@@ -1,0 +1,21 @@
+"""polykey_tpu — a TPU-native inference gateway framework.
+
+Re-implements the capabilities of spounge-ai/polykey-service (the gRPC
+`polykey.v2.PolykeyService` tool-execution gateway) with a co-located JAX/XLA/
+Pallas serving engine instead of a mock/proxy backend:
+
+- ``polykey_tpu.gateway``  — gRPC server/client/config/observability parity
+  with the reference (cmd/polykey, cmd/dev_client, internal/{server,service,
+  config} in /root/reference).
+- ``polykey_tpu.models``   — Llama-3 / Mixtral / Gemma-2 model families as
+  functional JAX pytrees.
+- ``polykey_tpu.ops``      — Pallas TPU kernels (paged attention, flash
+  prefill, ring attention, MoE dispatch) with jnp fallbacks for CPU tests.
+- ``polykey_tpu.engine``   — continuous-batching scheduler, paged KV cache,
+  sampling, streaming token delivery, speculative decode.
+- ``polykey_tpu.parallel`` — device mesh + sharding specs (dp/tp/pp/sp/ep)
+  mapped onto ICI/DCN via jax.sharding.
+- ``polykey_tpu.train``    — sharded fine-tuning step (loss/grad/optimizer).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,6 @@
+"""Gateway tier: gRPC server/client, config, health, observability.
+
+Behavior parity with the reference Go gateway (/root/reference/cmd/polykey,
+cmd/dev_client, internal/{server,service,config}), with the service seam
+(`Service.execute_tool`) as the mount point for the TPU engine.
+"""

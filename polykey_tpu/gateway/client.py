@@ -1,0 +1,257 @@
+"""Dev client — end-to-end smoke test of the running gateway.
+
+Parity with /root/reference/cmd/dev_client/main.go: logs to an in-memory
+buffer, runs config-load → raw TCP probe → gRPC connect (insecure creds,
+client keepalive 10s/5s with permit-without-stream, 4 MiB message caps) →
+one `example_tool` ExecuteTool call with struct params, secret_id
+"secret-123" and request metadata, 30s deadline — then renders the buffered
+logs as a Jest-style report. Its four PASS checks (config, TCP, gRPC READY,
+tool execution) are the acceptance criterion (reference README.md:84-101).
+
+Extension: ``--tool`` selects the tool and ``--stream`` exercises the
+server-streaming RPC (prints tokens as they arrive, then TTFT/throughput).
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import signal
+import sys
+import threading
+import time
+from typing import Optional
+
+import grpc
+
+from ..proto import common_v2_pb2 as cmn
+from ..proto import polykey_v2_pb2 as pk
+from ..proto.polykey_v2_grpc import PolykeyServiceStub
+from .beautify import print_jest_report
+from .config import Config, ConfigLoader, NetworkTester
+from .jsonlog import Logger
+
+_CHANNEL_OPTIONS = [
+    ("grpc.keepalive_time_ms", 10_000),
+    ("grpc.keepalive_timeout_ms", 5_000),
+    ("grpc.keepalive_permit_without_calls", 1),
+    ("grpc.max_receive_message_length", 4 * 1024 * 1024),
+    ("grpc.max_send_message_length", 4 * 1024 * 1024),
+]
+
+
+class Client:
+    def __init__(self, cfg: Config, logger: Logger):
+        self.logger = logger
+        self.channel = self._create_channel(cfg)
+        self.stub = PolykeyServiceStub(self.channel)
+
+    def _create_channel(self, cfg: Config) -> grpc.Channel:
+        self.logger.info("Creating gRPC connection", server=cfg.server_address)
+        channel = grpc.insecure_channel(cfg.server_address, options=_CHANNEL_OPTIONS)
+        self._wait_for_ready(channel, cfg.timeout)
+        self.logger.info("gRPC connection established successfully")
+        return channel
+
+    def _wait_for_ready(self, channel: grpc.Channel, timeout: float) -> None:
+        # Explicit connectivity state machine (dev_client/main.go:214-236):
+        # log transitions at DEBUG, fail on TRANSIENT_FAILURE / SHUTDOWN.
+        done = threading.Event()
+        failed: list[grpc.ChannelConnectivity] = []
+        first = True
+
+        def on_state(state: grpc.ChannelConnectivity) -> None:
+            nonlocal first
+            if first:
+                self.logger.debug("Initial connection state", state=state.name)
+                first = False
+            else:
+                self.logger.debug("Connection state changed", state=state.name)
+            if state == grpc.ChannelConnectivity.READY:
+                done.set()
+            elif state in (
+                grpc.ChannelConnectivity.TRANSIENT_FAILURE,
+                grpc.ChannelConnectivity.SHUTDOWN,
+            ):
+                failed.append(state)
+                done.set()
+
+        channel.subscribe(on_state, try_to_connect=True)
+        if not done.wait(timeout):
+            raise TimeoutError("connection timeout")
+        if failed:
+            raise ConnectionError(f"connection failed with state: {failed[0].name}")
+
+    def close(self) -> None:
+        self.channel.close()
+
+    def execute_tool(self, request: pk.ExecuteToolRequest, timeout: float = 30.0):
+        self.logger.info(
+            "Executing tool",
+            tool_name=request.tool_name,
+            secret_id=request.secret_id if request.HasField("secret_id") else None,
+            has_metadata=request.HasField("metadata"),
+        )
+        try:
+            resp = self.stub.ExecuteTool(request, timeout=timeout)
+        except grpc.RpcError as e:
+            self.logger.error(
+                "gRPC call failed", code=e.code().name, message=e.details()
+            )
+            raise
+        self._log_response(resp)
+        return resp
+
+    def execute_tool_stream(self, request: pk.ExecuteToolRequest, timeout: float = 30.0):
+        self.logger.info(
+            "Executing tool",
+            tool_name=request.tool_name,
+            secret_id=request.secret_id if request.HasField("secret_id") else None,
+            has_metadata=request.HasField("metadata"),
+        )
+        text, usage, status = [], None, None
+        try:
+            for chunk in self.stub.ExecuteToolStream(request, timeout=timeout):
+                if chunk.delta:
+                    text.append(chunk.delta)
+                if chunk.final:
+                    if chunk.HasField("status"):
+                        status = chunk.status
+                    if chunk.HasField("usage"):
+                        usage = chunk.usage
+        except grpc.RpcError as e:
+            self.logger.error(
+                "gRPC call failed", code=e.code().name, message=e.details()
+            )
+            raise
+        if status is not None:
+            self.logger.info(
+                "Tool execution completed",
+                status_code=status.code,
+                status_message=status.message,
+            )
+        if usage is not None:
+            self.logger.info(
+                "Streaming completed",
+                completion_tokens=usage.completion_tokens,
+                ttft_ms=round(usage.ttft_ms, 1),
+                tokens_per_sec=round(usage.tokens_per_sec, 1),
+            )
+        return "".join(text)
+
+    def _log_response(self, resp: pk.ExecuteToolResponse) -> None:
+        if resp.HasField("status"):
+            self.logger.info(
+                "Tool execution completed",
+                status_code=resp.status.code,
+                status_message=resp.status.message,
+            )
+        arm = resp.WhichOneof("output")
+        if arm == "string_output":
+            preview = resp.string_output[:100] + (
+                "..." if len(resp.string_output) > 100 else ""
+            )
+            self.logger.info(
+                "Received string output",
+                output_length=len(resp.string_output),
+                output_preview=preview,
+            )
+        elif arm == "struct_output":
+            self.logger.info(
+                "Received struct output", field_count=len(resp.struct_output.fields)
+            )
+        elif arm == "file_output":
+            self.logger.info(
+                "Received file output",
+                file_name=resp.file_output.file_name,
+                mime_type=resp.file_output.mime_type,
+                size_bytes=len(resp.file_output.content),
+            )
+        else:
+            self.logger.warn("No output returned")
+
+
+def build_test_request(tool_name: str = "example_tool", prompt: Optional[str] = None):
+    request = pk.ExecuteToolRequest(tool_name=tool_name, secret_id="secret-123")
+    params: dict = {"example_param": "value", "timestamp": int(time.time())}
+    if prompt is not None:
+        params["prompt"] = prompt
+    request.parameters.update(params)
+    request.metadata.CopyFrom(
+        cmn.Metadata(
+            fields={
+                "client_version": "1.0.0",
+                "request_source": "dev_client",
+                "request_id": f"req-{time.time_ns()}",
+            }
+        )
+    )
+    return request
+
+
+def run(logger: Logger, args: argparse.Namespace) -> None:
+    logger.info("Starting polykey client...")
+
+    loader = ConfigLoader()
+    cfg = loader.load([])
+    if args.server:
+        cfg.server_address = args.server
+    logger.info(
+        "Configuration loaded",
+        runtime=str(cfg.detected_runtime),
+        server=cfg.server_address,
+    )
+
+    logger.info("Testing network connectivity...")
+    NetworkTester().test_connection(cfg.server_address)
+    logger.info("Network connectivity test passed")
+
+    client = Client(cfg, logger)
+    try:
+        request = build_test_request(args.tool, args.prompt)
+        if args.stream:
+            client.execute_tool_stream(request)
+        else:
+            client.execute_tool(request)
+    finally:
+        client.close()
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description="polykey dev client")
+    parser.add_argument("-server", "--server", default="", help="server address")
+    parser.add_argument("--tool", default="example_tool")
+    parser.add_argument("--prompt", default=None)
+    parser.add_argument("--stream", action="store_true")
+    parser.add_argument(
+        "--raw-logs", action="store_true", help="print JSON logs instead of report"
+    )
+    args = parser.parse_args(argv)
+
+    buffer = io.StringIO()
+    logger = Logger(stream=buffer, level="debug")
+
+    try:
+        signal.signal(signal.SIGINT, signal.default_int_handler)
+    except ValueError:
+        pass  # not on the main thread (tests)
+
+    ok = True
+    try:
+        run(logger, args)
+    except KeyboardInterrupt:
+        logger.info("Received shutdown signal")
+    except Exception as e:
+        logger.error("Application failed", error=str(e))
+        ok = False
+
+    lines = buffer.getvalue().splitlines()
+    if args.raw_logs:
+        sys.stdout.write("\n".join(lines) + "\n")
+    else:
+        ok = print_jest_report(lines) and ok
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,73 @@
+"""grpc.health.v1 server implementation.
+
+Reproduces the semantics of grpc-go's bundled health server, which the
+reference wires in at /root/reference/cmd/polykey/main.go:82-94 and shuts down
+on SIGTERM (main.go:118): per-service serving status, NOT_FOUND on Check for
+unknown services, streaming Watch with SERVICE_UNKNOWN for unregistered names,
+and Shutdown() forcing every current and future status to NOT_SERVING.
+
+The engine watchdog (polykey_tpu.engine.watchdog) flips statuses here when the
+TPU step loop stalls, which is the serving-tier liveness story the reference
+delegates to container healthchecks (compose.yml:17-22).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import grpc
+
+from ..proto import health_v1_pb2 as health_pb
+from ..proto.health_v1_grpc import HealthServicer
+
+SERVING = health_pb.HealthCheckResponse.SERVING
+NOT_SERVING = health_pb.HealthCheckResponse.NOT_SERVING
+SERVICE_UNKNOWN = health_pb.HealthCheckResponse.SERVICE_UNKNOWN
+
+
+class HealthService(HealthServicer):
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._statuses: dict[str, int] = {}
+        self._shutdown = False
+
+    def set_serving_status(self, service: str, status: int) -> None:
+        with self._cond:
+            if self._shutdown:
+                return
+            self._statuses[service] = status
+            self._cond.notify_all()
+
+    def shutdown(self) -> None:
+        """Force every present and future status to NOT_SERVING."""
+        with self._cond:
+            self._shutdown = True
+            for service in self._statuses:
+                self._statuses[service] = NOT_SERVING
+            self._cond.notify_all()
+
+    def resume(self) -> None:
+        with self._cond:
+            self._shutdown = False
+
+    # -- RPC methods --------------------------------------------------------
+
+    def Check(self, request, context):
+        with self._cond:
+            if request.service not in self._statuses:
+                context.abort(grpc.StatusCode.NOT_FOUND, "unknown service")
+            return health_pb.HealthCheckResponse(
+                status=self._statuses[request.service]
+            )
+
+    def Watch(self, request, context):
+        last_sent = None
+        while context.is_active():
+            with self._cond:
+                status = self._statuses.get(request.service, SERVICE_UNKNOWN)
+                if status == last_sent:
+                    # Wake periodically to notice client disconnect.
+                    self._cond.wait(timeout=1.0)
+                    continue
+                last_sent = status
+            yield health_pb.HealthCheckResponse(status=status)

@@ -1,0 +1,137 @@
+"""Per-RPC structured logging interceptor.
+
+Parity with the reference's unary logging interceptor
+(/root/reference/cmd/polykey/main.go:25-52): health checks are not logged,
+every other RPC gets a "gRPC call received" line on entry and a
+"gRPC call finished" line with Go-style duration and status-code name on exit
+(ERROR level when the RPC failed). Extended to server-streaming methods, which
+the reference does not have.
+"""
+
+from __future__ import annotations
+
+import time
+
+import grpc
+
+from .jsonlog import Logger, go_duration
+
+_SKIP_METHODS = frozenset({"/grpc.health.v1.Health/Check"})
+
+# gRPC status-code names as Go's codes.Code.String() renders them — the
+# log-beautifier treats anything but "OK" as a failure
+# (/root/reference/cmd/utils/log-beautifier/main.go:70-73).
+_GO_CODE_NAMES = {
+    grpc.StatusCode.OK: "OK",
+    grpc.StatusCode.CANCELLED: "Canceled",
+    grpc.StatusCode.UNKNOWN: "Unknown",
+    grpc.StatusCode.INVALID_ARGUMENT: "InvalidArgument",
+    grpc.StatusCode.DEADLINE_EXCEEDED: "DeadlineExceeded",
+    grpc.StatusCode.NOT_FOUND: "NotFound",
+    grpc.StatusCode.ALREADY_EXISTS: "AlreadyExists",
+    grpc.StatusCode.PERMISSION_DENIED: "PermissionDenied",
+    grpc.StatusCode.RESOURCE_EXHAUSTED: "ResourceExhausted",
+    grpc.StatusCode.FAILED_PRECONDITION: "FailedPrecondition",
+    grpc.StatusCode.ABORTED: "Aborted",
+    grpc.StatusCode.OUT_OF_RANGE: "OutOfRange",
+    grpc.StatusCode.UNIMPLEMENTED: "Unimplemented",
+    grpc.StatusCode.INTERNAL: "Internal",
+    grpc.StatusCode.UNAVAILABLE: "Unavailable",
+    grpc.StatusCode.DATA_LOSS: "DataLoss",
+    grpc.StatusCode.UNAUTHENTICATED: "Unauthenticated",
+}
+
+
+class _RecordingContext:
+    """ServicerContext proxy that remembers the status code the handler set."""
+
+    def __init__(self, context):
+        self._ctx = context
+        self.recorded_code = None
+
+    def set_code(self, code):
+        self.recorded_code = code
+        return self._ctx.set_code(code)
+
+    def abort(self, code, details):
+        self.recorded_code = code
+        return self._ctx.abort(code, details)
+
+    def abort_with_status(self, status):
+        self.recorded_code = status.code
+        return self._ctx.abort_with_status(status)
+
+    def __getattr__(self, name):
+        return getattr(self._ctx, name)
+
+
+def _code_name(rec: _RecordingContext, error: BaseException | None) -> str:
+    if rec.recorded_code is not None:
+        return _GO_CODE_NAMES.get(rec.recorded_code, str(rec.recorded_code))
+    if error is not None:
+        return "Unknown"
+    return "OK"
+
+
+class LoggingInterceptor(grpc.ServerInterceptor):
+    def __init__(self, logger: Logger):
+        self._logger = logger
+
+    def intercept_service(self, continuation, handler_call_details):
+        handler = continuation(handler_call_details)
+        method = handler_call_details.method
+        if handler is None or method in _SKIP_METHODS:
+            return handler
+
+        if handler.unary_unary is not None:
+            return grpc.unary_unary_rpc_method_handler(
+                self._wrap_unary(handler.unary_unary, method),
+                request_deserializer=handler.request_deserializer,
+                response_serializer=handler.response_serializer,
+            )
+        if handler.unary_stream is not None:
+            return grpc.unary_stream_rpc_method_handler(
+                self._wrap_stream(handler.unary_stream, method),
+                request_deserializer=handler.request_deserializer,
+                response_serializer=handler.response_serializer,
+            )
+        return handler
+
+    def _finish(self, method: str, start: float, code: str) -> None:
+        level = "INFO" if code == "OK" else "ERROR"
+        self._logger.log(
+            level,
+            "gRPC call finished",
+            method=method,
+            duration=go_duration(time.monotonic() - start),
+            code=code,
+        )
+
+    def _wrap_unary(self, behavior, method):
+        def wrapped(request, context):
+            start = time.monotonic()
+            self._logger.info("gRPC call received", method=method)
+            rec = _RecordingContext(context)
+            try:
+                response = behavior(request, rec)
+            except BaseException as e:
+                self._finish(method, start, _code_name(rec, e))
+                raise
+            self._finish(method, start, _code_name(rec, None))
+            return response
+
+        return wrapped
+
+    def _wrap_stream(self, behavior, method):
+        def wrapped(request, context):
+            start = time.monotonic()
+            self._logger.info("gRPC call received", method=method)
+            rec = _RecordingContext(context)
+            try:
+                yield from behavior(request, rec)
+            except BaseException as e:
+                self._finish(method, start, _code_name(rec, e))
+                raise
+            self._finish(method, start, _code_name(rec, None))
+
+        return wrapped

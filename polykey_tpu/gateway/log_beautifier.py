@@ -1,0 +1,17 @@
+"""Stdin pipe entry point for the server-log beautifier.
+
+Usage (reference parity: Makefile compose-logs pipes
+``docker compose logs -f`` into the Go binary —
+/root/reference/Makefile:143-150):
+
+    docker compose logs -f | python -m polykey_tpu.gateway.log_beautifier
+
+A native C++ build of the same filter is available via ``make native``
+(native/log_beautifier.cc) for log pipelines where a Python runtime is
+unwanted.
+"""
+
+from .beautify import beautify_server_stream
+
+if __name__ == "__main__":
+    beautify_server_stream()

@@ -1,0 +1,209 @@
+"""gRPC server wiring — parity with the reference server binary.
+
+Reproduces /root/reference/cmd/polykey/main.go end to end:
+
+- listen address from ``LISTEN_ADDR``, default ``:50051`` (main.go:57-59);
+- keepalive: MaxConnectionIdle 5m, Time 2h, Timeout 20s (main.go:68-72);
+- unary logging interceptor that skips health checks (main.go:25-52);
+- health service with SERVING for ``polykey.v2.PolykeyService`` and ``""``
+  (main.go:82-94), plus server reflection (main.go:80);
+- startup log of the registered service/method table (main.go:97-103);
+- graceful drain on SIGINT/SIGTERM: health shutdown first, then server stop
+  (main.go:113-120).
+
+The RPC handler mirrors internal/server/server.go: log the request shape, then
+delegate to the Service seam, passing errors through unchanged (a plain
+service error surfaces as code Unknown, as a bare Go error does).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from ..proto import health_v1_pb2 as health_pb
+from ..proto import polykey_v2_pb2 as pk
+from ..proto.health_v1_grpc import add_HealthServicer_to_server
+from ..proto.polykey_v2_grpc import (
+    SERVICE_NAME,
+    PolykeyServiceServicer,
+    add_PolykeyServiceServicer_to_server,
+)
+from .health import HealthService
+from .interceptor import LoggingInterceptor
+from .jsonlog import Logger
+from .reflection import SERVICE_NAME as REFLECTION_SERVICE_NAME
+from .reflection import ReflectionService, add_reflection_to_server
+from .service import Service
+from ..proto.health_v1_grpc import SERVICE_NAME as HEALTH_SERVICE_NAME
+
+_KEEPALIVE_OPTIONS = [
+    ("grpc.max_connection_idle_ms", 5 * 60 * 1000),   # MaxConnectionIdle 5m
+    ("grpc.keepalive_time_ms", 2 * 60 * 60 * 1000),   # Time 2h
+    ("grpc.keepalive_timeout_ms", 20 * 1000),         # Timeout 20s
+    # Fail loudly when the port is taken (Go's net.Listen behavior) instead
+    # of silently sharing it via Linux SO_REUSEPORT.
+    ("grpc.so_reuseport", 0),
+]
+
+class PolykeyServer(PolykeyServiceServicer):
+    """RPC handler layer (reference: internal/server/server.go:12-43)."""
+
+    def __init__(self, service: Service, logger: Optional[Logger] = None):
+        self.service = service
+        self.logger = logger or Logger()
+
+    def _log_call(self, rpc: str, request: pk.ExecuteToolRequest) -> None:
+        self.logger.info(
+            f"{rpc} called",
+            tool_name=request.tool_name,
+            has_parameters=request.HasField("parameters"),
+            has_secret_id=request.HasField("secret_id"),
+            has_metadata=request.HasField("metadata"),
+        )
+
+    @staticmethod
+    def _unpack(request: pk.ExecuteToolRequest):
+        return (
+            request.tool_name,
+            request.parameters if request.HasField("parameters") else None,
+            request.secret_id if request.HasField("secret_id") else None,
+            request.metadata if request.HasField("metadata") else None,
+        )
+
+    def ExecuteTool(self, request, context):
+        self._log_call("ExecuteTool", request)
+        try:
+            return self.service.execute_tool(*self._unpack(request))
+        except Exception as e:
+            self.logger.error("Service ExecuteTool failed", error=str(e))
+            context.abort(grpc.StatusCode.UNKNOWN, str(e))
+
+    def ExecuteToolStream(self, request, context):
+        self._log_call("ExecuteToolStream", request)
+        try:
+            yield from self.service.execute_tool_stream(*self._unpack(request))
+        except Exception as e:
+            self.logger.error("Service ExecuteToolStream failed", error=str(e))
+            context.abort(grpc.StatusCode.UNKNOWN, str(e))
+
+
+def normalize_address(addr: str) -> str:
+    """Accept Go-style ':50051' (bind all interfaces) as well as host:port."""
+    if addr.startswith(":"):
+        return "[::]" + addr
+    return addr
+
+
+def build_server(
+    service: Service,
+    logger: Optional[Logger] = None,
+    address: str = ":50051",
+    max_workers: int = 32,
+):
+    """Assemble the fully-wired gRPC server; returns (server, health)."""
+    logger = logger or Logger()
+    server = grpc.server(
+        futures.ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="polykey-rpc"
+        ),
+        interceptors=[LoggingInterceptor(logger)],
+        options=_KEEPALIVE_OPTIONS,
+    )
+
+    add_PolykeyServiceServicer_to_server(PolykeyServer(service, logger), server)
+
+    health = HealthService()
+    add_HealthServicer_to_server(health, server)
+    health.set_serving_status(SERVICE_NAME, health_pb.HealthCheckResponse.SERVING)
+    health.set_serving_status("", health_pb.HealthCheckResponse.SERVING)
+
+    add_reflection_to_server(ReflectionService(), server)
+
+    try:
+        port = server.add_insecure_port(normalize_address(address))
+    except RuntimeError as e:  # grpc raises on bind failure
+        raise OSError(f"failed to listen on {address}: {e}") from e
+    if port == 0:
+        raise OSError(f"failed to listen on {address}")
+
+    return server, health, port
+
+
+_SERVICE_TABLE = {
+    SERVICE_NAME: ["ExecuteTool", "ExecuteToolStream"],
+    HEALTH_SERVICE_NAME: ["Check", "Watch"],
+    REFLECTION_SERVICE_NAME: ["ServerReflectionInfo"],
+}
+
+
+def _log_service_table(logger: Logger) -> None:
+    # Parity with the startup service/method table (main.go:97-103).
+    logger.info("Registered services:")
+    for name, methods in _SERVICE_TABLE.items():
+        logger.info("Service registered", name=name, methods=len(methods))
+        for method in methods:
+            logger.info("Method available", service=name, method=method)
+
+
+def serve(service: Optional[Service] = None, address: Optional[str] = None) -> None:
+    """Process entry point (reference: cmd/polykey/main.go:54-121)."""
+    logger = Logger(level=os.environ.get("POLYKEY_LOG_LEVEL", "info"))
+
+    if address is None:
+        address = os.environ.get("LISTEN_ADDR") or ":50051"
+
+    if service is None:
+        try:
+            service = _default_service(logger)
+        except Exception as e:
+            logger.error("failed to initialize backend", error=str(e))
+            raise SystemExit(1)
+
+    try:
+        server, health, _ = build_server(service, logger, address)
+    except OSError as e:
+        logger.error("failed to listen", error=str(e))
+        raise SystemExit(1)
+
+    _log_service_table(logger)
+
+    quit_event = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: quit_event.set())
+
+    server.start()
+    logger.info("server starting", address=address)
+
+    quit_event.wait()
+    logger.info("server shutting down")
+    health.shutdown()
+    server.stop(grace=10).wait()
+    service.close()
+    logger.info("server stopped")
+
+
+def _default_service(logger: Logger) -> Service:
+    """Select the backend: TPU engine when requested, mock otherwise.
+
+    The reference hard-wires its mock (main.go:85). Here POLYKEY_BACKEND=tpu
+    mounts the serving engine; the default remains the dependency-free mock so
+    the gateway runs anywhere.
+    """
+    backend = os.environ.get("POLYKEY_BACKEND", "mock").lower()
+    if backend in ("tpu", "engine"):
+        from .tpu_service import TpuService
+
+        return TpuService.from_env(logger=logger)
+    from .mock_service import MockService
+
+    return MockService()
+
+
+if __name__ == "__main__":
+    serve()

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Regenerate checked-in protobuf message modules.
+#
+# Only message stubs (*_pb2.py) are generated — grpc_tools is not available in
+# the serving image, so the gRPC service glue is hand-written in
+# polykey_tpu/proto/*_grpc.py against these messages.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT=polykey_tpu/proto
+mkdir -p "$OUT"
+
+protoc -I protos \
+  --python_out="$OUT" \
+  --descriptor_set_out="$OUT/descriptor_set.binpb" --include_imports \
+  protos/common_v2.proto protos/polykey_v2.proto protos/health_v1.proto \
+  protos/reflection_v1alpha.proto
+
+# protoc emits absolute imports between generated modules; rewrite to
+# package-relative so polykey_tpu.proto is importable from anywhere.
+sed -i 's/^import common_v2_pb2 as/from . import common_v2_pb2 as/' "$OUT"/*_pb2.py
+
+echo "generated: $(ls "$OUT" | grep -c _pb2.py) pb2 modules + descriptor_set.binpb"

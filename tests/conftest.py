@@ -1,0 +1,19 @@
+"""Test-session bootstrap.
+
+Forces JAX onto a simulated 8-device CPU platform *before* jax is imported
+anywhere, so multi-chip sharding (tp/dp/ep/sp axes over a Mesh) is exercised
+without TPU hardware — the strategy SURVEY.md §4 prescribes for this
+framework's multi-node tier.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

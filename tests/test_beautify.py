@@ -1,0 +1,105 @@
+"""Beautifier rendering tests (reference: test/utils/beautify.go and
+cmd/utils/log-beautifier/main.go)."""
+
+import io
+import json
+
+from polykey_tpu.gateway.beautify import beautify_server_stream, print_jest_report
+
+
+def _app_lines(fail=False):
+    lines = [
+        {"time": "t", "level": "INFO", "msg": "Starting polykey client..."},
+        {"time": "t", "level": "INFO", "msg": "Configuration loaded",
+         "runtime": "local", "server": "localhost:50051"},
+        {"time": "t", "level": "INFO", "msg": "Network connectivity test passed"},
+        {"time": "t", "level": "DEBUG", "msg": "Connection state changed",
+         "state": "READY"},
+        {"time": "t", "level": "INFO", "msg": "gRPC connection established successfully"},
+        {"time": "t", "level": "INFO", "msg": "Executing tool",
+         "tool_name": "example_tool"},
+        {"time": "t", "level": "INFO", "msg": "Tool execution completed",
+         "status_code": 200, "status_message": "Tool executed successfully"},
+    ]
+    if fail:
+        lines.append(
+            {"time": "t", "level": "ERROR", "msg": "Application failed",
+             "error": "boom"}
+        )
+    return [json.dumps(x) for x in lines]
+
+
+def test_app_report_all_pass():
+    out = io.StringIO()
+    ok = print_jest_report(_app_lines(), out)
+    text = out.getvalue()
+    assert ok
+    assert "All 4 checks passed" in text
+    for suite in ("SETUP", "CONNECTION", "EXECUTION"):
+        assert suite in text
+
+
+def test_app_report_failure():
+    out = io.StringIO()
+    ok = print_jest_report(_app_lines(fail=True), out)
+    text = out.getvalue()
+    assert not ok
+    assert "1 failed, 4 passed" in text
+    assert "ERROR" in text
+
+
+def test_report_skips_unparseable_lines():
+    out = io.StringIO()
+    ok = print_jest_report(["not json", "", "[1,2]"] + _app_lines(), out)
+    assert ok
+
+
+def test_pytest_report_mode():
+    lines = [
+        json.dumps({"$report_type": "TestReport", "nodeid": "tests/a.py::t1",
+                    "when": "call", "outcome": "passed", "duration": 0.01}),
+        json.dumps({"$report_type": "TestReport", "nodeid": "tests/a.py::t1",
+                    "when": "teardown", "outcome": "passed", "duration": 0.0}),
+        json.dumps({"$report_type": "TestReport", "nodeid": "tests/b.py::t2",
+                    "when": "call", "outcome": "failed", "duration": 0.02}),
+    ]
+    out = io.StringIO()
+    ok = print_jest_report(lines, out)
+    assert not ok
+    assert "1 failed, 1 passed" in out.getvalue()
+
+
+def test_server_stream_beautifier():
+    entries = [
+        "some non-json noise",
+        "compose-prefix | " + json.dumps(
+            {"msg": "server starting", "address": ":50051"}),
+        json.dumps({"msg": "gRPC call received",
+                    "method": "/polykey.v2.PolykeyService/ExecuteTool"}),
+        json.dumps({"msg": "gRPC call finished",
+                    "method": "/polykey.v2.PolykeyService/ExecuteTool",
+                    "duration": "1ms", "code": "OK"}),
+        json.dumps({"msg": "gRPC call received",
+                    "method": "/polykey.v2.PolykeyService/ExecuteToolStream"}),
+        json.dumps({"msg": "gRPC call finished",
+                    "method": "/polykey.v2.PolykeyService/ExecuteToolStream",
+                    "duration": "2ms", "code": "Internal"}),
+        json.dumps({"msg": "server shutting down"}),
+    ]
+    out = io.StringIO()
+    beautify_server_stream(io.StringIO("\n".join(entries) + "\n"), out)
+    text = out.getvalue()
+    assert "some non-json noise" in text          # passthrough
+    assert "Server Listening" in text
+    assert "✓" in text and "✗" in text            # OK pass, Internal fail
+    assert "SHUTDOWN" in text
+
+
+def test_server_stream_ignores_unmatched_finish():
+    out = io.StringIO()
+    beautify_server_stream(
+        io.StringIO(json.dumps({"msg": "gRPC call finished", "method": "/m",
+                                "code": "OK"}) + "\n"),
+        out,
+    )
+    assert "✓" not in out.getvalue()

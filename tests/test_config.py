@@ -1,0 +1,120 @@
+"""Config loader parity tests (/root/reference/internal/config/config.go)."""
+
+import pytest
+
+from polykey_tpu.gateway.config import (
+    Config,
+    ConfigLoader,
+    NetworkTester,
+    RuntimeDetector,
+    RuntimeEnvironment,
+    parse_duration,
+)
+
+
+class _FixedDetector(RuntimeDetector):
+    def __init__(self, runtime):
+        self._runtime = runtime
+
+    def detect_runtime(self):
+        return self._runtime
+
+
+def _clear_env(monkeypatch):
+    for var in (
+        "POLYKEY_SERVER_ADDR",
+        "POLYKEY_TIMEOUT",
+        "POLYKEY_LOG_LEVEL",
+        "POLYKEY_ENV",
+        "KUBERNETES_SERVICE_HOST",
+        "container",
+    ):
+        monkeypatch.delenv(var, raising=False)
+
+
+def test_defaults(monkeypatch):
+    _clear_env(monkeypatch)
+    cfg = ConfigLoader(_FixedDetector(RuntimeEnvironment.LOCAL)).load([])
+    assert cfg.timeout == 5.0
+    assert cfg.log_level == "info"
+    assert cfg.environment == "development"
+    assert cfg.server_address == "localhost:50051"
+
+
+def test_flags(monkeypatch):
+    _clear_env(monkeypatch)
+    cfg = ConfigLoader(_FixedDetector(RuntimeEnvironment.LOCAL)).load(
+        ["-server", "example:1234", "-timeout", "10s", "-log-level", "debug",
+         "-env", "production"]
+    )
+    assert cfg.server_address == "example:1234"
+    assert cfg.timeout == 10.0
+    assert cfg.log_level == "debug"
+    assert cfg.environment == "production"
+
+
+def test_env_overrides_flags(monkeypatch):
+    # Load() applies env after flags, so env wins (config.go Load()).
+    _clear_env(monkeypatch)
+    monkeypatch.setenv("POLYKEY_SERVER_ADDR", "env-host:9")
+    monkeypatch.setenv("POLYKEY_TIMEOUT", "500ms")
+    cfg = ConfigLoader(_FixedDetector(RuntimeEnvironment.LOCAL)).load(
+        ["-server", "flag-host:8", "-timeout", "10s"]
+    )
+    assert cfg.server_address == "env-host:9"
+    assert cfg.timeout == 0.5
+
+
+def test_malformed_env_timeout_is_ignored(monkeypatch):
+    _clear_env(monkeypatch)
+    monkeypatch.setenv("POLYKEY_TIMEOUT", "not-a-duration")
+    cfg = ConfigLoader(_FixedDetector(RuntimeEnvironment.LOCAL)).load([])
+    assert cfg.timeout == 5.0
+
+
+@pytest.mark.parametrize(
+    "runtime,expected",
+    [
+        (RuntimeEnvironment.KUBERNETES, "polykey-service:50051"),
+        (RuntimeEnvironment.DOCKER, "polykey-server:50051"),
+        (RuntimeEnvironment.CONTAINERD, "polykey-server:50051"),
+        (RuntimeEnvironment.PODMAN, "polykey-server:50051"),
+        (RuntimeEnvironment.LOCAL, "localhost:50051"),
+    ],
+)
+def test_address_autodetection(monkeypatch, runtime, expected):
+    _clear_env(monkeypatch)
+    cfg = ConfigLoader(_FixedDetector(runtime)).load([])
+    assert cfg.server_address == expected
+
+
+def test_k8s_detection_via_env(monkeypatch):
+    _clear_env(monkeypatch)
+    monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "10.0.0.1")
+    assert RuntimeDetector().detect_runtime() == RuntimeEnvironment.KUBERNETES
+
+
+def test_podman_detection_via_env(monkeypatch):
+    _clear_env(monkeypatch)
+    monkeypatch.setenv("container", "podman")
+    assert RuntimeDetector().detect_runtime() == RuntimeEnvironment.PODMAN
+
+
+@pytest.mark.parametrize(
+    "text,seconds",
+    [("5s", 5.0), ("500ms", 0.5), ("1m30s", 90.0), ("2h", 7200.0),
+     ("250us", 0.00025), ("3", 3.0)],
+)
+def test_parse_duration(text, seconds):
+    assert parse_duration(text) == pytest.approx(seconds)
+
+
+def test_parse_duration_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_duration("10 parsecs")
+
+
+def test_network_tester_refused():
+    with pytest.raises(ConnectionError):
+        # Port 1 on localhost is essentially guaranteed closed.
+        NetworkTester().test_connection("127.0.0.1:1", timeout=0.5)

@@ -1,0 +1,135 @@
+"""Integration tests of the full gRPC stack against the mock backend —
+the zero-TPU test discipline from SURVEY.md §4 (the mock is the fake
+backend, as in the reference's integration tier)."""
+
+import io
+
+import grpc
+import pytest
+
+from polykey_tpu.gateway import server as gateway_server
+from polykey_tpu.gateway.jsonlog import Logger
+from polykey_tpu.gateway.mock_service import MockService
+from polykey_tpu.gateway.service import Service
+from polykey_tpu.proto import health_v1_pb2 as health_pb
+from polykey_tpu.proto import polykey_v2_pb2 as pk
+from polykey_tpu.proto import reflection_v1alpha_pb2 as refl_pb
+from polykey_tpu.proto.health_v1_grpc import HealthStub
+from polykey_tpu.proto.polykey_v2_grpc import PolykeyServiceStub
+
+
+class _FailingService(Service):
+    def execute_tool(self, tool_name, parameters, secret_id, metadata):
+        raise RuntimeError("backend exploded")
+
+
+@pytest.fixture()
+def stack():
+    log_buffer = io.StringIO()
+    logger = Logger(stream=log_buffer, level="debug")
+    server, health, port = gateway_server.build_server(
+        MockService(), logger, address="127.0.0.1:0"
+    )
+    server.start()
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    yield channel, health, log_buffer
+    channel.close()
+    server.stop(grace=None)
+
+
+def test_execute_tool_roundtrip(stack):
+    channel, _, log_buffer = stack
+    stub = PolykeyServiceStub(channel)
+    req = pk.ExecuteToolRequest(tool_name="example_tool", secret_id="secret-123")
+    req.parameters.update({"example_param": "value"})
+    req.metadata.fields["request_id"] = "r1"
+    resp = stub.ExecuteTool(req, timeout=5)
+    assert resp.status.code == 200
+    assert resp.string_output.startswith("Mock execution of example_tool at ")
+    logs = log_buffer.getvalue()
+    # Interceptor parity: received + finished lines with OK code.
+    assert '"msg":"gRPC call received"' in logs
+    assert '"code":"OK"' in logs
+    # Handler parity (server.go:28-33): request-shape log line.
+    assert '"has_parameters":true' in logs
+    assert '"has_secret_id":true' in logs
+
+
+def test_execute_tool_stream(stack):
+    channel, _, _ = stack
+    stub = PolykeyServiceStub(channel)
+    req = pk.ExecuteToolRequest(tool_name="file_tool")
+    chunks = list(stub.ExecuteToolStream(req, timeout=5))
+    assert chunks[-1].final
+    assert chunks[-1].status.code == 200
+
+
+def test_service_error_maps_to_unknown():
+    # A bare service error surfaces as code Unknown, like a plain Go error.
+    server, health, port = gateway_server.build_server(
+        _FailingService(), Logger(stream=io.StringIO()), address="127.0.0.1:0"
+    )
+    server.start()
+    try:
+        with grpc.insecure_channel(f"127.0.0.1:{port}") as channel:
+            stub = PolykeyServiceStub(channel)
+            with pytest.raises(grpc.RpcError) as err:
+                stub.ExecuteTool(pk.ExecuteToolRequest(tool_name="x"), timeout=5)
+            assert err.value.code() == grpc.StatusCode.UNKNOWN
+            assert "backend exploded" in err.value.details()
+    finally:
+        server.stop(grace=None)
+
+
+def test_health_statuses(stack):
+    channel, health, _ = stack
+    stub = HealthStub(channel)
+    # Both the service name and "" are SERVING (main.go:93-94 parity).
+    for name in ("polykey.v2.PolykeyService", ""):
+        resp = stub.Check(health_pb.HealthCheckRequest(service=name), timeout=5)
+        assert resp.status == health_pb.HealthCheckResponse.SERVING
+    # Unknown service → NOT_FOUND (grpc-go health server semantics).
+    with pytest.raises(grpc.RpcError) as err:
+        stub.Check(health_pb.HealthCheckRequest(service="nope"), timeout=5)
+    assert err.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+def test_health_shutdown_forces_not_serving(stack):
+    channel, health, _ = stack
+    stub = HealthStub(channel)
+    health.shutdown()
+    resp = stub.Check(health_pb.HealthCheckRequest(service=""), timeout=5)
+    assert resp.status == health_pb.HealthCheckResponse.NOT_SERVING
+    # SetServingStatus after Shutdown is ignored.
+    health.set_serving_status("", health_pb.HealthCheckResponse.SERVING)
+    resp = stub.Check(health_pb.HealthCheckRequest(service=""), timeout=5)
+    assert resp.status == health_pb.HealthCheckResponse.NOT_SERVING
+
+
+def test_health_check_not_logged(stack):
+    channel, _, log_buffer = stack
+    stub = HealthStub(channel)
+    stub.Check(health_pb.HealthCheckRequest(service=""), timeout=5)
+    # Interceptor skips /grpc.health.v1.Health/Check (main.go:29-31 parity).
+    assert "Health/Check" not in log_buffer.getvalue()
+
+
+def test_reflection_list_and_lookup(stack):
+    channel, _, _ = stack
+    refl = channel.stream_stream(
+        "/grpc.reflection.v1alpha.ServerReflection/ServerReflectionInfo",
+        request_serializer=refl_pb.ServerReflectionRequest.SerializeToString,
+        response_deserializer=refl_pb.ServerReflectionResponse.FromString,
+    )
+    requests = [
+        refl_pb.ServerReflectionRequest(list_services=""),
+        refl_pb.ServerReflectionRequest(
+            file_containing_symbol="polykey.v2.PolykeyService"
+        ),
+    ]
+    responses = list(refl.__call__(iter(requests), timeout=5))
+    services = {s.name for s in responses[0].list_services_response.service}
+    assert "polykey.v2.PolykeyService" in services
+    assert "grpc.health.v1.Health" in services
+    files = responses[1].file_descriptor_response.file_descriptor_proto
+    assert len(files) >= 2  # polykey_v2.proto + its imports

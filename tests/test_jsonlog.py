@@ -1,0 +1,42 @@
+"""slog-style JSON logger format tests."""
+
+import io
+import json
+
+from polykey_tpu.gateway.jsonlog import Logger, go_duration
+
+
+def test_record_shape():
+    buf = io.StringIO()
+    Logger(stream=buf).info("hello", a=1, b="x", c=None, d=b"bytes")
+    record = json.loads(buf.getvalue())
+    assert record["level"] == "INFO"
+    assert record["msg"] == "hello"
+    assert record["a"] == 1 and record["b"] == "x" and record["c"] is None
+    assert record["d"] == "bytes"
+    assert "T" in record["time"]  # RFC3339
+
+
+def test_level_filtering():
+    buf = io.StringIO()
+    log = Logger(stream=buf, level="info")
+    log.debug("hidden")
+    log.warn("shown")
+    lines = buf.getvalue().splitlines()
+    assert len(lines) == 1
+    assert json.loads(lines[0])["level"] == "WARN"
+
+
+def test_nonserializable_attr_stringified():
+    buf = io.StringIO()
+    Logger(stream=buf).info("x", obj=object())
+    assert "object object" in json.loads(buf.getvalue())["obj"]
+
+
+def test_go_duration_units():
+    assert go_duration(5e-7).endswith("ns") or go_duration(5e-7).endswith("µs")
+    assert go_duration(0.000160644) == "160.644µs"
+    assert go_duration(0.0123).endswith("ms")
+    assert go_duration(2.5) == "2.5s"
+    assert go_duration(90) == "1m30s"
+    assert go_duration(3725) == "1h2m5s"

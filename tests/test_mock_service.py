@@ -1,0 +1,63 @@
+"""MockService behavior parity with the reference mock
+(/root/reference/internal/service/mock.go:22-66)."""
+
+import re
+
+from polykey_tpu.gateway.mock_service import MockService
+
+
+def _call(tool_name):
+    return MockService().execute_tool(tool_name, None, None, None)
+
+
+def test_status_always_200():
+    for tool in ("example_tool", "struct_tool", "file_tool", "nope"):
+        resp = _call(tool)
+        assert resp.status.code == 200
+        assert resp.status.message == "Tool executed successfully"
+
+
+def test_example_tool_string_output():
+    resp = _call("example_tool")
+    assert resp.WhichOneof("output") == "string_output"
+    # "Mock execution of example_tool at <RFC3339>" (mock.go:34)
+    assert re.fullmatch(
+        r"Mock execution of example_tool at "
+        r"\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}(Z|[+-]\d{2}:\d{2})",
+        resp.string_output,
+    )
+
+
+def test_struct_tool_output():
+    resp = _call("struct_tool")
+    assert resp.WhichOneof("output") == "struct_output"
+    out = dict(resp.struct_output)
+    assert out["result"] == "success"
+    assert isinstance(out["timestamp"], float)  # struct numbers are doubles
+    data = dict(out["data"])
+    assert data["processed"] is True
+    assert data["count"] == 42
+
+
+def test_file_tool_output():
+    resp = _call("file_tool")
+    assert resp.WhichOneof("output") == "file_output"
+    f = resp.file_output
+    assert f.file_name == "example.txt"
+    assert f.mime_type == "text/plain"
+    assert f.content == b"This is mock file content"
+
+
+def test_unknown_tool_is_success_not_error():
+    # mock.go:60-63: unknown tools return 200 with a string, NOT an error.
+    resp = _call("does_not_exist")
+    assert resp.status.code == 200
+    assert resp.string_output == "Unknown tool: does_not_exist"
+
+
+def test_stream_reassembles_to_unary_text():
+    chunks = list(MockService().execute_tool_stream("other_tool", None, None, None))
+    assert chunks[-1].final
+    assert chunks[-1].status.code == 200
+    text = "".join(c.delta for c in chunks)
+    assert text == "Unknown tool: other_tool"
