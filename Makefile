@@ -1,0 +1,49 @@
+# polykey_tpu build/test/run targets.
+# Mirrors the reference Makefile's target families (/root/reference/Makefile:
+# build/run/test/compose lifecycle/help) adapted to the Python+C++ toolchain.
+
+PYTHON ?= python3
+CXX ?= g++
+CXXFLAGS ?= -O2 -std=c++17 -Wall -Wextra
+BUILD_DIR := build
+
+.PHONY: help run run-client test test-models native protos clean bench dryrun
+
+help: ## Show available targets
+	@grep -E '^[a-zA-Z_-]+:.*?## .*$$' $(MAKEFILE_LIST) | \
+	  awk 'BEGIN {FS = ":.*?## "}; {printf "  \033[36m%-14s\033[0m %s\n", $$1, $$2}'
+
+run: ## Start the gRPC gateway (mock backend; POLYKEY_BACKEND=tpu for engine)
+	$(PYTHON) -m polykey_tpu.gateway.server
+
+run-client: ## Run the dev client smoke test against a running server
+	$(PYTHON) -m polykey_tpu.gateway.client
+
+test: ## Run the full test suite (CPU, simulated 8-device mesh)
+	$(PYTHON) -m pytest tests/ -x -q
+
+test-report: ## Tests with the Jest-style report renderer
+	$(PYTHON) -m pytest tests/ -q --report-log=/tmp/pytest-report.jsonl; \
+	  $(PYTHON) -c "import sys; sys.path.insert(0,'.'); \
+	    from polykey_tpu.gateway.beautify import print_jest_report; \
+	    print_jest_report(open('/tmp/pytest-report.jsonl'))"
+
+native: $(BUILD_DIR)/log-beautifier ## Build native C++ components
+
+$(BUILD_DIR)/log-beautifier: native/log_beautifier.cc
+	@mkdir -p $(BUILD_DIR)
+	$(CXX) $(CXXFLAGS) -o $@ $<
+
+protos: ## Regenerate protobuf stubs from protos/
+	./scripts/gen_protos.sh
+
+bench: ## Run the benchmark harness (prints one JSON line)
+	$(PYTHON) bench.py
+
+dryrun: ## Compile-check the multi-chip sharded step on a virtual mesh
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	  $(PYTHON) -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
+
+clean: ## Remove build artifacts and caches
+	rm -rf $(BUILD_DIR) .pytest_cache
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

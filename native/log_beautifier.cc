@@ -1,0 +1,112 @@
+// Native stdin pipe filter for server JSON logs.
+//
+// C++ build of the same filter as polykey_tpu/gateway/log_beautifier.py, for
+// log pipelines where a Python runtime is unwanted. Mirrors the reference's
+// standalone Go pipe binary (/root/reference/cmd/utils/log-beautifier/main.go):
+// scan each line for the first '{', tolerate non-JSON prefixes (compose adds
+// them), track in-flight RPCs by method, render Jest-style steps, treat any
+// terminal code other than "OK" as FAIL.
+//
+// Build: make native   (→ build/log-beautifier)
+// Usage: docker compose logs -f | build/log-beautifier
+//
+// JSON handling is a minimal flat-string-field extractor rather than a full
+// parser: server log records are single-level objects with string/number
+// values (gateway/jsonlog.py), which is all this filter needs.
+
+#include <cstdio>
+#include <iostream>
+#include <map>
+#include <string>
+
+namespace {
+
+constexpr const char* kGreen = "\033[0;32m";
+constexpr const char* kRed = "\033[0;31m";
+constexpr const char* kGray = "\033[0;90m";
+constexpr const char* kBold = "\033[1m";
+constexpr const char* kReset = "\033[0m";
+
+// Extract the string value of "key" from a flat JSON object; empty if absent.
+std::string JsonField(const std::string& json, const std::string& key) {
+  const std::string needle = "\"" + key + "\":";
+  size_t pos = json.find(needle);
+  if (pos == std::string::npos) return "";
+  pos += needle.size();
+  while (pos < json.size() && json[pos] == ' ') ++pos;
+  if (pos >= json.size()) return "";
+  if (json[pos] == '"') {
+    std::string out;
+    for (++pos; pos < json.size() && json[pos] != '"'; ++pos) {
+      if (json[pos] == '\\' && pos + 1 < json.size()) ++pos;
+      out += json[pos];
+    }
+    return out;
+  }
+  size_t end = json.find_first_of(",}", pos);
+  return json.substr(pos, end == std::string::npos ? end : end - pos);
+}
+
+void PrintSuite(std::string* current, const std::string& next) {
+  if (*current == next) return;
+  *current = next;
+  std::string sep(10 * 3, '\0');
+  // "─" is 3 UTF-8 bytes; build the separator explicitly.
+  std::string bar;
+  for (int i = 0; i < 10; ++i) bar += "─";
+  std::printf("\n%s%s %s%s %s%s\n", kGray, bar.c_str(), kBold, next.c_str(),
+              bar.c_str(), kReset);
+}
+
+void PrintStep(bool ok, const std::string& message, const std::string& details) {
+  const char* color = ok ? kGreen : kRed;
+  const char* symbol = ok ? "✓" : "✗";
+  if (details.empty()) {
+    std::printf("  %s%s%s %s\n", color, symbol, kReset, message.c_str());
+  } else {
+    std::printf("  %s%s%s %s %s(%s)%s\n", color, symbol, kReset,
+                message.c_str(), kGray, details.c_str(), kReset);
+  }
+}
+
+}  // namespace
+
+int main() {
+  std::string line;
+  std::string suite;
+  std::map<std::string, int> pending;  // method → in-flight count
+
+  while (std::getline(std::cin, line)) {
+    const size_t start = line.find('{');
+    if (start == std::string::npos) {
+      std::printf("%s\n", line.c_str());
+      continue;
+    }
+    const std::string json = line.substr(start);
+    const std::string msg = JsonField(json, "msg");
+    const std::string method = JsonField(json, "method");
+
+    if (msg == "server starting") {
+      PrintSuite(&suite, "SETUP");
+      PrintStep(true, "Server Listening", "addr=" + JsonField(json, "address"));
+    } else if (msg == "gRPC call received") {
+      PrintSuite(&suite, "CONNECTION");
+      PrintStep(true, "gRPC Connection", method);
+      PrintSuite(&suite, "EXECUTION");
+      pending[method] += 1;
+      std::printf("  ○ %s%s%s\n", kGray, method.c_str(), kReset);
+    } else if (msg == "gRPC call finished") {
+      if (pending[method] <= 0) {
+        std::printf("%s\n", line.c_str());  // unmatched: pass through
+        continue;
+      }
+      pending[method] -= 1;
+      const std::string code = JsonField(json, "code");
+      PrintStep(code == "OK", method, JsonField(json, "duration"));
+    } else if (msg == "server shutting down" || msg == "server stopped") {
+      PrintSuite(&suite, "SHUTDOWN");
+      PrintStep(true, msg, "");
+    }
+  }
+  return 0;
+}

@@ -1,0 +1,6 @@
+"""Serving engine: scheduler, paged KV, sampling, streaming, speculation.
+
+The TPU-native replacement for the reference's mock backend — the hot loop
+that SURVEY.md §3.2 says mounts at the Service seam: requests enqueue into a
+continuous-batching scheduler, and the decode step loop runs on-device.
+"""

@@ -1,0 +1,61 @@
+"""Token sampling: greedy, temperature, top-k, top-p.
+
+jit-friendly by construction: the sampling configuration is static (baked at
+trace time via SamplingParams), shapes never depend on data, and top-p uses a
+sort + cumulative-sum mask rather than dynamic truncation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    """Static sampling configuration (hashable → usable as a jit static arg)."""
+
+    temperature: float = 0.0   # 0 → greedy
+    top_k: int = 0             # 0 → disabled
+    top_p: float = 1.0         # 1.0 → disabled
+    max_new_tokens: int = 128
+
+    @property
+    def is_greedy(self) -> bool:
+        return self.temperature == 0.0
+
+
+def _apply_top_k(logits: jax.Array, k: int) -> jax.Array:
+    vals, _ = jax.lax.top_k(logits, k)
+    threshold = vals[..., -1:]
+    return jnp.where(logits < threshold, -jnp.inf, logits)
+
+
+def _apply_top_p(logits: jax.Array, p: float) -> jax.Array:
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cumulative = jnp.cumsum(probs, axis=-1)
+    # Keep the smallest prefix with cumulative mass >= p (always >= 1 token).
+    cutoff_mask = cumulative - probs < p
+    threshold = jnp.min(
+        jnp.where(cutoff_mask, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits < threshold, -jnp.inf, logits)
+
+
+def sample(
+    logits: jax.Array,            # [..., vocab] fp32
+    key: jax.Array,
+    params: SamplingParams,
+) -> jax.Array:
+    """Sample token ids [...] from logits under the static params."""
+    if params.is_greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / params.temperature
+    if params.top_k > 0:
+        logits = _apply_top_k(logits, params.top_k)
+    if params.top_p < 1.0:
+        logits = _apply_top_p(logits, params.top_p)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)

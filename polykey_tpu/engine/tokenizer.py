@@ -1,0 +1,85 @@
+"""Tokenizers for the serving engine.
+
+Two implementations behind one protocol:
+
+- ByteTokenizer — self-contained UTF-8 byte-level tokenizer (PAD/BOS/EOS +
+  256 byte ids). The engine's default: needs no external vocab files, so the
+  whole stack runs hermetically (the same zero-external-dependency discipline
+  as the reference's mock backend, SURVEY.md §4).
+- HFTokenizer — adapter over a local `transformers` tokenizer directory for
+  serving real checkpoints (Llama-3 / Mixtral / Gemma vocab files). Loaded
+  lazily; never fetches from the network.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, Sequence
+
+
+class Tokenizer(Protocol):
+    bos_id: int
+    eos_id: int
+    pad_id: int
+
+    def encode(self, text: str) -> list[int]: ...
+    def decode(self, ids: Sequence[int]) -> str: ...
+
+
+class ByteTokenizer:
+    """UTF-8 bytes with 3 specials. Vocab: 0=PAD, 1=BOS, 2=EOS, 3+b=byte b."""
+
+    pad_id = 0
+    bos_id = 1
+    eos_id = 2
+    vocab_size = 259
+
+    def encode(self, text: str) -> list[int]:
+        return [self.bos_id] + [3 + b for b in text.encode("utf-8")]
+
+    def decode(self, ids: Sequence[int]) -> str:
+        # Ids outside the byte range (specials below, or a model vocab larger
+        # than 259 sampling unmapped ids) are skipped rather than crashing.
+        data = bytes(i - 3 for i in ids if 3 <= i < 259)
+        return data.decode("utf-8", errors="replace")
+
+    def decode_incremental(self, ids: Sequence[int], state: bytes = b"") -> tuple[str, bytes]:
+        """Streaming decode: returns (complete text, undecoded byte tail).
+
+        UTF-8 sequences can split across token boundaries; the tail carries
+        incomplete sequences into the next call so streamed chunks never
+        contain replacement characters mid-character.
+        """
+        data = state + bytes(i - 3 for i in ids if 3 <= i < 259)
+        # Find the longest decodable prefix (max 3 trailing continuation bytes).
+        for cut in range(len(data), max(len(data) - 4, -1), -1):
+            try:
+                return data[:cut].decode("utf-8"), data[cut:]
+            except UnicodeDecodeError:
+                continue
+        return "", data
+
+
+class HFTokenizer:
+    """Local HuggingFace tokenizer adapter (no network access)."""
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer  # lazy; heavy import
+
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        self.bos_id = self._tok.bos_token_id or 0
+        self.eos_id = self._tok.eos_token_id or 0
+        self.pad_id = self._tok.pad_token_id or self.eos_id
+        self.vocab_size = len(self._tok)
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+
+def load_tokenizer(spec: str) -> Tokenizer:
+    """'byte' → ByteTokenizer; anything else is a local HF tokenizer path."""
+    if spec == "byte":
+        return ByteTokenizer()
+    return HFTokenizer(spec)

@@ -189,6 +189,9 @@ def beautify_server_stream(
             out.write(f"  ○ {GRAY}{method}{RESET}\n")
         elif msg == "gRPC call finished":
             if pending.get(method, 0) <= 0:
+                # No matched "received" (e.g. attached mid-stream): pass the
+                # raw line through rather than dropping the RPC's outcome.
+                out.write(raw + "\n")
                 continue
             pending[method] -= 1
             code = entry.get("code", "OK")

@@ -153,7 +153,12 @@ class ConfigLoader:
         if args.server:
             config.server_address = args.server
         if args.timeout is not None:
-            config.timeout = parse_duration(args.timeout)
+            try:
+                config.timeout = parse_duration(args.timeout)
+            except ValueError as e:
+                # Go's flag.DurationVar exits with a usage message on a bad
+                # value; a raw traceback here would be the un-parity.
+                raise SystemExit(f"invalid value for -timeout: {e}")
         if args.log_level is not None:
             config.log_level = args.log_level
         if args.env is not None:
@@ -193,6 +198,7 @@ class NetworkTester:
         host, _, port = address.rpartition(":")
         if not host:
             raise ValueError(f"address missing port: {address!r}")
+        host = host.strip("[]")  # bracketed IPv6 literals ([::1]:50051)
         try:
             with socket.create_connection((host, int(port)), timeout=timeout):
                 pass

@@ -1,0 +1,13 @@
+"""Model families served by the engine.
+
+The reference has no model code at all (SURVEY.md: the only backend is
+internal/service/mock.go); these families come from the north-star serving
+configs (BASELINE.json): Llama-3, Mixtral 8x7B (MoE), Gemma-2.
+
+All models are functional JAX: parameters are plain pytrees (dicts of
+arrays with layers stacked on a leading axis for `lax.scan`), forward passes
+are pure functions, and sharding is applied externally via
+`polykey_tpu.parallel` partition specs.
+"""
+
+from .config import MODEL_REGISTRY, ModelConfig, get_config  # noqa: F401

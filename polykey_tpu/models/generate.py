@@ -1,0 +1,110 @@
+"""Batch generation over the contiguous-cache path.
+
+The simple serving loop (measurement config 2 in BASELINE.json: single-chip
+greedy decode): jitted prefill writes the prompt into the cache and samples
+the first token; a `lax.scan` decode loop generates the rest. Fixed shapes
+throughout — (batch, max_len) are compile-time constants, per-row prompt
+lengths arrive as data.
+
+The continuous-batching engine (engine/engine.py) supersedes this for
+serving; this path remains for tests, offline eval, and the bench harness.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..engine.sampling import SamplingParams, sample
+from .config import ModelConfig
+from .transformer import KVCache, forward, init_cache, unembed
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def prefill(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jax.Array,       # [B, T] right-padded prompts
+    seq_lens: jax.Array,     # [B] true prompt lengths
+    cache: KVCache,
+) -> tuple[jax.Array, KVCache]:
+    """Write prompts into the cache; return fp32 logits at each row's last
+    real token ([B, vocab]) and the updated cache."""
+    B, T = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    hidden, cache = forward(params, cfg, tokens, positions, cache)
+    last = hidden[jnp.arange(B), seq_lens - 1]           # [B, H]
+    return unembed(params, cfg, last), cache
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def decode_step(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jax.Array,       # [B] last sampled token per row
+    positions: jax.Array,    # [B] absolute position being generated
+    cache: KVCache,
+) -> tuple[jax.Array, KVCache]:
+    """One decode step; returns fp32 logits [B, vocab] + updated cache."""
+    hidden, cache = forward(
+        params, cfg, tokens[:, None], positions[:, None], cache
+    )
+    return unembed(params, cfg, hidden[:, 0]), cache
+
+
+@partial(jax.jit, static_argnames=("cfg", "sampling", "max_len"))
+def generate(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jax.Array,       # [B, T] right-padded prompts
+    seq_lens: jax.Array,     # [B]
+    key: jax.Array,
+    sampling: SamplingParams,
+    max_len: int,
+    eos_id: int = -1,        # -1 → never stops early
+) -> tuple[jax.Array, jax.Array]:
+    """Generate sampling.max_new_tokens per row.
+
+    Returns (generated [B, max_new_tokens] int32, num_generated [B]).
+    Rows that hit eos_id keep emitting pad-like eos tokens (shapes are
+    static); num_generated counts tokens up to and including eos.
+    """
+    B, T = tokens.shape
+    if T + sampling.max_new_tokens > max_len:
+        raise ValueError(
+            f"cache too small: prompt window {T} + max_new_tokens "
+            f"{sampling.max_new_tokens} exceeds max_len {max_len} "
+            "(out-of-range cache writes would be silently dropped)"
+        )
+    cache = init_cache(cfg, B, max_len, params["embed"].dtype)
+
+    logits, cache = prefill(params, cfg, tokens, seq_lens, cache)
+    key, k0 = jax.random.split(key)
+    first = sample(logits, k0, sampling)
+
+    def step(carry, _):
+        cache, prev_token, pos, done, key = carry
+        key, k = jax.random.split(key)
+        logits, cache = decode_step(params, cfg, prev_token, pos, cache)
+        token = sample(logits, k, sampling)
+        token = jnp.where(done, eos_id, token)
+        new_done = done | (token == eos_id)
+        return (cache, token, pos + 1, new_done, key), (token, done)
+
+    done0 = first == eos_id
+    (_, _, _, _, _), (rest, was_done) = jax.lax.scan(
+        step,
+        (cache, first, seq_lens, done0, key),
+        None,
+        length=sampling.max_new_tokens - 1,
+    )
+
+    generated = jnp.concatenate([first[None, :], rest], axis=0).T  # [B, N]
+    # Count tokens emitted before each row finished (+1 for the eos itself).
+    alive = jnp.concatenate(
+        [jnp.zeros((1, B), dtype=bool), was_done], axis=0
+    ).T                                                            # [B, N]
+    num_generated = jnp.sum(~alive, axis=1).astype(jnp.int32)
+    return generated, num_generated

@@ -1,0 +1,111 @@
+"""Transformer layer primitives shared across model families.
+
+Functional style: parameters are dict pytrees, every function is pure. All
+linear weights use the [in_features, out_features] convention so matmuls are
+plain `x @ w` and shard naturally under Megatron-style TP partition specs
+(parallel/sharding.py). Layers are stacked on a leading axis and driven by
+`lax.scan` in the family forward functions — one compiled block regardless of
+depth, and a natural unit for pipeline-stage sharding.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models.config import ModelConfig
+
+
+def rms_norm(
+    x: jax.Array, weight: jax.Array, eps: float, offset: float = 0.0
+) -> jax.Array:
+    """RMSNorm with fp32 accumulation. Gemma stores weights as (1 + w), which
+    callers express via offset=1.0."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    normed = xf * jax.lax.rsqrt(var + eps)
+    return (normed * (offset + weight.astype(jnp.float32))).astype(x.dtype)
+
+
+def rope(
+    x: jax.Array,                # [B, T, H, D]
+    positions: jax.Array,        # [B, T]
+    theta: float,
+) -> jax.Array:
+    """Rotary position embedding, half-split (rotate-half) convention."""
+    half = x.shape[-1] // 2
+    freqs = theta ** (
+        -jnp.arange(0, half, dtype=jnp.float32) / half
+    )                                                    # [half]
+    angles = positions[:, :, None].astype(jnp.float32) * freqs  # [B, T, half]
+    cos = jnp.cos(angles)[:, :, None, :]                 # [B, T, 1, half]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    ).astype(x.dtype)
+
+
+def _activate(x: jax.Array, activation: str) -> jax.Array:
+    if activation == "silu":
+        return jax.nn.silu(x)
+    if activation == "gelu_tanh":
+        return jax.nn.gelu(x, approximate=True)
+    raise ValueError(f"unknown activation {activation!r}")
+
+
+def mlp(p: dict, x: jax.Array, activation: str) -> jax.Array:
+    """Gated MLP (SwiGLU / GeGLU): act(x@gate) * (x@up) @ down."""
+    gate = _activate(x @ p["gate"], activation)
+    return (gate * (x @ p["up"])) @ p["down"]
+
+
+def qkv_project(
+    p: dict, x: jax.Array, cfg: ModelConfig
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    B, T, _ = x.shape
+    q = (x @ p["wq"]).reshape(B, T, cfg.num_heads, cfg.head_dim)
+    k = (x @ p["wk"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    v = (x @ p["wv"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    return q, k, v
+
+
+def init_attention_params(
+    key: jax.Array, cfg: ModelConfig, dtype=jnp.bfloat16
+) -> dict:
+    kq, kk, kv, ko = jax.random.split(key, 4)
+    h, d = cfg.hidden_size, cfg.head_dim
+    scale = h**-0.5
+    return {
+        "wq": jax.random.normal(kq, (h, cfg.num_heads * d), dtype) * scale,
+        "wk": jax.random.normal(kk, (h, cfg.num_kv_heads * d), dtype) * scale,
+        "wv": jax.random.normal(kv, (h, cfg.num_kv_heads * d), dtype) * scale,
+        "wo": jax.random.normal(ko, (cfg.num_heads * d, h), dtype)
+        * (cfg.num_heads * d) ** -0.5,
+    }
+
+
+def init_mlp_params(
+    key: jax.Array, hidden: int, intermediate: int, dtype=jnp.bfloat16
+) -> dict:
+    kg, ku, kd = jax.random.split(key, 3)
+    return {
+        "gate": jax.random.normal(kg, (hidden, intermediate), dtype) * hidden**-0.5,
+        "up": jax.random.normal(ku, (hidden, intermediate), dtype) * hidden**-0.5,
+        "down": jax.random.normal(kd, (intermediate, hidden), dtype)
+        * intermediate**-0.5,
+    }
+
+
+def layer_sliding_window(cfg: ModelConfig, layer_idx: jax.Array) -> Optional[jax.Array]:
+    """Gemma-2 interleaves sliding-window (even) and global (odd) layers.
+
+    Returns a per-layer window size as a traced scalar (or None when the
+    config has no window). Global layers get window = max_seq_len, which is
+    equivalent to no window.
+    """
+    if cfg.sliding_window is None:
+        return None
+    return jnp.where(layer_idx % 2 == 0, cfg.sliding_window, cfg.max_seq_len)

@@ -1,0 +1,34 @@
+"""Llama-3 family façade.
+
+The reference contains no model code (its backend is a mock —
+/root/reference/internal/service/mock.go); Llama-3 is the flagship serving
+family from BASELINE.json configs 2-3. The architecture (GQA, RoPE
+theta=500k, SwiGLU, RMSNorm, untied head for 8B/70B) is implemented by the
+config-driven stack in transformer.py; this module binds the family name to
+its configs and weight loading.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .config import LLAMA3_8B, LLAMA3_70B, LLAMA32_1B, TINY_LLAMA, ModelConfig
+from .transformer import KVCache, forward, init_cache, init_params, unembed
+
+__all__ = [
+    "LLAMA3_8B",
+    "LLAMA3_70B",
+    "LLAMA32_1B",
+    "TINY_LLAMA",
+    "KVCache",
+    "ModelConfig",
+    "forward",
+    "init_cache",
+    "init_params",
+    "unembed",
+    "param_bytes",
+]
+
+
+def param_bytes(cfg: ModelConfig, dtype=jnp.bfloat16) -> int:
+    return cfg.num_params() * jnp.dtype(dtype).itemsize

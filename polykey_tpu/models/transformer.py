@@ -1,0 +1,225 @@
+"""Config-driven transformer forward pass covering the served families.
+
+One implementation handles Llama-3 (GQA + RoPE + SwiGLU), Gemma-2 (post
+norms, logit soft-capping, interleaved sliding-window layers, scaled
+embeddings), and — via the MoE hook — Mixtral. Family façades live in
+llama.py / gemma.py / mixtral.py.
+
+TPU-first design choices:
+- layers stacked on a leading axis, driven by `lax.scan`: one compiled block,
+  natural pipeline-stage unit;
+- static shapes only: right-padded batches, masks computed from absolute
+  positions (never data-dependent shapes);
+- KV cache is a plain pytree carried through scan; slot s always holds the
+  token at absolute position s, so causal masking doubles as garbage-slot
+  masking (see ops/attention.make_attention_mask);
+- bf16 weights/activations, fp32 softmax/norm accumulation, fp32 logits.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..ops.attention import attention
+from .config import ModelConfig
+from .layers import (
+    init_attention_params,
+    init_mlp_params,
+    mlp,
+    qkv_project,
+    rms_norm,
+    rope,
+)
+
+
+@struct.dataclass
+class KVCache:
+    """Contiguous per-layer KV cache: [num_layers, B, S, num_kv_heads, head_dim].
+
+    The simple serving path (fixed-geometry batch, fixed max length). The
+    continuous-batching engine replaces this with the paged cache
+    (engine/kv_cache.py + ops/paged_attention.py).
+    """
+
+    k: jax.Array
+    v: jax.Array
+
+    @property
+    def num_slots(self) -> int:
+        return self.k.shape[2]
+
+
+def init_cache(
+    cfg: ModelConfig, batch: int, max_len: int, dtype=jnp.bfloat16
+) -> KVCache:
+    shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def init_params(key: jax.Array, cfg: ModelConfig, dtype=jnp.bfloat16) -> dict:
+    """Random-init parameter pytree with layers stacked for scan."""
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+
+    def one_layer(k: jax.Array) -> dict:
+        k_attn, k_mlp = jax.random.split(k)
+        layer = {
+            "attn": init_attention_params(k_attn, cfg, dtype),
+            "ln1": jnp.zeros((cfg.hidden_size,), dtype),
+            "ln2": jnp.zeros((cfg.hidden_size,), dtype),
+        }
+        if cfg.is_moe:
+            k_router, k_experts = jax.random.split(k_mlp)
+            layer["router"] = (
+                jax.random.normal(k_router, (cfg.hidden_size, cfg.num_experts), dtype)
+                * cfg.hidden_size**-0.5
+            )
+            layer["experts"] = jax.vmap(
+                lambda kk: init_mlp_params(
+                    kk, cfg.hidden_size, cfg.intermediate_size, dtype
+                )
+            )(jax.random.split(k_experts, cfg.num_experts))
+        else:
+            layer["mlp"] = init_mlp_params(
+                k_mlp, cfg.hidden_size, cfg.intermediate_size, dtype
+            )
+        if cfg.use_post_norms:
+            layer["post_ln1"] = jnp.zeros((cfg.hidden_size,), dtype)
+            layer["post_ln2"] = jnp.zeros((cfg.hidden_size,), dtype)
+        return layer
+
+    layers = jax.vmap(one_layer)(jax.random.split(k_layers, cfg.num_layers))
+
+    params = {
+        "embed": jax.random.normal(
+            k_embed, (cfg.vocab_size, cfg.hidden_size), dtype
+        )
+        * cfg.hidden_size**-0.5,
+        "layers": layers,
+        "final_norm": jnp.zeros((cfg.hidden_size,), dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = (
+            jax.random.normal(k_head, (cfg.hidden_size, cfg.vocab_size), dtype)
+            * cfg.hidden_size**-0.5
+        )
+    return params
+
+
+def _moe_mlp(layer_params: dict, h: jax.Array, cfg: ModelConfig) -> jax.Array:
+    from ..ops.moe import moe_mlp  # deferred: keeps dense path import-light
+
+    return moe_mlp(layer_params, h, cfg)
+
+
+def forward(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jax.Array,               # [B, T] int32, right-padded
+    positions: jax.Array,            # [B, T] absolute positions
+    cache: Optional[KVCache] = None,
+) -> tuple[jax.Array, Optional[KVCache]]:
+    """Run the stack; returns (hidden [B, T, H], updated cache).
+
+    With a cache: new K/V are written at their absolute positions and
+    attention spans all cache slots — prefill and decode share this path.
+    Without a cache (training / one-shot scoring): attention spans the
+    current sequence only.
+    """
+    B, T = tokens.shape
+    use_cache = cache is not None
+    norm_offset = 1.0 if cfg.scale_embeddings else 0.0
+    eps = cfg.rms_norm_eps
+
+    x = params["embed"][tokens]
+    if cfg.scale_embeddings:
+        x = (x.astype(jnp.float32) * cfg.hidden_size**0.5).astype(x.dtype)
+
+    batch_idx = jnp.arange(B, dtype=jnp.int32)[:, None]
+    q_pos = positions[:, :, None]                       # [B, T, 1]
+    if use_cache:
+        kv_pos = jnp.arange(cache.num_slots, dtype=jnp.int32)[None, None, :]
+    else:
+        kv_pos = positions[:, None, :]                  # kv = current tokens
+
+    def body(x, scanned):
+        layer_params, layer_idx, kc, vc = scanned
+
+        h = rms_norm(x, layer_params["ln1"], eps, norm_offset)
+        q, k, v = qkv_project(layer_params["attn"], h, cfg)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+
+        if use_cache:
+            kc = kc.at[batch_idx, positions].set(k)
+            vc = vc.at[batch_idx, positions].set(v)
+            k_slots, v_slots = kc, vc
+        else:
+            k_slots, v_slots = k, v
+
+        mask = kv_pos <= q_pos
+        if cfg.sliding_window is not None:
+            # Gemma-2: even layers sliding-window, odd layers global.
+            window = jnp.where(
+                layer_idx % 2 == 0, cfg.sliding_window, cfg.max_seq_len
+            )
+            mask &= kv_pos > q_pos - window
+
+        attn_out = attention(
+            q, k_slots, v_slots, mask,
+            scale=cfg.q_scale,
+            logit_softcap=cfg.attn_logit_softcap,
+        )
+        attn_out = attn_out.reshape(B, T, cfg.num_heads * cfg.head_dim)
+        attn_out = attn_out @ layer_params["attn"]["wo"]
+        if cfg.use_post_norms:
+            attn_out = rms_norm(attn_out, layer_params["post_ln1"], eps, norm_offset)
+        x = x + attn_out
+
+        h = rms_norm(x, layer_params["ln2"], eps, norm_offset)
+        if cfg.is_moe:
+            mlp_out = _moe_mlp(layer_params, h, cfg)
+        else:
+            mlp_out = mlp(layer_params["mlp"], h, cfg.activation)
+        if cfg.use_post_norms:
+            mlp_out = rms_norm(mlp_out, layer_params["post_ln2"], eps, norm_offset)
+        x = x + mlp_out
+
+        return x, (kc, vc)
+
+    layer_ids = jnp.arange(cfg.num_layers, dtype=jnp.int32)
+    if use_cache:
+        scanned = (params["layers"], layer_ids, cache.k, cache.v)
+    else:
+        empty = jnp.zeros((cfg.num_layers, 0), dtype=x.dtype)
+        scanned = (params["layers"], layer_ids, empty, empty)
+
+    x, (new_k, new_v) = jax.lax.scan(body, x, scanned)
+
+    x = rms_norm(x, params["final_norm"], eps, norm_offset)
+    new_cache = KVCache(k=new_k, v=new_v) if use_cache else None
+    return x, new_cache
+
+
+def unembed(params: dict, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:
+    """Project hidden states to vocab logits (fp32), applying Gemma's final
+    soft-cap. Callers gather the positions they need *before* unembedding —
+    at 128k-256k vocab the [B, T, V] matmul is the expensive part."""
+    if cfg.tie_embeddings:
+        logits = jnp.einsum(
+            "...h,vh->...v", hidden, params["embed"],
+            preferred_element_type=jnp.float32,
+        )
+    else:
+        logits = jnp.einsum(
+            "...h,hv->...v", hidden, params["lm_head"],
+            preferred_element_type=jnp.float32,
+        )
+    if cfg.final_logit_softcap is not None:
+        logits = cfg.final_logit_softcap * jnp.tanh(
+            logits / cfg.final_logit_softcap
+        )
+    return logits

@@ -1,0 +1,8 @@
+"""TPU compute ops: reference jnp implementations + Pallas kernels.
+
+Every op has a pure-jnp reference implementation (runs anywhere, used on CPU
+test meshes and as the correctness oracle) and, where it matters for HBM
+bandwidth, a Pallas TPU kernel (paged attention decode, flash prefill).
+Kernel/bandwidth tradeoffs follow the v5e numbers: MXU wants ≥128-wide tiles,
+bf16 min tile (16, 128), ~16 MB VMEM per core.
+"""

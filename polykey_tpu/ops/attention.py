@@ -1,0 +1,67 @@
+"""Reference attention (pure jnp) — the correctness oracle for the Pallas
+kernels, and the CPU-mesh fallback path.
+
+Supports the features the served families need (models/config.py): GQA
+(num_kv_heads < num_heads), causal masking by absolute position, Gemma-2
+attention-logit soft-capping, and sliding-window masking. Softmax runs in
+fp32 regardless of activation dtype — bf16 softmax loses decode accuracy.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def make_attention_mask(
+    q_positions: jax.Array,       # [B, T] absolute position of each query
+    num_kv_slots: int,            # S — key/value slot count (slot s = pos s)
+    sliding_window: Optional[int] = None,
+) -> jax.Array:
+    """Boolean [B, T, S] mask: True where the query may attend.
+
+    Causality is by absolute position (slot s holds the token at position s),
+    which covers right-padded prefill and single-token decode uniformly:
+    padded/garbage slots beyond the query's position are never visible.
+    """
+    kv_pos = jnp.arange(num_kv_slots, dtype=jnp.int32)[None, None, :]
+    q_pos = q_positions[:, :, None]
+    mask = kv_pos <= q_pos
+    if sliding_window is not None:
+        mask &= kv_pos > q_pos - sliding_window
+    return mask
+
+
+def attention(
+    q: jax.Array,                 # [B, T, num_heads, head_dim]
+    k: jax.Array,                 # [B, S, num_kv_heads, head_dim]
+    v: jax.Array,                 # [B, S, num_kv_heads, head_dim]
+    mask: jax.Array,              # [B, T, S] bool
+    *,
+    scale: float,
+    logit_softcap: Optional[float] = None,
+) -> jax.Array:
+    """Grouped-query attention; returns [B, T, num_heads, head_dim]."""
+    B, T, num_heads, head_dim = q.shape
+    num_kv_heads = k.shape[2]
+    groups = num_heads // num_kv_heads
+
+    qg = q.reshape(B, T, num_kv_heads, groups, head_dim)
+    logits = jnp.einsum(
+        "bthgd,bshd->bhgts", qg, k, preferred_element_type=jnp.float32
+    )
+    logits = logits * scale
+    if logit_softcap is not None:
+        logits = logit_softcap * jnp.tanh(logits / logit_softcap)
+
+    neg = jnp.finfo(jnp.float32).min
+    logits = jnp.where(mask[:, None, None, :, :], logits, neg)
+
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum(
+        "bhgts,bshd->bthgd", probs.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(B, T, num_heads, head_dim).astype(q.dtype)

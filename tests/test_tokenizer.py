@@ -1,0 +1,42 @@
+"""ByteTokenizer tests, including streaming UTF-8 boundary handling."""
+
+from polykey_tpu.engine.tokenizer import ByteTokenizer, load_tokenizer
+
+
+def test_roundtrip():
+    tok = ByteTokenizer()
+    ids = tok.encode("hello, world")
+    assert ids[0] == tok.bos_id
+    assert tok.decode(ids) == "hello, world"
+
+
+def test_unicode_roundtrip():
+    tok = ByteTokenizer()
+    text = "héllo → 世界 🌍"
+    assert tok.decode(tok.encode(text)) == text
+
+
+def test_specials_skipped_in_decode():
+    tok = ByteTokenizer()
+    ids = [tok.bos_id] + tok.encode("hi")[1:] + [tok.eos_id, tok.pad_id]
+    assert tok.decode(ids) == "hi"
+
+
+def test_incremental_decode_splits_multibyte():
+    tok = ByteTokenizer()
+    ids = tok.encode("a→b")[1:]  # strip bos; '→' is 3 bytes
+    # Feed one token at a time; concatenation must equal the full string and
+    # no chunk may contain a replacement character.
+    state = b""
+    out = []
+    for i in ids:
+        chunk, state = tok.decode_incremental([i], state)
+        assert "�" not in chunk
+        out.append(chunk)
+    assert "".join(out) == "a→b"
+    assert state == b""
+
+
+def test_load_tokenizer_byte():
+    tok = load_tokenizer("byte")
+    assert isinstance(tok, ByteTokenizer)
