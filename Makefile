@@ -28,11 +28,15 @@ test-report: ## Tests with the Jest-style report renderer
 	    from polykey_tpu.gateway.beautify import print_jest_report; \
 	    print_jest_report(open('/tmp/pytest-report.jsonl'))"
 
-native: $(BUILD_DIR)/log-beautifier ## Build native C++ components
+native: $(BUILD_DIR)/log-beautifier $(BUILD_DIR)/libblock_allocator.so ## Build native C++ components
 
 $(BUILD_DIR)/log-beautifier: native/log_beautifier.cc
 	@mkdir -p $(BUILD_DIR)
 	$(CXX) $(CXXFLAGS) -o $@ $<
+
+$(BUILD_DIR)/libblock_allocator.so: native/block_allocator.cc
+	@mkdir -p $(BUILD_DIR)
+	$(CXX) $(CXXFLAGS) -shared -fPIC -o $@ $<
 
 protos: ## Regenerate protobuf stubs from protos/
 	./scripts/gen_protos.sh

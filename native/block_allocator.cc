@@ -1,0 +1,84 @@
+// Paged-KV block allocator — the native bookkeeping core of the serving
+// engine's memory manager (engine/kv_cache.py wraps this via ctypes, with a
+// pure-Python fallback of identical behavior).
+//
+// The reference delegates all resource management to its platform (SURVEY.md
+// §5 "failure detection": Docker restart policies); the paged-KV design has
+// no reference analog — it comes from the north star's "Pallas paged-KV
+// decoder" requirement. Pages are fixed-size KV slabs; sequences own ordered
+// page lists; refcounts support copy-on-write prefix sharing (speculative
+// decode forks, common-prefix batching).
+//
+// Page 0 is reserved as the garbage page: inactive decode slots point their
+// page tables at it so masked-out lanes have a safe write target.
+//
+// Build: make native  (→ build/libblock_allocator.so)
+
+#include <cstdint>
+#include <vector>
+
+namespace {
+
+struct Allocator {
+  int32_t num_pages = 0;
+  std::vector<int32_t> free_list;   // LIFO of free page ids
+  std::vector<int32_t> refcount;    // per page; 0 = free
+};
+
+}  // namespace
+
+extern "C" {
+
+// Create an allocator over `num_pages` pages. Page 0 is reserved (never
+// handed out). Returns an opaque handle.
+void* pk_allocator_new(int32_t num_pages) {
+  auto* a = new Allocator();
+  a->num_pages = num_pages;
+  a->refcount.assign(num_pages, 0);
+  a->free_list.reserve(num_pages);
+  // LIFO: push descending so low page ids are handed out first (stable
+  // layouts help debugging and keep hot pages dense).
+  for (int32_t p = num_pages - 1; p >= 1; --p) a->free_list.push_back(p);
+  if (num_pages > 0) a->refcount[0] = 1;  // garbage page, permanently held
+  return a;
+}
+
+void pk_allocator_free(void* handle) { delete static_cast<Allocator*>(handle); }
+
+int32_t pk_num_free(void* handle) {
+  return static_cast<int32_t>(static_cast<Allocator*>(handle)->free_list.size());
+}
+
+// Allocate `count` pages into `out`. All-or-nothing: returns 1 on success,
+// 0 (no pages written) if fewer than `count` are free.
+int32_t pk_alloc(void* handle, int32_t count, int32_t* out) {
+  auto* a = static_cast<Allocator*>(handle);
+  if (static_cast<int32_t>(a->free_list.size()) < count) return 0;
+  for (int32_t i = 0; i < count; ++i) {
+    int32_t page = a->free_list.back();
+    a->free_list.pop_back();
+    a->refcount[page] = 1;
+    out[i] = page;
+  }
+  return 1;
+}
+
+// Increment refcount (prefix sharing). Returns new refcount, or -1 on a free
+// or out-of-range page.
+int32_t pk_retain(void* handle, int32_t page) {
+  auto* a = static_cast<Allocator*>(handle);
+  if (page <= 0 || page >= a->num_pages || a->refcount[page] == 0) return -1;
+  return ++a->refcount[page];
+}
+
+// Decrement refcount; page returns to the free list at zero. Returns the new
+// refcount, or -1 on a double-free / out-of-range / garbage page.
+int32_t pk_release(void* handle, int32_t page) {
+  auto* a = static_cast<Allocator*>(handle);
+  if (page <= 0 || page >= a->num_pages || a->refcount[page] == 0) return -1;
+  int32_t rc = --a->refcount[page];
+  if (rc == 0) a->free_list.push_back(page);
+  return rc;
+}
+
+}  // extern "C"

@@ -1,0 +1,101 @@
+"""Engine configuration, layered on the gateway's precedence discipline.
+
+Extends the reference's config model (internal/config/config.go: defaults <
+flags < env) with the serving-engine settings the north star needs: model
+selection, decode-batch geometry, KV page pool, prefill buckets, parallelism
+axes. Env vars use the same POLYKEY_* prefix.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    model: str = "tiny-llama"
+    tokenizer: str = "byte"              # 'byte' or a local HF tokenizer path
+    dtype: str = "bfloat16"
+    checkpoint_path: Optional[str] = None  # None → random init (dev/bench)
+
+    # Decode-batch geometry (static shapes; compile-time constants).
+    max_decode_slots: int = 8
+    page_size: int = 16
+    num_pages: int = 512                 # includes reserved garbage page 0
+    max_seq_len: int = 256               # per-request position cap
+    prefill_buckets: tuple[int, ...] = (32, 64, 128)
+    max_new_tokens_cap: int = 128
+    default_max_new_tokens: int = 64
+
+    # Parallelism axes (parallel/mesh.py); 1 → axis unused.
+    tp: int = 1
+    dp: int = 1
+
+    # Liveness. The watchdog window must comfortably exceed worst-case XLA
+    # compile time (each new prefill bucket compiles on first use).
+    watchdog_timeout_s: float = 300.0
+    request_timeout_s: float = 300.0
+
+    @property
+    def pages_per_seq(self) -> int:
+        return self.max_seq_len // self.page_size
+
+    @classmethod
+    def from_env(cls) -> "EngineConfig":
+        buckets = os.environ.get("POLYKEY_PREFILL_BUCKETS")
+        return cls(
+            model=os.environ.get("POLYKEY_MODEL", cls.model),
+            tokenizer=os.environ.get("POLYKEY_TOKENIZER", cls.tokenizer),
+            dtype=os.environ.get("POLYKEY_DTYPE", cls.dtype),
+            checkpoint_path=os.environ.get("POLYKEY_CHECKPOINT") or None,
+            max_decode_slots=_env_int("POLYKEY_MAX_DECODE_SLOTS", cls.max_decode_slots),
+            page_size=_env_int("POLYKEY_PAGE_SIZE", cls.page_size),
+            num_pages=_env_int("POLYKEY_NUM_PAGES", cls.num_pages),
+            max_seq_len=_env_int("POLYKEY_MAX_SEQ_LEN", cls.max_seq_len),
+            prefill_buckets=tuple(
+                int(x) for x in buckets.split(",")
+            ) if buckets else cls.prefill_buckets,
+            max_new_tokens_cap=_env_int(
+                "POLYKEY_MAX_NEW_TOKENS_CAP", cls.max_new_tokens_cap
+            ),
+            default_max_new_tokens=_env_int(
+                "POLYKEY_DEFAULT_MAX_NEW_TOKENS", cls.default_max_new_tokens
+            ),
+            tp=_env_int("POLYKEY_TP", cls.tp),
+            dp=_env_int("POLYKEY_DP", cls.dp),
+            watchdog_timeout_s=_env_float(
+                "POLYKEY_WATCHDOG_TIMEOUT", cls.watchdog_timeout_s
+            ),
+            request_timeout_s=_env_float(
+                "POLYKEY_REQUEST_TIMEOUT", cls.request_timeout_s
+            ),
+        )
+
+    def validate(self) -> None:
+        if self.max_seq_len % self.page_size != 0:
+            raise ValueError("max_seq_len must be a multiple of page_size")
+        if self.num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is reserved)")
+        for b in self.prefill_buckets:
+            if b > self.max_seq_len:
+                raise ValueError(
+                    f"prefill bucket {b} exceeds max_seq_len {self.max_seq_len}"
+                )
+        if not self.prefill_buckets:
+            raise ValueError("need at least one prefill bucket")

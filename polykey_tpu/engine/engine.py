@@ -1,0 +1,421 @@
+"""The inference engine: continuous batching over a paged KV cache.
+
+This is the TPU-native replacement for the reference's mock backend — the
+component the north star mounts at the Service seam (SURVEY.md §3.2: "the
+handler keeps its signature; the implementation becomes enqueue-into-
+scheduler, and the hot loop becomes the decode step loop on-device").
+
+Design:
+
+- One engine thread owns all device state (page pools, page tables, slot
+  arrays). gRPC handler threads only enqueue GenRequests and read from
+  per-request queues — no device access, no locks around jax calls.
+- Static shapes everywhere: the decode batch is a fixed array of
+  `max_decode_slots` slots; prompts prefill through a small set of padded
+  length buckets. Slot occupancy is data (`active` mask), not shape.
+- Step loop: admit (prefill one request per free slot) → decode one step for
+  all active slots → deliver tokens → retire finished slots. Prefills
+  interleave between decode steps, so running streams stall for at most one
+  prefill bucket.
+- Inactive slots point their page tables at the reserved garbage page 0 and
+  carry position 0; their lanes compute masked garbage that is never read.
+- Page pools are donated through every jitted step (in-place update — the
+  pool is by far the largest buffer).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.config import ModelConfig, get_config
+from ..models.transformer import forward_paged, init_params, unembed
+from .config import EngineConfig
+from .kv_cache import AllocationError, BlockAllocator, PagedKV, init_paged_kv
+from .metrics import EngineMetrics, RequestTimings
+from .sampling import sample_dynamic
+from .tokenizer import ByteTokenizer, load_tokenizer
+
+
+@dataclass
+class GenRequest:
+    """One generation request, enqueued by a gRPC handler thread.
+
+    The engine pushes ("token", id), then ("done", RequestTimings) or
+    ("error", message) into `out`.
+    """
+
+    prompt: str
+    max_new_tokens: int = 64
+    temperature: float = 0.0
+    top_p: float = 1.0
+    out: queue.Queue = field(default_factory=queue.Queue)
+    cancelled: threading.Event = field(default_factory=threading.Event)
+    timings: RequestTimings = field(default_factory=RequestTimings)
+
+
+@dataclass
+class _Slot:
+    request: GenRequest
+    pages: list[int]
+    generated: int = 0
+    position_cap: int = 0      # absolute position limit for this request
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("paged",))
+def _prefill_step(
+    params, cfg: ModelConfig, paged: PagedKV,
+    tokens, seq_len, page_table, key, temperature, top_p,
+):
+    """Prefill one request (tokens [1, T_bucket]) and sample its first token."""
+    T = tokens.shape[1]
+    positions = jnp.arange(T, dtype=jnp.int32)[None, :]
+    hidden, paged = forward_paged(params, cfg, tokens, positions, paged, page_table)
+    last = hidden[0, seq_len[0] - 1][None]                 # [1, H]
+    logits = unembed(params, cfg, last)                    # [1, V]
+    token = sample_dynamic(logits, key, temperature, top_p)
+    return token[0], paged
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("paged",))
+def _decode_step(
+    params, cfg: ModelConfig, paged: PagedKV,
+    last_tokens, seq_lens, page_tables, active, key, temperature, top_p,
+):
+    """One decode step for the whole slot batch.
+
+    seq_lens counts tokens including `last_tokens` (sampled but not yet in
+    cache); the step writes their KV at position seq_lens-1 and samples the
+    next token for every active slot.
+    """
+    positions = jnp.maximum(seq_lens - 1, 0)[:, None]      # [B, 1]
+    hidden, paged = forward_paged(
+        params, cfg, last_tokens[:, None], positions, paged, page_tables
+    )
+    logits = unembed(params, cfg, hidden[:, 0])            # [B, V]
+    tokens = sample_dynamic(logits, key, temperature, top_p)
+    tokens = jnp.where(active, tokens, 0)
+    return tokens, paged
+
+
+class EngineDeadError(RuntimeError):
+    pass
+
+
+class InferenceEngine:
+    def __init__(
+        self,
+        config: EngineConfig,
+        params: Optional[dict] = None,
+        health=None,
+        logger=None,
+        seed: int = 0,
+    ):
+        config.validate()
+        self.config = config
+        self.model_cfg = get_config(config.model)
+        self.tokenizer = load_tokenizer(config.tokenizer)
+        self.metrics = EngineMetrics()
+        self.health = health
+        self.logger = logger
+        self._dtype = jnp.dtype(config.dtype)
+
+        if params is None:
+            # Random init — the dev/bench path; checkpoint loading comes via
+            # models.loader (POLYKEY_CHECKPOINT) when weights exist locally.
+            params = init_params(
+                jax.random.PRNGKey(seed), self.model_cfg, self._dtype
+            )
+            if config.checkpoint_path:
+                from ..models.loader import load_checkpoint
+
+                params = load_checkpoint(
+                    config.checkpoint_path, self.model_cfg, self._dtype
+                )
+        self.params = params
+
+        B, P = config.max_decode_slots, config.pages_per_seq
+        self.paged = init_paged_kv(
+            self.model_cfg, config.num_pages, config.page_size, self._dtype
+        )
+        self.allocator = BlockAllocator(config.num_pages)
+
+        # Host mirrors of per-slot device state (engine thread only).
+        self._page_tables = np.zeros((B, P), dtype=np.int32)
+        self._seq_lens = np.zeros((B,), dtype=np.int32)
+        self._last_tokens = np.zeros((B,), dtype=np.int32)
+        self._active = np.zeros((B,), dtype=bool)
+        self._temperature = np.zeros((B,), dtype=np.float32)
+        self._top_p = np.ones((B,), dtype=np.float32)
+        self._slots: list[Optional[_Slot]] = [None] * B
+
+        self._key = jax.random.PRNGKey(seed + 1)
+        self._submit: queue.Queue[GenRequest] = queue.Queue()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self.dead: Optional[str] = None
+        self.last_progress = time.monotonic()
+
+        self._thread = threading.Thread(
+            target=self._run, name="polykey-engine", daemon=True
+        )
+        self._thread.start()
+
+    # -- public API (any thread) -------------------------------------------
+
+    def submit(self, request: GenRequest) -> None:
+        if self.dead is not None:
+            raise EngineDeadError(self.dead)
+        if self._stop.is_set():
+            raise EngineDeadError("engine is shut down")
+        self.metrics.on_admit()
+        self._submit.put(request)
+        self._wake.set()
+
+    def stats(self) -> dict:
+        snap = self.metrics.snapshot()
+        snap.update(
+            {
+                "model": self.model_cfg.name,
+                "slots_busy": int(self._active.sum()),
+                "slots_total": self.config.max_decode_slots,
+                "pages_free": self.allocator.num_free,
+                "pages_total": self.config.num_pages,
+                "queued": self._submit.qsize(),
+            }
+        )
+        return snap
+
+    @property
+    def busy(self) -> bool:
+        return bool(self._active.any()) or not self._submit.empty()
+
+    def shutdown(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=timeout)
+
+    # -- engine thread ------------------------------------------------------
+
+    def _run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                if self.dead is not None:  # watchdog tripped while we were out
+                    self._fail_all(self.dead)
+                    return
+                # While streams are decoding, admit at most one prefill per
+                # step so running streams stall for ≤ one prefill bucket.
+                limit = 1 if self._active.any() else None
+                worked = self._admit(limit)
+                if self._active.any():
+                    self._step()
+                    worked = True
+                if worked:
+                    self.last_progress = time.monotonic()
+                else:
+                    self._wake.wait(timeout=0.05)
+                    self._wake.clear()
+            self._fail_all("engine is shut down")
+        except Exception as e:  # engine thread must never die silently
+            self.dead = f"engine loop crashed: {e}"
+            if self.logger is not None:
+                self.logger.error(
+                    "engine loop crashed",
+                    error=str(e),
+                    traceback=traceback.format_exc(),
+                )
+            self._fail_all(self.dead)
+            if self.health is not None:
+                self.health.shutdown()
+
+    def _bucket_for(self, length: int) -> Optional[int]:
+        for b in self.config.prefill_buckets:
+            if length <= b:
+                return b
+        return None
+
+    def _admit(self, limit: Optional[int] = None) -> bool:
+        admitted = False
+        count = 0
+        while limit is None or count < limit:
+            free_slots = [i for i, s in enumerate(self._slots) if s is None]
+            if not free_slots:
+                return admitted
+            try:
+                request = self._submit.get_nowait()
+            except queue.Empty:
+                return admitted
+            if request.cancelled.is_set():
+                continue
+            try:
+                self._start_request(free_slots[0], request)
+                admitted = True
+                count += 1
+            except AllocationError:
+                # Pool exhausted: put it back and let running requests
+                # finish. FIFO fairness over throughput.
+                self._requeue_front(request)
+                return admitted
+            except Exception as e:
+                request.out.put(("error", f"admission failed: {e}"))
+                self.metrics.on_finish(request.timings, failed=True)
+        return admitted
+
+    def _requeue_front(self, request: GenRequest) -> None:
+        # queue.Queue has no push-front; rebuild (small queues, rare path).
+        items = [request]
+        try:
+            while True:
+                items.append(self._submit.get_nowait())
+        except queue.Empty:
+            pass
+        for item in items:
+            self._submit.put(item)
+
+    def _start_request(self, slot_idx: int, request: GenRequest) -> None:
+        cfg = self.config
+        request.timings.prefill_start = time.monotonic()
+
+        prompt_ids = self.tokenizer.encode(request.prompt)
+        max_new = max(
+            1,
+            min(request.max_new_tokens, cfg.max_new_tokens_cap,
+                cfg.max_seq_len - 1),
+        )
+        # Leave room for generation within the per-request position cap
+        # (max_new ≤ max_seq_len-1 guarantees max_prompt ≥ 1, so the
+        # tail-truncation slice below can never be [-0:]).
+        max_prompt = min(max(cfg.prefill_buckets), cfg.max_seq_len - max_new)
+        if len(prompt_ids) > max_prompt:
+            prompt_ids = prompt_ids[-max_prompt:]  # keep the prompt tail
+        prompt_len = len(prompt_ids)
+        request.timings.prompt_tokens = prompt_len
+
+        bucket = self._bucket_for(prompt_len)
+        assert bucket is not None  # max_prompt <= max bucket
+
+        total_len = prompt_len + max_new
+        num_pages = -(-total_len // cfg.page_size)  # ceil
+        pages = self.allocator.alloc(num_pages)     # may raise AllocationError
+
+        page_table = np.zeros((1, cfg.pages_per_seq), dtype=np.int32)
+        page_table[0, : len(pages)] = pages
+
+        tokens = np.zeros((1, bucket), dtype=np.int32)
+        tokens[0, :prompt_len] = prompt_ids
+
+        self._key, key = jax.random.split(self._key)
+        first_token, self.paged = _prefill_step(
+            self.params,
+            self.model_cfg,
+            self.paged,
+            jnp.asarray(tokens),
+            jnp.asarray([prompt_len], dtype=jnp.int32),
+            jnp.asarray(page_table),
+            key,
+            jnp.asarray([request.temperature], dtype=jnp.float32),
+            jnp.asarray([request.top_p], dtype=jnp.float32),
+        )
+        first_token = int(first_token)
+
+        slot = _Slot(request=request, pages=pages, generated=1,
+                     position_cap=total_len)
+        self._slots[slot_idx] = slot
+        self._page_tables[slot_idx] = page_table[0]
+        self._seq_lens[slot_idx] = prompt_len + 1  # prompt + sampled token
+        self._last_tokens[slot_idx] = first_token
+        self._active[slot_idx] = True
+        self._temperature[slot_idx] = request.temperature
+        self._top_p[slot_idx] = request.top_p
+
+        request.timings.first_token = time.monotonic()
+        request.out.put(("token", first_token))
+        self._maybe_finish(slot_idx, first_token)
+
+    def _step(self) -> None:
+        self._key, key = jax.random.split(self._key)
+        tokens, self.paged = _decode_step(
+            self.params,
+            self.model_cfg,
+            self.paged,
+            jnp.asarray(self._last_tokens),
+            jnp.asarray(self._seq_lens),
+            jnp.asarray(self._page_tables),
+            jnp.asarray(self._active),
+            key,
+            jnp.asarray(self._temperature),
+            jnp.asarray(self._top_p),
+        )
+        tokens = np.asarray(tokens)  # blocks until the step completes
+
+        emitted = 0
+        for i, slot in enumerate(self._slots):
+            if slot is None or not self._active[i]:
+                continue
+            if slot.request.cancelled.is_set():
+                self._finish(i, error="cancelled")
+                continue
+            token = int(tokens[i])
+            slot.generated += 1
+            self._seq_lens[i] += 1
+            self._last_tokens[i] = token
+            slot.request.out.put(("token", token))
+            emitted += 1
+            self._maybe_finish(i, token)
+        self.metrics.on_step(emitted)
+
+    def _maybe_finish(self, slot_idx: int, token: int) -> None:
+        slot = self._slots[slot_idx]
+        assert slot is not None
+        request = slot.request
+        hit_eos = token == self.tokenizer.eos_id
+        hit_cap = (
+            slot.generated >= request.max_new_tokens
+            or slot.generated >= self.config.max_new_tokens_cap
+            or int(self._seq_lens[slot_idx]) >= slot.position_cap
+        )
+        if hit_eos or hit_cap:
+            self._finish(slot_idx)
+
+    def _finish(self, slot_idx: int, error: Optional[str] = None) -> None:
+        slot = self._slots[slot_idx]
+        if slot is None:
+            return
+        request = slot.request
+        request.timings.finished = time.monotonic()
+        request.timings.completion_tokens = slot.generated
+        self.allocator.release_all(slot.pages)
+        self._slots[slot_idx] = None
+        self._active[slot_idx] = False
+        self._seq_lens[slot_idx] = 0
+        self._last_tokens[slot_idx] = 0
+        self._page_tables[slot_idx] = 0
+        if error is not None:
+            request.out.put(("error", error))
+            self.metrics.on_finish(request.timings, failed=True)
+        else:
+            request.out.put(("done", request.timings))
+            self.metrics.on_finish(request.timings)
+
+    def _fail_pending(self, message: str) -> None:
+        try:
+            while True:
+                request = self._submit.get_nowait()
+                request.out.put(("error", message))
+        except queue.Empty:
+            pass
+
+    def _fail_all(self, message: str) -> None:
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                self._finish(i, error=message)
+        self._fail_pending(message)

@@ -1,0 +1,164 @@
+"""Paged KV cache: device-side page pools + host-side block allocator.
+
+The north star's core memory structure (no reference analog — the reference
+is stateless; SURVEY.md §2b "Paged KV cache"): KV for all sequences lives in
+fixed-size pages inside one preallocated pool per layer, so sequences grow
+without reallocation or fragmentation, and the decode batch is composed by
+page-table indirection rather than copying.
+
+Layout (per K and V):  [num_layers, num_pages, page_size, num_kv_heads,
+head_dim]. The trailing (page_size·num_kv_heads, head_dim) footprint of one
+page is contiguous in HBM — what the Pallas decode kernel DMAs per grid step.
+
+The allocator is host-side bookkeeping: the C++ implementation
+(native/block_allocator.cc, loaded via ctypes) with a pure-Python fallback of
+identical semantics. Page 0 is reserved as the garbage page — inactive decode
+slots point at it so masked lanes always have a safe write target.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..models.config import ModelConfig
+
+_NATIVE_PATHS = (
+    os.path.join(os.path.dirname(__file__), "..", "..", "build",
+                 "libblock_allocator.so"),
+    "build/libblock_allocator.so",
+)
+
+
+def _load_native() -> Optional[ctypes.CDLL]:
+    for path in _NATIVE_PATHS:
+        if os.path.exists(path):
+            lib = ctypes.CDLL(os.path.abspath(path))
+            lib.pk_allocator_new.restype = ctypes.c_void_p
+            lib.pk_allocator_new.argtypes = [ctypes.c_int32]
+            lib.pk_allocator_free.argtypes = [ctypes.c_void_p]
+            lib.pk_num_free.restype = ctypes.c_int32
+            lib.pk_num_free.argtypes = [ctypes.c_void_p]
+            lib.pk_alloc.restype = ctypes.c_int32
+            lib.pk_alloc.argtypes = [
+                ctypes.c_void_p, ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int32),
+            ]
+            lib.pk_retain.restype = ctypes.c_int32
+            lib.pk_retain.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+            lib.pk_release.restype = ctypes.c_int32
+            lib.pk_release.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+            return lib
+    return None
+
+
+class AllocationError(RuntimeError):
+    """Not enough free pages for the request (admission should back off)."""
+
+
+class BlockAllocator:
+    """Refcounted free-list page allocator (native-backed when built)."""
+
+    def __init__(self, num_pages: int, prefer_native: bool = True):
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is reserved)")
+        self.num_pages = num_pages
+        self._lib = _load_native() if prefer_native else None
+        if self._lib is not None:
+            self._handle = self._lib.pk_allocator_new(num_pages)
+        else:
+            self._free = list(range(num_pages - 1, 0, -1))
+            self._refcount = [0] * num_pages
+            self._refcount[0] = 1
+        self.is_native = self._lib is not None
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        if lib is not None:
+            lib.pk_allocator_free(self._handle)
+            self._lib = None
+
+    @property
+    def num_free(self) -> int:
+        if self._lib is not None:
+            return self._lib.pk_num_free(self._handle)
+        return len(self._free)
+
+    def alloc(self, count: int) -> list[int]:
+        """Allocate `count` pages; all-or-nothing."""
+        if count == 0:
+            return []
+        if self._lib is not None:
+            out = (ctypes.c_int32 * count)()
+            if not self._lib.pk_alloc(self._handle, count, out):
+                raise AllocationError(
+                    f"requested {count} pages, {self.num_free} free"
+                )
+            return list(out)
+        if len(self._free) < count:
+            raise AllocationError(
+                f"requested {count} pages, {len(self._free)} free"
+            )
+        pages = [self._free.pop() for _ in range(count)]
+        for p in pages:
+            self._refcount[p] = 1
+        return pages
+
+    def retain(self, page: int) -> None:
+        if self._lib is not None:
+            if self._lib.pk_retain(self._handle, page) < 0:
+                raise ValueError(f"retain of unallocated page {page}")
+            return
+        if page <= 0 or page >= self.num_pages or self._refcount[page] == 0:
+            raise ValueError(f"retain of unallocated page {page}")
+        self._refcount[page] += 1
+
+    def release(self, page: int) -> None:
+        if self._lib is not None:
+            if self._lib.pk_release(self._handle, page) < 0:
+                raise ValueError(f"release of unallocated page {page}")
+            return
+        if page <= 0 or page >= self.num_pages or self._refcount[page] == 0:
+            raise ValueError(f"release of unallocated page {page}")
+        self._refcount[page] -= 1
+        if self._refcount[page] == 0:
+            self._free.append(page)
+
+    def release_all(self, pages: list[int]) -> None:
+        for p in pages:
+            self.release(p)
+
+
+@struct.dataclass
+class PagedKV:
+    """Device-side page pools: k/v [L, num_pages, page_size, Hk, D]."""
+
+    k: jax.Array
+    v: jax.Array
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def num_pages(self) -> int:
+        return self.k.shape[1]
+
+
+def init_paged_kv(
+    cfg: ModelConfig, num_pages: int, page_size: int, dtype=jnp.bfloat16
+) -> PagedKV:
+    shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads, cfg.head_dim)
+    return PagedKV(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def kv_pool_bytes(
+    cfg: ModelConfig, num_pages: int, page_size: int, dtype=jnp.bfloat16
+) -> int:
+    per_slot = cfg.num_kv_heads * cfg.head_dim * jnp.dtype(dtype).itemsize
+    return 2 * cfg.num_layers * num_pages * page_size * per_slot

@@ -1,0 +1,98 @@
+"""Serving metrics: the north-star counters (tok/s, TTFT) plus engine gauges.
+
+The reference's observability is per-RPC duration logging only (SURVEY.md §5
+"metrics"); the engine adds what serving needs: request phase timestamps
+(enqueue → prefill → first token → finish), throughput counters, and pool
+gauges. Snapshots surface through the `engine_stats` tool and per-request
+Usage on the streaming RPC.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class RequestTimings:
+    enqueued: float = field(default_factory=time.monotonic)
+    prefill_start: float = 0.0
+    first_token: float = 0.0
+    finished: float = 0.0
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+
+    @property
+    def ttft_ms(self) -> float:
+        if self.first_token and self.enqueued:
+            return (self.first_token - self.enqueued) * 1e3
+        return 0.0
+
+    @property
+    def tokens_per_sec(self) -> float:
+        if self.finished and self.first_token and self.completion_tokens > 1:
+            elapsed = self.finished - self.first_token
+            if elapsed > 0:
+                return (self.completion_tokens - 1) / elapsed
+        return 0.0
+
+
+class EngineMetrics:
+    """Thread-safe counters; cheap enough to update from the step loop."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.requests_admitted = 0
+        self.requests_completed = 0
+        self.requests_failed = 0
+        self.tokens_generated = 0
+        self.decode_steps = 0
+        self.ttft_ms_sum = 0.0
+        self.ttft_ms_count = 0
+        self._window_start = time.monotonic()
+        self._window_tokens = 0
+        self.tokens_per_sec = 0.0
+
+    def on_admit(self) -> None:
+        with self._lock:
+            self.requests_admitted += 1
+
+    def on_step(self, num_tokens: int) -> None:
+        with self._lock:
+            self.decode_steps += 1
+            self.tokens_generated += num_tokens
+            self._window_tokens += num_tokens
+            now = time.monotonic()
+            elapsed = now - self._window_start
+            if elapsed >= 1.0:
+                self.tokens_per_sec = self._window_tokens / elapsed
+                self._window_start = now
+                self._window_tokens = 0
+
+    def on_finish(self, timings: RequestTimings, failed: bool = False) -> None:
+        with self._lock:
+            if failed:
+                self.requests_failed += 1
+            else:
+                self.requests_completed += 1
+            if timings.ttft_ms > 0:
+                self.ttft_ms_sum += timings.ttft_ms
+                self.ttft_ms_count += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            mean_ttft = (
+                self.ttft_ms_sum / self.ttft_ms_count
+                if self.ttft_ms_count
+                else 0.0
+            )
+            return {
+                "requests_admitted": self.requests_admitted,
+                "requests_completed": self.requests_completed,
+                "requests_failed": self.requests_failed,
+                "tokens_generated": self.tokens_generated,
+                "decode_steps": self.decode_steps,
+                "tokens_per_sec": round(self.tokens_per_sec, 2),
+                "mean_ttft_ms": round(mean_ttft, 2),
+            }

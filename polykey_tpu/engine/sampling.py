@@ -33,16 +33,59 @@ def _apply_top_k(logits: jax.Array, k: int) -> jax.Array:
     return jnp.where(logits < threshold, -jnp.inf, logits)
 
 
-def _apply_top_p(logits: jax.Array, p: float) -> jax.Array:
-    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+def _top_p_keep_mask(sorted_logits: jax.Array, p: jax.Array) -> jax.Array:
+    """Keep-mask over descending-sorted logits: smallest prefix with
+    cumulative mass >= p, and always at least the top-1 entry (so p <= 0
+    degrades to greedy support instead of masking everything)."""
     probs = jax.nn.softmax(sorted_logits, axis=-1)
     cumulative = jnp.cumsum(probs, axis=-1)
-    # Keep the smallest prefix with cumulative mass >= p (always >= 1 token).
-    cutoff_mask = cumulative - probs < p
+    keep = cumulative - probs < p
+    first = (
+        jax.lax.broadcasted_iota(
+            jnp.int32, sorted_logits.shape, sorted_logits.ndim - 1
+        )
+        == 0
+    )
+    return keep | first
+
+
+def _apply_top_p(logits: jax.Array, p: float) -> jax.Array:
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    keep = _top_p_keep_mask(sorted_logits, jnp.float32(p))
     threshold = jnp.min(
-        jnp.where(cutoff_mask, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
     )
     return jnp.where(logits < threshold, -jnp.inf, logits)
+
+
+def sample_dynamic(
+    logits: jax.Array,            # [B, vocab] fp32
+    key: jax.Array,
+    temperature: jax.Array,       # [B] — 0 → greedy for that row
+    top_p: jax.Array,             # [B] — 1.0 → disabled for that row
+) -> jax.Array:
+    """Per-row sampling with *data-dependent* temperature/top-p.
+
+    The continuous-batching decode step serves many requests with different
+    sampling settings in one jitted call, so the settings arrive as arrays
+    rather than static config. Greedy rows are selected with jnp.where (no
+    control flow → no recompilation as the batch mix changes).
+    """
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / temp
+
+    # Per-row top-p on the scaled logits (sort + cumulative mass threshold).
+    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
+    keep = _top_p_keep_mask(sorted_logits, top_p[:, None])
+    threshold = jnp.min(
+        jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    scaled = jnp.where(scaled < threshold, -jnp.inf, scaled)
+
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature == 0.0, greedy, sampled)
 
 
 def sample(

@@ -1,0 +1,61 @@
+"""Engine watchdog: a hung device step must fail fast and visibly.
+
+The reference delegates liveness entirely to the platform (SURVEY.md §5:
+Docker healthcheck + restart policy); a TPU engine adds a failure mode the
+platform can't see — the process is alive but the step loop is wedged (device
+hang, deadlocked transfer). The watchdog notices missing progress while work
+is pending, flips gRPC health to NOT_SERVING (so orchestration stops routing
+and restarts per policy), and fails in-flight requests cleanly rather than
+letting clients hit their deadlines.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class Watchdog:
+    def __init__(self, engine, health=None, logger=None,
+                 check_interval_s: float = 5.0):
+        self.engine = engine
+        self.health = health
+        self.logger = logger
+        self.check_interval_s = check_interval_s
+        self.tripped = False
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="polykey-watchdog", daemon=True
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        timeout = self.engine.config.watchdog_timeout_s
+        while not self._stop.wait(self.check_interval_s):
+            if not self.engine.busy:
+                continue
+            stalled_for = time.monotonic() - self.engine.last_progress
+            if stalled_for < timeout:
+                continue
+            self.tripped = True
+            message = (
+                f"engine made no progress for {stalled_for:.0f}s with work "
+                "pending (device hang?)"
+            )
+            if self.logger is not None:
+                self.logger.error("watchdog tripped", error=message)
+            # Only flag and flip health here; slot/allocator state belongs to
+            # the engine thread. If that thread ever returns from the wedged
+            # device call it sees `dead` and fails in-flight work itself; if
+            # it never returns, clients hit request_timeout_s and the
+            # platform restarts the NOT_SERVING process (compose healthcheck).
+            self.engine.dead = message
+            self.engine._wake.set()
+            if self.health is not None:
+                self.health.shutdown()
+            return

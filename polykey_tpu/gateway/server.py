@@ -105,8 +105,13 @@ def build_server(
     logger: Optional[Logger] = None,
     address: str = ":50051",
     max_workers: int = 32,
+    health: Optional[HealthService] = None,
 ):
-    """Assemble the fully-wired gRPC server; returns (server, health)."""
+    """Assemble the fully-wired gRPC server; returns (server, health, port).
+
+    An existing HealthService may be passed in so backends created before the
+    server (the engine + its watchdog) can flip serving status.
+    """
     logger = logger or Logger()
     server = grpc.server(
         futures.ThreadPoolExecutor(
@@ -118,7 +123,8 @@ def build_server(
 
     add_PolykeyServiceServicer_to_server(PolykeyServer(service, logger), server)
 
-    health = HealthService()
+    if health is None:
+        health = HealthService()
     add_HealthServicer_to_server(health, server)
     health.set_serving_status(SERVICE_NAME, health_pb.HealthCheckResponse.SERVING)
     health.set_serving_status("", health_pb.HealthCheckResponse.SERVING)
@@ -158,15 +164,16 @@ def serve(service: Optional[Service] = None, address: Optional[str] = None) -> N
     if address is None:
         address = os.environ.get("LISTEN_ADDR") or ":50051"
 
+    health = HealthService()
     if service is None:
         try:
-            service = _default_service(logger)
+            service = _default_service(logger, health)
         except Exception as e:
             logger.error("failed to initialize backend", error=str(e))
             raise SystemExit(1)
 
     try:
-        server, health, _ = build_server(service, logger, address)
+        server, health, _ = build_server(service, logger, address, health=health)
     except OSError as e:
         logger.error("failed to listen", error=str(e))
         raise SystemExit(1)
@@ -188,7 +195,7 @@ def serve(service: Optional[Service] = None, address: Optional[str] = None) -> N
     logger.info("server stopped")
 
 
-def _default_service(logger: Logger) -> Service:
+def _default_service(logger: Logger, health: Optional[HealthService] = None) -> Service:
     """Select the backend: TPU engine when requested, mock otherwise.
 
     The reference hard-wires its mock (main.go:85). Here POLYKEY_BACKEND=tpu
@@ -199,7 +206,7 @@ def _default_service(logger: Logger) -> Service:
     if backend in ("tpu", "engine"):
         from .tpu_service import TpuService
 
-        return TpuService.from_env(logger=logger)
+        return TpuService.from_env(health=health, logger=logger)
     from .mock_service import MockService
 
     return MockService()

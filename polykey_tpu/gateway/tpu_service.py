@@ -1,0 +1,175 @@
+"""TPU backend for the Service seam: tool calls → inference engine.
+
+What the north star describes as the `tpu` provider: the gRPC contract stays
+exactly the reference's (tool_name + Struct parameters in, oneof output out —
+internal/service/service.go:13-15), but `llm_generate` runs on the co-located
+serving engine instead of proxying to an external API. Zero external calls.
+
+Tools:
+- ``llm_generate`` (alias ``generate``) — params: prompt (string, required),
+  max_tokens, temperature, top_p. Unary returns the full completion as
+  string_output; the streaming RPC emits incremental UTF-8-safe deltas and a
+  terminal chunk with Usage (TTFT, tok/s).
+- ``engine_stats`` — struct_output snapshot of engine metrics and pool state.
+- the reference's mock tools (example_tool / struct_tool / file_tool) keep
+  their exact semantics via delegation to MockService, so a client of the
+  reference sees no behavior change for non-LLM tools (including the
+  unknown-tool-is-success contract, mock.go:60-63).
+"""
+
+from __future__ import annotations
+
+import queue
+from typing import Iterator, Optional
+
+from ..engine.config import EngineConfig
+from ..engine.engine import EngineDeadError, GenRequest, InferenceEngine
+from ..engine.tokenizer import ByteTokenizer
+from ..engine.watchdog import Watchdog
+from ..proto import common_v2_pb2 as cmn
+from ..proto import polykey_v2_pb2 as pk
+from .mock_service import MockService
+from .service import Service
+from google.protobuf import struct_pb2
+
+_LLM_TOOLS = frozenset({"llm_generate", "generate"})
+
+
+class TpuService(Service):
+    def __init__(self, engine: InferenceEngine, watchdog: Optional[Watchdog] = None):
+        self.engine = engine
+        self.watchdog = watchdog
+        self._mock = MockService()
+
+    @classmethod
+    def from_env(cls, health=None, logger=None) -> "TpuService":
+        config = EngineConfig.from_env()
+        engine = InferenceEngine(config, health=health, logger=logger)
+        watchdog = Watchdog(engine, health=health, logger=logger)
+        watchdog.start()
+        if logger is not None:
+            logger.info(
+                "engine initialized",
+                model=config.model,
+                slots=config.max_decode_slots,
+                pages=config.num_pages,
+                page_size=config.page_size,
+            )
+        return cls(engine, watchdog)
+
+    def close(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        self.engine.shutdown()
+
+    # -- request plumbing ---------------------------------------------------
+
+    def _build_request(self, parameters: Optional[struct_pb2.Struct]) -> GenRequest:
+        params = dict(parameters) if parameters is not None else {}
+        prompt = params.get("prompt")
+        if not isinstance(prompt, str) or not prompt:
+            raise ValueError("llm_generate requires a non-empty string 'prompt'")
+        cfg = self.engine.config
+        return GenRequest(
+            prompt=prompt,
+            max_new_tokens=int(params.get("max_tokens", cfg.default_max_new_tokens)),
+            # Clamp client-supplied knobs into sane ranges rather than letting
+            # degenerate values (negative temp, top_p=0) reach the sampler.
+            temperature=max(0.0, float(params.get("temperature", 0.0))),
+            top_p=min(1.0, max(0.0, float(params.get("top_p", 1.0)))),
+        )
+
+    def _drain(self, request: GenRequest, timeout: float):
+        """Yield engine events until done/error; raises on timeout."""
+        while True:
+            try:
+                kind, value = request.out.get(timeout=timeout)
+            except queue.Empty:
+                request.cancelled.set()
+                raise TimeoutError("generation timed out") from None
+            yield kind, value
+            if kind in ("done", "error"):
+                return
+
+    # -- Service interface --------------------------------------------------
+
+    def execute_tool(self, tool_name, parameters, secret_id, metadata):
+        if tool_name == "engine_stats":
+            response = pk.ExecuteToolResponse(
+                status=cmn.Status(code=200, message="Tool executed successfully")
+            )
+            response.struct_output.update(self.engine.stats())
+            return response
+        if tool_name not in _LLM_TOOLS:
+            return self._mock.execute_tool(tool_name, parameters, secret_id, metadata)
+
+        request = self._build_request(parameters)
+        self.engine.submit(request)
+
+        token_ids: list[int] = []
+        for kind, value in self._drain(request, self.engine.config.request_timeout_s):
+            if kind == "token":
+                token_ids.append(value)
+            elif kind == "error":
+                raise RuntimeError(value)
+
+        text = self.engine.tokenizer.decode(token_ids)
+        response = pk.ExecuteToolResponse(
+            status=cmn.Status(code=200, message="Tool executed successfully"),
+            string_output=text,
+        )
+        return response
+
+    def execute_tool_stream(
+        self, tool_name, parameters, secret_id, metadata
+    ) -> Iterator[pk.ExecuteToolStreamChunk]:
+        if tool_name not in _LLM_TOOLS:
+            yield from self._mock.execute_tool_stream(
+                tool_name, parameters, secret_id, metadata
+            )
+            return
+
+        request = self._build_request(parameters)
+        self.engine.submit(request)
+
+        tokenizer = self.engine.tokenizer
+        incremental = isinstance(tokenizer, ByteTokenizer)
+        utf8_tail = b""
+        all_ids: list[int] = []
+        emitted = ""
+        timings = None
+        try:
+            for kind, value in self._drain(
+                request, self.engine.config.request_timeout_s
+            ):
+                if kind == "token":
+                    if incremental:
+                        delta, utf8_tail = tokenizer.decode_incremental(
+                            [value], utf8_tail
+                        )
+                    else:
+                        # HF detokenization is context-dependent: re-decode
+                        # the full prefix and emit the textual diff.
+                        all_ids.append(value)
+                        text = tokenizer.decode(all_ids)
+                        delta, emitted = text[len(emitted):], text
+                    if delta:
+                        yield pk.ExecuteToolStreamChunk(delta=delta)
+                elif kind == "error":
+                    raise RuntimeError(value)
+                else:
+                    timings = value
+        except GeneratorExit:
+            request.cancelled.set()  # client went away mid-stream
+            raise
+
+        final = pk.ExecuteToolStreamChunk(
+            final=True,
+            status=cmn.Status(code=200, message="Tool executed successfully"),
+        )
+        if timings is not None:
+            final.usage.prompt_tokens = timings.prompt_tokens
+            final.usage.completion_tokens = timings.completion_tokens
+            final.usage.ttft_ms = timings.ttft_ms
+            final.usage.tokens_per_sec = timings.tokens_per_sec
+        yield final

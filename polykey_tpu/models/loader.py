@@ -1,0 +1,145 @@
+"""Checkpoint save/load for served model weights.
+
+The reference has no persistence at all (SURVEY.md §5 checkpoint/resume:
+"Absent — stateless service"); the engine owes load-only checkpointing for
+the served checkpoints. Orbax is the storage layer (the JAX-ecosystem
+standard; handles sharded arrays natively, so weights restore directly onto
+a device mesh when sharding specs are provided).
+
+Formats:
+- orbax directory (save_checkpoint / load_checkpoint) — the native format;
+- HF safetensors import (import_safetensors) — maps a HuggingFace Llama-style
+  state_dict into this framework's param pytree for serving public weights.
+  Requires local files; nothing is fetched.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import ModelConfig
+
+
+def save_checkpoint(path: str, params: dict) -> None:
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.abspath(path), params)
+
+
+def load_checkpoint(
+    path: str,
+    cfg: ModelConfig,
+    dtype=jnp.bfloat16,
+    shardings: Optional[dict] = None,
+) -> dict:
+    """Restore a param pytree saved by save_checkpoint.
+
+    When `shardings` (a pytree of jax.sharding.NamedSharding matching the
+    params) is given, arrays restore directly into their sharded layout.
+    """
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    if path.endswith(".safetensors") or os.path.isfile(
+        os.path.join(path, "model.safetensors.index.json")
+    ):
+        return import_safetensors(path, cfg, dtype)
+
+    from .transformer import init_params
+
+    template = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), cfg, dtype)
+    )
+    if shardings is not None:
+        template = jax.tree_util.tree_map(
+            lambda shape_dtype, sharding: jax.ShapeDtypeStruct(
+                shape_dtype.shape, shape_dtype.dtype, sharding=sharding
+            ),
+            template,
+            shardings,
+        )
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(path, template)
+
+
+# HF Llama-style key mapping: framework param path → HF tensor name pattern.
+# HF stores linear layers as [out, in]; this framework uses [in, out], so
+# every matmul weight transposes on import.
+_HF_LAYER_MAP = {
+    ("attn", "wq"): "model.layers.{i}.self_attn.q_proj.weight",
+    ("attn", "wk"): "model.layers.{i}.self_attn.k_proj.weight",
+    ("attn", "wv"): "model.layers.{i}.self_attn.v_proj.weight",
+    ("attn", "wo"): "model.layers.{i}.self_attn.o_proj.weight",
+    ("mlp", "gate"): "model.layers.{i}.mlp.gate_proj.weight",
+    ("mlp", "up"): "model.layers.{i}.mlp.up_proj.weight",
+    ("mlp", "down"): "model.layers.{i}.mlp.down_proj.weight",
+    ("ln1",): "model.layers.{i}.input_layernorm.weight",
+    ("ln2",): "model.layers.{i}.post_attention_layernorm.weight",
+}
+
+
+def import_safetensors(path: str, cfg: ModelConfig, dtype=jnp.bfloat16) -> dict:
+    """Map a local HF Llama-family safetensors checkpoint into a param pytree.
+
+    Dense models only (Mixtral/Gemma import can extend _HF_LAYER_MAP); layer
+    tensors are stacked on the leading axis for the scan-based forward.
+    """
+    try:
+        from safetensors import safe_open  # optional dep; gate at call time
+    except ImportError as e:
+        raise RuntimeError(
+            "safetensors is not installed in this image; convert the "
+            "checkpoint to orbax with scripts/convert_checkpoint.py on a "
+            "machine that has it"
+        ) from e
+
+    import glob
+    import json
+
+    if os.path.isdir(path):
+        index = os.path.join(path, "model.safetensors.index.json")
+        if os.path.exists(index):
+            with open(index) as f:
+                weight_map = json.load(f)["weight_map"]
+            files = {os.path.join(path, fn) for fn in weight_map.values()}
+        else:
+            files = set(glob.glob(os.path.join(path, "*.safetensors")))
+    else:
+        files = {path}
+
+    tensors: dict[str, np.ndarray] = {}
+    for file in sorted(files):
+        with safe_open(file, framework="np") as f:
+            for name in f.keys():
+                tensors[name] = f.get_tensor(name)
+
+    def get(name: str, transpose: bool) -> jnp.ndarray:
+        t = tensors[name]
+        arr = jnp.asarray(t, dtype=dtype)
+        return arr.T if transpose else arr
+
+    layers: dict = {}
+    for key_path, pattern in _HF_LAYER_MAP.items():
+        per_layer = [
+            get(pattern.format(i=i), transpose=len(key_path) == 2)
+            for i in range(cfg.num_layers)
+        ]
+        node = layers
+        for k in key_path[:-1]:
+            node = node.setdefault(k, {})
+        node[key_path[-1]] = jnp.stack(per_layer)
+
+    params = {
+        "embed": get("model.embed_tokens.weight", transpose=False),
+        "layers": layers,
+        "final_norm": get("model.norm.weight", transpose=False),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = get("lm_head.weight", transpose=True)
+    return params

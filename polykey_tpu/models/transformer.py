@@ -115,35 +115,29 @@ def _moe_mlp(layer_params: dict, h: jax.Array, cfg: ModelConfig) -> jax.Array:
     return moe_mlp(layer_params, h, cfg)
 
 
-def forward(
-    params: dict,
-    cfg: ModelConfig,
-    tokens: jax.Array,               # [B, T] int32, right-padded
-    positions: jax.Array,            # [B, T] absolute positions
-    cache: Optional[KVCache] = None,
-) -> tuple[jax.Array, Optional[KVCache]]:
-    """Run the stack; returns (hidden [B, T, H], updated cache).
+def _layer_window(cfg: ModelConfig, layer_idx: jax.Array):
+    """Gemma-2 interleaving: even layers sliding-window, odd layers global."""
+    if cfg.sliding_window is None:
+        return None
+    return jnp.where(layer_idx % 2 == 0, cfg.sliding_window, cfg.max_seq_len)
 
-    With a cache: new K/V are written at their absolute positions and
-    attention spans all cache slots — prefill and decode share this path.
-    Without a cache (training / one-shot scoring): attention spans the
-    current sequence only.
+
+def _run_stack(params, cfg: ModelConfig, tokens, positions, kv_scanned, attend):
+    """Shared transformer stack: embed → scan(layer body) → final norm.
+
+    The KV mechanics (where K/V are written, what attention reads) differ
+    between the contiguous-cache, no-cache, and paged paths, so they are
+    injected via `attend(layer_idx, q, k, v, kc, vc) → (ctx, kc, vc)`;
+    everything else — norms, projections, RoPE, residuals, MLP/MoE,
+    Gemma post-norms — is this one body.
     """
     B, T = tokens.shape
-    use_cache = cache is not None
     norm_offset = 1.0 if cfg.scale_embeddings else 0.0
     eps = cfg.rms_norm_eps
 
     x = params["embed"][tokens]
     if cfg.scale_embeddings:
         x = (x.astype(jnp.float32) * cfg.hidden_size**0.5).astype(x.dtype)
-
-    batch_idx = jnp.arange(B, dtype=jnp.int32)[:, None]
-    q_pos = positions[:, :, None]                       # [B, T, 1]
-    if use_cache:
-        kv_pos = jnp.arange(cache.num_slots, dtype=jnp.int32)[None, None, :]
-    else:
-        kv_pos = positions[:, None, :]                  # kv = current tokens
 
     def body(x, scanned):
         layer_params, layer_idx, kc, vc = scanned
@@ -153,27 +147,9 @@ def forward(
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
 
-        if use_cache:
-            kc = kc.at[batch_idx, positions].set(k)
-            vc = vc.at[batch_idx, positions].set(v)
-            k_slots, v_slots = kc, vc
-        else:
-            k_slots, v_slots = k, v
+        ctx, kc, vc = attend(layer_idx, q, k, v, kc, vc)
 
-        mask = kv_pos <= q_pos
-        if cfg.sliding_window is not None:
-            # Gemma-2: even layers sliding-window, odd layers global.
-            window = jnp.where(
-                layer_idx % 2 == 0, cfg.sliding_window, cfg.max_seq_len
-            )
-            mask &= kv_pos > q_pos - window
-
-        attn_out = attention(
-            q, k_slots, v_slots, mask,
-            scale=cfg.q_scale,
-            logit_softcap=cfg.attn_logit_softcap,
-        )
-        attn_out = attn_out.reshape(B, T, cfg.num_heads * cfg.head_dim)
+        attn_out = ctx.reshape(B, T, cfg.num_heads * cfg.head_dim)
         attn_out = attn_out @ layer_params["attn"]["wo"]
         if cfg.use_post_norms:
             attn_out = rms_norm(attn_out, layer_params["post_ln1"], eps, norm_offset)
@@ -191,17 +167,102 @@ def forward(
         return x, (kc, vc)
 
     layer_ids = jnp.arange(cfg.num_layers, dtype=jnp.int32)
-    if use_cache:
-        scanned = (params["layers"], layer_ids, cache.k, cache.v)
-    else:
-        empty = jnp.zeros((cfg.num_layers, 0), dtype=x.dtype)
-        scanned = (params["layers"], layer_ids, empty, empty)
-
-    x, (new_k, new_v) = jax.lax.scan(body, x, scanned)
-
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["layers"], layer_ids) + kv_scanned
+    )
     x = rms_norm(x, params["final_norm"], eps, norm_offset)
+    return x, new_k, new_v
+
+
+def forward(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jax.Array,               # [B, T] int32, right-padded
+    positions: jax.Array,            # [B, T] absolute positions
+    cache: Optional[KVCache] = None,
+) -> tuple[jax.Array, Optional[KVCache]]:
+    """Run the stack; returns (hidden [B, T, H], updated cache).
+
+    With a cache: new K/V are written at their absolute positions and
+    attention spans all cache slots — prefill and decode share this path.
+    Without a cache (training / one-shot scoring): attention spans the
+    current sequence only.
+    """
+    B, T = tokens.shape
+    use_cache = cache is not None
+    batch_idx = jnp.arange(B, dtype=jnp.int32)[:, None]
+    q_pos = positions[:, :, None]                       # [B, T, 1]
+
+    if use_cache:
+        kv_pos = jnp.arange(cache.num_slots, dtype=jnp.int32)[None, None, :]
+
+        def attend(layer_idx, q, k, v, kc, vc):
+            kc = kc.at[batch_idx, positions].set(k)
+            vc = vc.at[batch_idx, positions].set(v)
+            mask = kv_pos <= q_pos
+            window = _layer_window(cfg, layer_idx)
+            if window is not None:
+                mask &= kv_pos > q_pos - window
+            ctx = attention(
+                q, kc, vc, mask,
+                scale=cfg.q_scale, logit_softcap=cfg.attn_logit_softcap,
+            )
+            return ctx, kc, vc
+
+        kv_scanned = (cache.k, cache.v)
+    else:
+        kv_pos = positions[:, None, :]                  # kv = current tokens
+
+        def attend(layer_idx, q, k, v, kc, vc):
+            mask = kv_pos <= q_pos
+            window = _layer_window(cfg, layer_idx)
+            if window is not None:
+                mask &= kv_pos > q_pos - window
+            ctx = attention(
+                q, k, v, mask,
+                scale=cfg.q_scale, logit_softcap=cfg.attn_logit_softcap,
+            )
+            return ctx, kc, vc
+
+        empty = jnp.zeros((cfg.num_layers, 0), dtype=jnp.float32)
+        kv_scanned = (empty, empty)
+
+    x, new_k, new_v = _run_stack(params, cfg, tokens, positions, kv_scanned, attend)
     new_cache = KVCache(k=new_k, v=new_v) if use_cache else None
     return x, new_cache
+
+
+def forward_paged(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jax.Array,               # [B, T] int32, right-padded
+    positions: jax.Array,            # [B, T] absolute positions
+    paged,                           # engine.kv_cache.PagedKV
+    page_tables: jax.Array,          # [B, P] int32
+):
+    """Forward pass over the paged KV cache (serving path).
+
+    Same computation as `forward`-with-cache, but KV lives in the shared page
+    pools and is addressed through per-sequence page tables — the layout the
+    continuous-batching engine composes decode batches from. Used both for
+    prefill (T = prompt bucket) and batched decode (T = 1).
+    """
+    from ..ops.paged_attention import paged_attention, paged_write
+
+    def attend(layer_idx, q, k, v, kc, vc):
+        kc, vc = paged_write(kc, vc, k, v, page_tables, positions)
+        ctx = paged_attention(
+            q, kc, vc, page_tables, positions,
+            scale=cfg.q_scale,
+            logit_softcap=cfg.attn_logit_softcap,
+            window=_layer_window(cfg, layer_idx),
+        )
+        return ctx, kc, vc
+
+    x, new_k, new_v = _run_stack(
+        params, cfg, tokens, positions, (paged.k, paged.v), attend
+    )
+    return x, type(paged)(k=new_k, v=new_v)
 
 
 def unembed(params: dict, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:

@@ -1,0 +1,81 @@
+"""Paged attention: read KV through page-table indirection.
+
+`paged_gather_kv` is the reference implementation (pure jnp): materialize the
+per-sequence KV window by gathering whole pages, then run the standard masked
+attention. Correct everywhere, but it streams the full gathered window
+through HBM every step — the Pallas decode kernel (paged_attention_decode
+with use_kernel=True, task: ops/paged_attention_kernel.py) replaces the
+gather with per-page DMA so only valid pages move.
+
+Page-table convention (engine/kv_cache.py): page_tables[b, j] is the page id
+holding positions [j*page_size, (j+1)*page_size); unused tail entries point
+at the reserved garbage page 0 and are excluded by the position mask.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .attention import attention
+
+
+def paged_gather_kv(
+    k_pages: jax.Array,       # [num_pages, page_size, Hk, D]
+    v_pages: jax.Array,
+    page_tables: jax.Array,   # [B, P] int32
+    ) -> tuple[jax.Array, jax.Array]:
+    """Materialize [B, P*page_size, Hk, D] K/V windows from the pools."""
+    B, P = page_tables.shape
+    _, page_size, Hk, D = k_pages.shape
+    k = k_pages[page_tables]  # [B, P, page_size, Hk, D]
+    v = v_pages[page_tables]
+    return (
+        k.reshape(B, P * page_size, Hk, D),
+        v.reshape(B, P * page_size, Hk, D),
+    )
+
+
+def paged_attention(
+    q: jax.Array,             # [B, T, Hq, D]
+    k_pages: jax.Array,       # [num_pages, page_size, Hk, D]
+    v_pages: jax.Array,
+    page_tables: jax.Array,   # [B, P]
+    q_positions: jax.Array,   # [B, T] absolute positions of the queries
+    *,
+    scale: float,
+    logit_softcap: Optional[float] = None,
+    window: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Attention over paged KV; returns [B, T, Hq, D].
+
+    Slot j of the gathered window holds position j, so the absolute-position
+    causal mask simultaneously hides unwritten slots and garbage-page tails.
+    """
+    k, v = paged_gather_kv(k_pages, v_pages, page_tables)
+    S = k.shape[1]
+    kv_pos = jnp.arange(S, dtype=jnp.int32)[None, None, :]
+    mask = kv_pos <= q_positions[:, :, None]
+    if window is not None:
+        mask &= kv_pos > q_positions[:, :, None] - window
+    return attention(q, k, v, mask, scale=scale, logit_softcap=logit_softcap)
+
+
+def paged_write(
+    k_pages: jax.Array,       # [num_pages, page_size, Hk, D]
+    v_pages: jax.Array,
+    k_new: jax.Array,         # [B, T, Hk, D]
+    v_new: jax.Array,
+    page_tables: jax.Array,   # [B, P]
+    positions: jax.Array,     # [B, T] absolute position of each new token
+) -> tuple[jax.Array, jax.Array]:
+    """Scatter new KV into their pages at (page_table[pos // ps], pos % ps)."""
+    page_size = k_pages.shape[1]
+    batch_idx = jnp.arange(page_tables.shape[0], dtype=jnp.int32)[:, None]
+    page_ids = page_tables[batch_idx, positions // page_size]   # [B, T]
+    offsets = positions % page_size                             # [B, T]
+    k_pages = k_pages.at[page_ids, offsets].set(k_new)
+    v_pages = v_pages.at[page_ids, offsets].set(v_new)
+    return k_pages, v_pages

@@ -1,0 +1,260 @@
+"""Engine tests: continuous batching, streaming, cancellation, stats, and the
+full gRPC stack with the TPU service mounted (tiny model, CPU device).
+
+This is the concurrency-stress tier SURVEY.md §4 prescribes in place of Go's
+race detector: many concurrent clients hammering the batcher with assertion
+checks on every response.
+"""
+
+import queue
+import threading
+import time
+
+import grpc
+import numpy as np
+import pytest
+
+from polykey_tpu.engine.config import EngineConfig
+from polykey_tpu.engine.engine import GenRequest, InferenceEngine
+from polykey_tpu.gateway import server as gateway_server
+from polykey_tpu.gateway.jsonlog import Logger
+from polykey_tpu.gateway.tpu_service import TpuService
+from polykey_tpu.proto import polykey_v2_pb2 as pk
+from polykey_tpu.proto.polykey_v2_grpc import PolykeyServiceStub
+
+import io
+
+TEST_CONFIG = EngineConfig(
+    model="tiny-llama",
+    tokenizer="byte",
+    dtype="float32",
+    max_decode_slots=4,
+    page_size=8,
+    num_pages=64,
+    max_seq_len=64,
+    prefill_buckets=(16, 32),
+    max_new_tokens_cap=32,
+    default_max_new_tokens=8,
+)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = InferenceEngine(TEST_CONFIG)
+    yield eng
+    eng.shutdown()
+
+
+def _collect(request: GenRequest, timeout=30.0):
+    tokens, done, error = [], None, None
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            kind, value = request.out.get(timeout=deadline - time.monotonic())
+        except queue.Empty:
+            break
+        if kind == "token":
+            tokens.append(value)
+        elif kind == "done":
+            done = value
+            break
+        else:
+            error = value
+            break
+    return tokens, done, error
+
+
+def test_single_request(engine):
+    request = GenRequest(prompt="hello", max_new_tokens=5)
+    engine.submit(request)
+    tokens, done, error = _collect(request)
+    assert error is None
+    assert done is not None
+    assert len(tokens) == done.completion_tokens <= 5
+    assert done.prompt_tokens == len(engine.tokenizer.encode("hello"))
+    assert done.ttft_ms > 0
+
+
+def test_greedy_reproducible(engine):
+    outs = []
+    for _ in range(2):
+        request = GenRequest(prompt="abc", max_new_tokens=6, temperature=0.0)
+        engine.submit(request)
+        tokens, done, error = _collect(request)
+        assert error is None
+        outs.append(tokens)
+    assert outs[0] == outs[1]
+
+
+def test_concurrent_requests_batched(engine):
+    """More requests than slots: all must complete, slots recycled."""
+    requests = [
+        GenRequest(prompt=f"prompt {i}", max_new_tokens=6, temperature=0.5)
+        for i in range(10)
+    ]
+    for request in requests:
+        engine.submit(request)
+    results = [_collect(request) for request in requests]
+    for tokens, done, error in results:
+        assert error is None
+        assert done is not None
+        assert len(tokens) >= 1
+    # All pages back in the pool afterwards.
+    assert engine.allocator.num_free == TEST_CONFIG.num_pages - 1
+    assert not engine.busy
+
+
+def test_batched_greedy_matches_solo(engine):
+    """Continuous batching must not change greedy output: run a probe alone,
+    then again while 3 other requests occupy the batch."""
+    probe_prompt = "determinism probe"
+    solo = GenRequest(prompt=probe_prompt, max_new_tokens=6)
+    engine.submit(solo)
+    solo_tokens, _, _ = _collect(solo)
+
+    noise = [
+        GenRequest(prompt=f"noise {i}", max_new_tokens=12, temperature=1.0)
+        for i in range(3)
+    ]
+    probe = GenRequest(prompt=probe_prompt, max_new_tokens=6)
+    for request in noise:
+        engine.submit(request)
+    engine.submit(probe)
+    probe_tokens, _, probe_err = _collect(probe)
+    for request in noise:
+        _collect(request)
+    assert probe_err is None
+    assert probe_tokens == solo_tokens
+
+
+def test_cancellation_frees_slot(engine):
+    request = GenRequest(prompt="cancel me", max_new_tokens=32, temperature=1.0)
+    engine.submit(request)
+    request.out.get(timeout=30)  # wait for the first token
+    request.cancelled.set()
+    deadline = time.monotonic() + 10
+    while engine.busy and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert not engine.busy
+    assert engine.allocator.num_free == TEST_CONFIG.num_pages - 1
+
+
+def test_pool_exhaustion_backpressure():
+    """A pool that fits one request at a time still completes all requests."""
+    config = EngineConfig(
+        model="tiny-llama", tokenizer="byte", dtype="float32",
+        max_decode_slots=2, page_size=8, num_pages=4, max_seq_len=32,
+        prefill_buckets=(16,), max_new_tokens_cap=8, default_max_new_tokens=4,
+    )
+    eng = InferenceEngine(config)
+    try:
+        requests = [GenRequest(prompt=f"req {i}", max_new_tokens=4) for i in range(4)]
+        for request in requests:
+            eng.submit(request)
+        for request in requests:
+            tokens, done, error = _collect(request)
+            assert error is None, error
+            assert done is not None
+        assert eng.allocator.num_free == config.num_pages - 1
+    finally:
+        eng.shutdown()
+
+
+def test_stats_shape(engine):
+    stats = engine.stats()
+    for key in ("requests_admitted", "tokens_generated", "slots_busy",
+                "pages_free", "model", "tokens_per_sec"):
+        assert key in stats
+    assert stats["model"] == "tiny-llama"
+
+
+# -- full-stack gRPC tests --------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def grpc_stack(engine):
+    logger = Logger(stream=io.StringIO(), level="debug")
+    service = TpuService(engine)
+    server, health, port = gateway_server.build_server(
+        service, logger, address="127.0.0.1:0"
+    )
+    server.start()
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    yield PolykeyServiceStub(channel)
+    channel.close()
+    server.stop(grace=None)
+
+
+def _llm_request(prompt="hi there", **params):
+    request = pk.ExecuteToolRequest(tool_name="llm_generate")
+    request.parameters.update({"prompt": prompt, "max_tokens": 6, **params})
+    return request
+
+
+def test_grpc_llm_generate_unary(grpc_stack):
+    resp = grpc_stack.ExecuteTool(_llm_request(), timeout=60)
+    assert resp.status.code == 200
+    assert resp.WhichOneof("output") == "string_output"
+
+
+def test_grpc_llm_generate_stream(grpc_stack):
+    chunks = list(grpc_stack.ExecuteToolStream(_llm_request(), timeout=60))
+    assert chunks[-1].final
+    assert chunks[-1].status.code == 200
+    usage = chunks[-1].usage
+    assert usage.completion_tokens >= 1
+    assert usage.ttft_ms > 0
+    assert usage.prompt_tokens == len("hi there".encode()) + 1  # bytes + BOS
+
+
+def test_grpc_mock_tools_still_work(grpc_stack):
+    resp = grpc_stack.ExecuteTool(
+        pk.ExecuteToolRequest(tool_name="example_tool"), timeout=30
+    )
+    assert resp.status.code == 200
+    assert resp.string_output.startswith("Mock execution of example_tool")
+    resp = grpc_stack.ExecuteTool(
+        pk.ExecuteToolRequest(tool_name="nope"), timeout=30
+    )
+    assert resp.string_output == "Unknown tool: nope"
+
+
+def test_grpc_engine_stats_tool(grpc_stack):
+    resp = grpc_stack.ExecuteTool(
+        pk.ExecuteToolRequest(tool_name="engine_stats"), timeout=30
+    )
+    assert resp.WhichOneof("output") == "struct_output"
+    assert dict(resp.struct_output)["model"] == "tiny-llama"
+
+
+def test_grpc_missing_prompt_errors(grpc_stack):
+    request = pk.ExecuteToolRequest(tool_name="llm_generate")
+    request.parameters.update({"max_tokens": 4})
+    with pytest.raises(grpc.RpcError) as err:
+        grpc_stack.ExecuteTool(request, timeout=30)
+    assert "prompt" in err.value.details()
+
+
+def test_grpc_concurrent_streams(grpc_stack):
+    """Concurrent streaming clients — the race-detector analog."""
+    errors: list = []
+
+    def worker(i):
+        try:
+            chunks = list(
+                grpc_stack.ExecuteToolStream(
+                    _llm_request(prompt=f"client {i}", temperature=0.8),
+                    timeout=120,
+                )
+            )
+            assert chunks[-1].final
+            assert chunks[-1].usage.completion_tokens >= 1
+        except Exception as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors

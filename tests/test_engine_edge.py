@@ -1,0 +1,80 @@
+"""Edge-case engine/sampling tests added from review findings."""
+
+import queue
+import time
+
+import jax
+import jax.numpy as jnp
+
+from polykey_tpu.engine.config import EngineConfig
+from polykey_tpu.engine.engine import GenRequest, InferenceEngine
+from polykey_tpu.engine.sampling import SamplingParams, sample, sample_dynamic
+
+
+def test_top_p_zero_degrades_to_greedy():
+    logits = jnp.array([[0.0, 3.0, 1.0, -2.0]], dtype=jnp.float32)
+    key = jax.random.PRNGKey(0)
+    # Static path.
+    out = sample(logits, key, SamplingParams(temperature=1.0, top_p=0.0))
+    assert int(out[0]) == 1
+    # Dynamic (per-row) path.
+    out = sample_dynamic(
+        logits, key, jnp.array([1.0]), jnp.array([0.0], dtype=jnp.float32)
+    )
+    assert int(out[0]) == 1
+
+
+def test_shutdown_fails_inflight_requests():
+    config = EngineConfig(
+        model="tiny-llama", tokenizer="byte", dtype="float32",
+        max_decode_slots=2, page_size=8, num_pages=32, max_seq_len=64,
+        prefill_buckets=(16,), max_new_tokens_cap=64,
+        default_max_new_tokens=32,
+    )
+    engine = InferenceEngine(config)
+    request = GenRequest(prompt="long", max_new_tokens=64, temperature=1.0)
+    engine.submit(request)
+    request.out.get(timeout=30)  # first token: the request is in-flight
+    engine.shutdown()
+    # The in-flight request must receive a terminal event promptly, not
+    # block until the request timeout.
+    deadline = time.monotonic() + 5
+    terminal = None
+    while time.monotonic() < deadline:
+        try:
+            kind, value = request.out.get(timeout=0.5)
+        except queue.Empty:
+            continue
+        if kind in ("done", "error"):
+            terminal = (kind, value)
+            break
+    assert terminal is not None
+    assert terminal[0] == "error"
+
+
+def test_oversize_max_tokens_clamped():
+    config = EngineConfig(
+        model="tiny-llama", tokenizer="byte", dtype="float32",
+        max_decode_slots=1, page_size=8, num_pages=32, max_seq_len=32,
+        prefill_buckets=(16,), max_new_tokens_cap=1000,  # cap > max_seq_len
+        default_max_new_tokens=4,
+    )
+    engine = InferenceEngine(config)
+    try:
+        request = GenRequest(prompt="x" * 100, max_new_tokens=1000)
+        engine.submit(request)
+        tokens, done, error = [], None, None
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            kind, value = request.out.get(timeout=60)
+            if kind == "token":
+                tokens.append(value)
+            else:
+                done, error = (value, None) if kind == "done" else (None, value)
+                break
+        assert error is None, error
+        assert done is not None
+        # Never exceeds the position cap implied by max_seq_len.
+        assert done.prompt_tokens + done.completion_tokens <= config.max_seq_len
+    finally:
+        engine.shutdown()
