@@ -15,14 +15,19 @@
 // Build: make native  (→ build/libblock_allocator.so)
 
 #include <cstdint>
+#include <mutex>
 #include <vector>
 
 namespace {
 
+// The engine thread owns alloc/release, but gauge reads (pk_num_free via the
+// engine_stats tool) arrive from gRPC handler threads — every entry point
+// locks.
 struct Allocator {
   int32_t num_pages = 0;
   std::vector<int32_t> free_list;   // LIFO of free page ids
   std::vector<int32_t> refcount;    // per page; 0 = free
+  std::mutex mu;
 };
 
 }  // namespace
@@ -46,13 +51,16 @@ void* pk_allocator_new(int32_t num_pages) {
 void pk_allocator_free(void* handle) { delete static_cast<Allocator*>(handle); }
 
 int32_t pk_num_free(void* handle) {
-  return static_cast<int32_t>(static_cast<Allocator*>(handle)->free_list.size());
+  auto* a = static_cast<Allocator*>(handle);
+  std::lock_guard<std::mutex> lock(a->mu);
+  return static_cast<int32_t>(a->free_list.size());
 }
 
 // Allocate `count` pages into `out`. All-or-nothing: returns 1 on success,
 // 0 (no pages written) if fewer than `count` are free.
 int32_t pk_alloc(void* handle, int32_t count, int32_t* out) {
   auto* a = static_cast<Allocator*>(handle);
+  std::lock_guard<std::mutex> lock(a->mu);
   if (static_cast<int32_t>(a->free_list.size()) < count) return 0;
   for (int32_t i = 0; i < count; ++i) {
     int32_t page = a->free_list.back();
@@ -67,6 +75,7 @@ int32_t pk_alloc(void* handle, int32_t count, int32_t* out) {
 // or out-of-range page.
 int32_t pk_retain(void* handle, int32_t page) {
   auto* a = static_cast<Allocator*>(handle);
+  std::lock_guard<std::mutex> lock(a->mu);
   if (page <= 0 || page >= a->num_pages || a->refcount[page] == 0) return -1;
   return ++a->refcount[page];
 }
@@ -75,6 +84,7 @@ int32_t pk_retain(void* handle, int32_t page) {
 // refcount, or -1 on a double-free / out-of-range / garbage page.
 int32_t pk_release(void* handle, int32_t page) {
   auto* a = static_cast<Allocator*>(handle);
+  std::lock_guard<std::mutex> lock(a->mu);
   if (page <= 0 || page >= a->num_pages || a->refcount[page] == 0) return -1;
   int32_t rc = --a->refcount[page];
   if (rc == 0) a->free_list.push_back(page);

@@ -130,16 +130,16 @@ class InferenceEngine:
         self._dtype = jnp.dtype(config.dtype)
 
         if params is None:
-            # Random init — the dev/bench path; checkpoint loading comes via
-            # models.loader (POLYKEY_CHECKPOINT) when weights exist locally.
-            params = init_params(
-                jax.random.PRNGKey(seed), self.model_cfg, self._dtype
-            )
             if config.checkpoint_path:
                 from ..models.loader import load_checkpoint
 
                 params = load_checkpoint(
                     config.checkpoint_path, self.model_cfg, self._dtype
+                )
+            else:
+                # Random init — the dev/bench path.
+                params = init_params(
+                    jax.random.PRNGKey(seed), self.model_cfg, self._dtype
                 )
         self.params = params
 
@@ -178,8 +178,18 @@ class InferenceEngine:
         if self._stop.is_set():
             raise EngineDeadError("engine is shut down")
         self.metrics.on_admit()
+        # A fresh submission also resets the stall clock: the engine may have
+        # been idle for longer than the watchdog window, and idle time is not
+        # a stall.
+        self.last_progress = time.monotonic()
         self._submit.put(request)
         self._wake.set()
+        # Close the submit/shutdown race: if the engine died or stopped
+        # between the check above and the put, nothing will ever drain the
+        # queue — fail it from here (queue ops are thread-safe; a duplicate
+        # terminal event is harmless, readers stop at the first one).
+        if self.dead is not None or self._stop.is_set():
+            self._fail_pending(self.dead or "engine is shut down")
 
     def stats(self) -> dict:
         snap = self.metrics.snapshot()
@@ -307,25 +317,31 @@ class InferenceEngine:
         num_pages = -(-total_len // cfg.page_size)  # ceil
         pages = self.allocator.alloc(num_pages)     # may raise AllocationError
 
-        page_table = np.zeros((1, cfg.pages_per_seq), dtype=np.int32)
-        page_table[0, : len(pages)] = pages
+        try:
+            page_table = np.zeros((1, cfg.pages_per_seq), dtype=np.int32)
+            page_table[0, : len(pages)] = pages
 
-        tokens = np.zeros((1, bucket), dtype=np.int32)
-        tokens[0, :prompt_len] = prompt_ids
+            tokens = np.zeros((1, bucket), dtype=np.int32)
+            tokens[0, :prompt_len] = prompt_ids
 
-        self._key, key = jax.random.split(self._key)
-        first_token, self.paged = _prefill_step(
-            self.params,
-            self.model_cfg,
-            self.paged,
-            jnp.asarray(tokens),
-            jnp.asarray([prompt_len], dtype=jnp.int32),
-            jnp.asarray(page_table),
-            key,
-            jnp.asarray([request.temperature], dtype=jnp.float32),
-            jnp.asarray([request.top_p], dtype=jnp.float32),
-        )
-        first_token = int(first_token)
+            self._key, key = jax.random.split(self._key)
+            first_token, self.paged = _prefill_step(
+                self.params,
+                self.model_cfg,
+                self.paged,
+                jnp.asarray(tokens),
+                jnp.asarray([prompt_len], dtype=jnp.int32),
+                jnp.asarray(page_table),
+                key,
+                jnp.asarray([request.temperature], dtype=jnp.float32),
+                jnp.asarray([request.top_p], dtype=jnp.float32),
+            )
+            first_token = int(first_token)
+        except Exception:
+            # Pages are only owned by a _Slot after prefill succeeds; give
+            # them back on any failure in between or they leak forever.
+            self.allocator.release_all(pages)
+            raise
 
         slot = _Slot(request=request, pages=pages, generated=1,
                      position_cap=total_len)

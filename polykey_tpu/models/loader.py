@@ -45,10 +45,13 @@ def load_checkpoint(
     """
     import orbax.checkpoint as ocp
 
+    import glob
+
     path = os.path.abspath(path)
-    if path.endswith(".safetensors") or os.path.isfile(
-        os.path.join(path, "model.safetensors.index.json")
-    ):
+    is_safetensors = path.endswith(".safetensors") or (
+        os.path.isdir(path) and glob.glob(os.path.join(path, "*.safetensors"))
+    )
+    if is_safetensors:
         return import_safetensors(path, cfg, dtype)
 
     from .transformer import init_params

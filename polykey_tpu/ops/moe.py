@@ -1,0 +1,116 @@
+"""Mixture-of-Experts layer (Mixtral-style top-k routing).
+
+Two formulations:
+
+- `moe_mlp` — einsum-dense: every token runs through every expert, weighted
+  by the (sparse) combine matrix. Simple, fully differentiable, and shards
+  cleanly: with the expert axis on ``ep`` (parallel/sharding.py), each device
+  computes only its local experts' contributions and XLA reduces the combine
+  over the ep axis — structurally the all-to-all-free "expert-replicated
+  compute" layout. Cost: num_experts/top_k × the FLOPs of sparse dispatch
+  (4× for Mixtral 8×7B's 8-choose-2) — acceptable for correctness paths and
+  small batches.
+- `moe_mlp_dispatch` — capacity-bucketed sparse dispatch: tokens gather into
+  per-expert buckets (static capacity, dropped on overflow like GShard/
+  Switch), experts run batched matmuls on their buckets only, results
+  scatter-combine back. With experts on ``ep`` under jit, XLA emits the
+  token all-to-all over ICI. This is the serving path for real MoE sizes.
+
+Router math in fp32; combine weights renormalized over the selected top-k
+(Mixtral convention).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..models.config import ModelConfig
+from ..models.layers import _activate
+
+
+def _router_weights(
+    layer_params: dict, h: jax.Array, cfg: ModelConfig
+) -> tuple[jax.Array, jax.Array]:
+    """Top-k routing: returns (combine [.., E] fp32, expert_idx [.., k])."""
+    logits = jnp.einsum(
+        "...h,he->...e", h, layer_params["router"],
+        preferred_element_type=jnp.float32,
+    )
+    weights, idx = jax.lax.top_k(logits, cfg.num_experts_per_tok)   # [.., k]
+    weights = jax.nn.softmax(weights, axis=-1)                      # renorm
+    # Dense [.., E] combine matrix: one-hot scatter of the k weights.
+    onehot = jax.nn.one_hot(idx, cfg.num_experts, dtype=jnp.float32)
+    combine = jnp.sum(onehot * weights[..., None], axis=-2)
+    return combine, idx
+
+
+def moe_mlp(layer_params: dict, h: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """Dense-compute MoE: [B, T, H] → [B, T, H]."""
+    combine, _ = _router_weights(layer_params, h, cfg)              # [B,T,E]
+    experts = layer_params["experts"]                               # stacked [E,...]
+
+    up = jnp.einsum("bth,ehi->beti", h, experts["up"])
+    gate = _activate(jnp.einsum("bth,ehi->beti", h, experts["gate"]),
+                     cfg.activation)
+    out = jnp.einsum("beti,eih->beth", gate * up, experts["down"])  # [B,E,T,H]
+    return jnp.einsum(
+        "beth,bte->bth", out.astype(jnp.float32), combine
+    ).astype(h.dtype)
+
+
+def moe_mlp_dispatch(
+    layer_params: dict,
+    h: jax.Array,                   # [B, T, H]
+    cfg: ModelConfig,
+    capacity_factor: float = 1.25,
+) -> jax.Array:
+    """Capacity-bucketed sparse dispatch (GShard-style).
+
+    Static shapes: each expert processes a fixed-capacity bucket
+    C = ceil(tokens · k / E · capacity_factor); tokens beyond an expert's
+    capacity are dropped (their combine weight contributes nothing — the
+    residual connection carries them).
+    """
+    B, T, H = h.shape
+    E, k = cfg.num_experts, cfg.num_experts_per_tok
+    tokens = h.reshape(B * T, H)
+    N = B * T
+    capacity = max(1, int(N * k / E * capacity_factor))
+
+    combine, idx = _router_weights(layer_params, tokens, cfg)       # [N,E],[N,k]
+
+    # Position of each (token, choice) within its expert's bucket.
+    onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)                # [N,k,E]
+    flat_choice = onehot.reshape(N * k, E)
+    position = jnp.cumsum(flat_choice, axis=0) * flat_choice - 1    # [N·k,E]
+    position = position.reshape(N, k, E)
+    slot = jnp.sum(position * onehot, axis=-1)                      # [N,k]
+    expert = idx                                                    # [N,k]
+    keep = slot < capacity
+
+    # Gather tokens into buckets [E, C, H].
+    buckets = jnp.zeros((E, capacity, H), h.dtype)
+    flat_expert = expert.reshape(-1)
+    flat_slot = jnp.where(keep, slot, capacity - 1).reshape(-1)
+    flat_keep = keep.reshape(-1)
+    src = jnp.repeat(tokens, k, axis=0)                             # [N·k,H]
+    src = jnp.where(flat_keep[:, None], src, 0)
+    buckets = buckets.at[flat_expert, flat_slot].add(src)
+
+    # Expert compute on buckets.
+    experts_p = layer_params["experts"]
+    up = jnp.einsum("ech,ehi->eci", buckets, experts_p["up"])
+    gate = _activate(
+        jnp.einsum("ech,ehi->eci", buckets, experts_p["gate"]), cfg.activation
+    )
+    out = jnp.einsum("eci,eih->ech", gate * up, experts_p["down"])  # [E,C,H]
+
+    # Combine back: each (token, choice) reads its bucket slot.
+    gathered = out[flat_expert, flat_slot].reshape(N, k, H)
+    weight = jnp.take_along_axis(combine, expert, axis=-1)          # [N,k]
+    weight = jnp.where(keep, weight, 0.0)
+    mixed = jnp.sum(
+        gathered.astype(jnp.float32) * weight[..., None], axis=1
+    )                                                               # [N,H]
+    return mixed.reshape(B, T, H).astype(h.dtype)

@@ -1,0 +1,13 @@
+"""Parallelism: device mesh + sharding specs over ICI/DCN.
+
+The reference has no distributed backend at all (SURVEY.md §5: no NCCL/MPI/
+Gloo — its only transport is north-south gRPC). The TPU-native equivalent is
+not a comm library but a declaration layer: axes on a `jax.sharding.Mesh`
+(dp/pp/sp/ep/tp) plus PartitionSpecs on parameters and activations; XLA's
+SPMD partitioner inserts the all-gathers/reduce-scatters/all-to-alls that a
+GPU stack would issue through NCCL, and lays them onto ICI (intra-slice axes)
+or DCN (the leading axis under multi-host `jax.distributed`).
+"""
+
+from .mesh import MeshConfig, create_mesh  # noqa: F401
+from .sharding import param_shardings, shard_params  # noqa: F401

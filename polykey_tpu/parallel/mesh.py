@@ -1,0 +1,76 @@
+"""Device mesh construction: dp × pp × sp × ep × tp axes.
+
+Axis meanings (sizes of 1 leave an axis declared but unused — specs stay
+uniform across configurations):
+
+- ``dp`` — data parallel: batch (decode slots / train batch) sharding.
+- ``pp`` — pipeline parallel: the stacked layer axis of the parameter pytree
+  is sharded over it (inter-stage memory distribution; layers stream through
+  `lax.scan`).
+- ``sp`` — sequence/context parallel: long-context activation sharding
+  (ring attention rotates KV blocks along this axis — ops/ring_attention.py).
+- ``ep`` — expert parallel: MoE expert axis (Mixtral), token dispatch rides
+  all-to-all over this axis.
+- ``tp`` — tensor parallel: Megatron-style head/hidden sharding. Kept as the
+  *last* (fastest-varying) axis so TP collectives land on adjacent-device ICI
+  links; under multi-host, the leading axes map to DCN.
+
+`jax.distributed.initialize` (multi-host) composes transparently: the same
+axis declaration spans all hosts' devices.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+AXIS_NAMES = ("dp", "pp", "sp", "ep", "tp")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    dp: int = 1
+    pp: int = 1
+    sp: int = 1
+    ep: int = 1
+    tp: int = 1
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        return (self.dp, self.pp, self.sp, self.ep, self.tp)
+
+    @property
+    def num_devices(self) -> int:
+        return math.prod(self.shape)
+
+
+def create_mesh(
+    config: MeshConfig = MeshConfig(),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+    if config.num_devices != len(devices):
+        raise ValueError(
+            f"mesh {dict(zip(AXIS_NAMES, config.shape))} needs "
+            f"{config.num_devices} devices, have {len(devices)}"
+        )
+    if len(devices) == jax.device_count() and devices[0].platform == "tpu":
+        # Topology-aware assignment: keeps tp (innermost) on adjacent chips.
+        device_array = mesh_utils.create_device_mesh(
+            config.shape, devices=np.asarray(devices)
+        )
+    else:
+        device_array = np.asarray(devices).reshape(config.shape)
+    return Mesh(device_array, AXIS_NAMES)
+
+
+def single_device_mesh() -> Mesh:
+    """1-device mesh: all axes size 1 — specs apply, no communication."""
+    return create_mesh(MeshConfig(), devices=jax.devices()[:1])

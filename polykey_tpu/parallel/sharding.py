@@ -1,0 +1,118 @@
+"""Partition specs: how parameters, caches, and activations shard on the mesh.
+
+Megatron-style tensor parallelism for the transformer block: column-parallel
+first matmuls (wq/wk/wv, gate/up shard their *output* features over ``tp``),
+row-parallel second matmuls (wo, down shard their *input* features), so the
+only cross-device traffic per block is the reduce of the row-parallel output
+— which XLA's SPMD partitioner emits as reduce-scatter/all-gather pairs over
+the ICI ``tp`` axis on its own; no hand-written collectives.
+
+Other axes: the stacked layer dim shards over ``pp``; MoE expert dims over
+``ep``; the KV page pool shards its head dim over ``tp``; the decode batch
+shards over ``dp``.
+
+GQA constraint: num_kv_heads must divide by tp (Llama-3-8B: 8 kv heads →
+tp ∈ {1,2,4,8}).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.config import ModelConfig
+
+# Leaf-path (within a layer) → PartitionSpec *without* the leading stacked
+# layer axis (added uniformly below as the pp dimension).
+_LAYER_RULES: dict[tuple[str, ...], P] = {
+    ("attn", "wq"): P(None, "tp"),
+    ("attn", "wk"): P(None, "tp"),
+    ("attn", "wv"): P(None, "tp"),
+    ("attn", "wo"): P("tp", None),
+    ("mlp", "gate"): P(None, "tp"),
+    ("mlp", "up"): P(None, "tp"),
+    ("mlp", "down"): P("tp", None),
+    ("router",): P(None, None),
+    ("experts", "gate"): P("ep", None, "tp"),
+    ("experts", "up"): P("ep", None, "tp"),
+    ("experts", "down"): P("ep", "tp", None),
+    ("ln1",): P(None),
+    ("ln2",): P(None),
+    ("post_ln1",): P(None),
+    ("post_ln2",): P(None),
+}
+
+_TOP_RULES: dict[tuple[str, ...], P] = {
+    ("embed",): P("tp", None),     # vocab-sharded; lookup gathers over tp
+    ("final_norm",): P(None),
+    ("lm_head",): P(None, "tp"),   # logits shard over vocab on tp
+}
+
+
+def _spec_for_path(path: tuple[str, ...]) -> P:
+    if path in _TOP_RULES:
+        return _TOP_RULES[path]
+    if path[0] == "layers":
+        layer_path = path[1:]
+        if layer_path in _LAYER_RULES:
+            inner = _LAYER_RULES[layer_path]
+            return P("pp", *inner)  # leading stacked-layer axis → pp
+    raise KeyError(f"no sharding rule for param path {path}")
+
+
+def _path_keys(path) -> tuple[str, ...]:
+    keys = []
+    for entry in path:
+        if isinstance(entry, jax.tree_util.DictKey):
+            keys.append(str(entry.key))
+        else:
+            keys.append(str(entry))
+    return tuple(keys)
+
+
+def param_shardings(cfg: ModelConfig, mesh: Mesh, params_tree=None):
+    """NamedSharding pytree matching init_params' structure."""
+    if params_tree is None:
+        from ..models.transformer import init_params
+
+        params_tree = jax.eval_shape(
+            lambda: init_params(jax.random.PRNGKey(0), cfg)
+        )
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: NamedSharding(mesh, _spec_for_path(_path_keys(path))),
+        params_tree,
+    )
+
+
+def shard_params(params: dict, cfg: ModelConfig, mesh: Mesh) -> dict:
+    """Place a param pytree onto the mesh under the TP/PP/EP specs."""
+    return jax.device_put(params, param_shardings(cfg, mesh, params))
+
+
+def paged_kv_sharding(mesh: Mesh) -> NamedSharding:
+    """Page pools [L, N, page_size, Hk, D]: heads shard over tp.
+
+    Pages are *not* dp-sharded: any decode slot may hold any page, so the
+    pool replicates over dp (each dp replica serves its own slot subset with
+    its own pool in the dp>1 serving layout).
+    """
+    return NamedSharding(mesh, P("pp", None, None, "tp", None))
+
+
+def contiguous_kv_sharding(mesh: Mesh) -> NamedSharding:
+    """Contiguous cache [L, B, S, Hk, D]: batch over dp, heads over tp."""
+    return NamedSharding(mesh, P("pp", "dp", None, "tp", None))
+
+
+def batch_sharding(mesh: Mesh, ndim: int, seq_axis: Optional[int] = None):
+    """Token batches [B, T, ...]: batch over dp, optionally T over sp."""
+    spec = ["dp"] + [None] * (ndim - 1)
+    if seq_axis is not None:
+        spec[seq_axis] = "sp"
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

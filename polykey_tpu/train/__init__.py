@@ -1,0 +1,9 @@
+"""Fine-tuning: sharded train step (loss, grads, optimizer) over the mesh.
+
+The reference serves only (no training anywhere); this module exists so the
+framework covers the fine-tune half of the model lifecycle and so multi-chip
+shardings are exercised end-to-end (grads and optimizer state inherit the
+parameter specs; batch shards over dp, sequence over sp).
+"""
+
+from .train import TrainState, cross_entropy_loss, make_train_step  # noqa: F401

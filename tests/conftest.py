@@ -1,15 +1,17 @@
 """Test-session bootstrap.
 
-Forces JAX onto a simulated 8-device CPU platform *before* jax is imported
-anywhere, so multi-chip sharding (tp/dp/ep/sp axes over a Mesh) is exercised
-without TPU hardware — the strategy SURVEY.md §4 prescribes for this
-framework's multi-node tier.
+Forces JAX onto a simulated 8-device CPU platform so multi-chip sharding
+(tp/dp/ep/sp axes over a Mesh) is exercised without TPU hardware — the
+strategy SURVEY.md §4 prescribes for this framework's multi-node tier.
+
+Note: this image pre-imports a TPU platform plugin and pins JAX_PLATFORMS in
+the environment, so plain env vars are not enough — XLA_FLAGS must be set
+before backend init AND the platform must be overridden via jax.config.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -17,3 +19,7 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
