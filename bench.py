@@ -1,0 +1,85 @@
+"""Benchmark harness: single-chip generation throughput.
+
+Prints exactly ONE JSON line to stdout:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The north-star target (BASELINE.md) is >= 2,000 tok/s/chip greedy decode at
+8B on v5e. One v5e chip has 16 GiB HBM, so bf16 8B weights alone fill it;
+the harness benches the llama-1b-bench config (models/config.py) by default
+and reports vs_baseline = value / 2000 against the 8B target so the driver
+has a stable, monotonic number to track across rounds.
+
+Measures the fused generate path (models/generate.py: jitted prefill +
+lax.scan decode, one dispatch for the whole sequence), end-to-end including
+prefill. Sync is via device_get of the result — block_until_ready alone does
+not drain the axon-tunnel queue on this image.
+
+Knobs (env): POLYKEY_BENCH_MODEL, POLYKEY_BENCH_BATCH, POLYKEY_BENCH_PROMPT,
+POLYKEY_BENCH_NEW_TOKENS.
+
+All progress chatter goes to stderr; stdout carries only the JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    from polykey_tpu.engine.sampling import SamplingParams
+    from polykey_tpu.models.config import get_config
+    from polykey_tpu.models.generate import generate
+    from polykey_tpu.models.transformer import init_params
+
+    model_name = os.environ.get("POLYKEY_BENCH_MODEL", "llama-1b-bench")
+    B = int(os.environ.get("POLYKEY_BENCH_BATCH", "64"))
+    T = int(os.environ.get("POLYKEY_BENCH_PROMPT", "128"))
+    N = int(os.environ.get("POLYKEY_BENCH_NEW_TOKENS", "128"))
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} ({dev.device_kind})")
+    cfg = get_config(model_name)
+    log(f"model: {cfg.name} ({cfg.num_params() / 1e9:.2f}B params), "
+        f"batch={B} prompt={T} new_tokens={N}")
+
+    key = jax.random.PRNGKey(0)
+    params = init_params(key, cfg, jnp.bfloat16)
+    tokens = jax.random.randint(key, (B, T), 0, cfg.vocab_size, jnp.int32)
+    seq_lens = jnp.full((B,), T, jnp.int32)
+    sampling = SamplingParams(max_new_tokens=N)
+
+    t0 = time.perf_counter()
+    _, num = generate(params, cfg, tokens, seq_lens, key, sampling, max_len=T + N)
+    jax.device_get(num)
+    log(f"warmup (incl. compile): {time.perf_counter() - t0:.2f}s")
+
+    t0 = time.perf_counter()
+    _, num = generate(params, cfg, tokens, seq_lens, key, sampling, max_len=T + N)
+    jax.device_get(num)
+    elapsed = time.perf_counter() - t0
+
+    tok_s = B * N / elapsed
+    log(f"generate: batch {B} x {N} tokens in {elapsed:.3f}s -> {tok_s:.1f} tok/s "
+        "(end-to-end incl. prefill)")
+
+    baseline = 2000.0  # BASELINE.md north star: tok/s/chip, 8B greedy on v5e
+    print(json.dumps({
+        "metric": f"{cfg.name}_generate_tok_s_per_chip",
+        "value": round(tok_s, 1),
+        "unit": "tok/s",
+        "vs_baseline": round(tok_s / baseline, 3),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

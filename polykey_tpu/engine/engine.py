@@ -178,10 +178,6 @@ class InferenceEngine:
         if self._stop.is_set():
             raise EngineDeadError("engine is shut down")
         self.metrics.on_admit()
-        # A fresh submission also resets the stall clock: the engine may have
-        # been idle for longer than the watchdog window, and idle time is not
-        # a stall.
-        self.last_progress = time.monotonic()
         self._submit.put(request)
         self._wake.set()
         # Close the submit/shutdown race: if the engine died or stopped
@@ -234,6 +230,11 @@ class InferenceEngine:
                 else:
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
+                    # Idle time is not a stall: only the engine thread itself
+                    # may refresh the stall clock (a submit() reset would let
+                    # steady client traffic suppress the watchdog during a
+                    # genuine device hang mid-_step).
+                    self.last_progress = time.monotonic()
             self._fail_all("engine is shut down")
         except Exception as e:  # engine thread must never die silently
             self.dead = f"engine loop crashed: {e}"

@@ -37,6 +37,11 @@ class ModelConfig:
     # MoE (Mixtral) specifics
     num_experts: int = 0
     num_experts_per_tok: int = 0
+    # Capacity-bucketed sparse dispatch (ops/moe.py: moe_mlp_dispatch) instead
+    # of the einsum-dense formulation. On for real MoE sizes — dense pays
+    # num_experts/top_k x the dispatch FLOPs; off for tiny test configs,
+    # where dispatch's token-drop-on-overflow would perturb exactness checks.
+    moe_dispatch: bool = False
 
     @property
     def is_moe(self) -> bool:
@@ -118,6 +123,7 @@ MIXTRAL_8X7B = ModelConfig(
     rope_theta=1_000_000.0,
     num_experts=8,
     num_experts_per_tok=2,
+    moe_dispatch=True,
 )
 
 GEMMA2_27B = ModelConfig(

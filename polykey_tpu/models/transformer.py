@@ -110,8 +110,10 @@ def init_params(key: jax.Array, cfg: ModelConfig, dtype=jnp.bfloat16) -> dict:
 
 
 def _moe_mlp(layer_params: dict, h: jax.Array, cfg: ModelConfig) -> jax.Array:
-    from ..ops.moe import moe_mlp  # deferred: keeps dense path import-light
+    from ..ops.moe import moe_mlp, moe_mlp_dispatch  # deferred import
 
+    if cfg.moe_dispatch:
+        return moe_mlp_dispatch(layer_params, h, cfg)
     return moe_mlp(layer_params, h, cfg)
 
 

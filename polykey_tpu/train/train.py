@@ -56,10 +56,12 @@ def make_train_step(
     mesh: Mesh,
     optimizer: Optional[optax.GradientTransformation] = None,
 ):
-    """Returns (init_state, train_step) bound to the mesh.
+    """Returns (init_state, train_step, shard_batch) bound to the mesh.
 
     init_state places params/opt-state under their specs; train_step is
-    jitted with donated state, so the optimizer update is in-place on device.
+    jitted with donated state, so the optimizer update is in-place on device;
+    shard_batch places (tokens, targets, positions) under the batch specs
+    (dp-sharded batch axis, sp-sharded sequence axis).
     """
     if optimizer is None:
         optimizer = optax.adamw(learning_rate=1e-4, weight_decay=0.01)
