@@ -196,18 +196,18 @@ def forward(
     q_pos = positions[:, :, None]                       # [B, T, 1]
 
     if use_cache:
-        kv_pos = jnp.arange(cache.num_slots, dtype=jnp.int32)[None, None, :]
+        # Inference-only path → flash kernel is safe (no VJP needed); it
+        # falls back to the reference attention off-TPU and for tiny shapes.
+        from ..ops.flash_attention import flash_attention
 
         def attend(layer_idx, q, k, v, kc, vc):
             kc = kc.at[batch_idx, positions].set(k)
             vc = vc.at[batch_idx, positions].set(v)
-            mask = kv_pos <= q_pos
-            window = _layer_window(cfg, layer_idx)
-            if window is not None:
-                mask &= kv_pos > q_pos - window
-            ctx = attention(
-                q, kc, vc, mask,
-                scale=cfg.q_scale, logit_softcap=cfg.attn_logit_softcap,
+            ctx = flash_attention(
+                q, kc, vc, positions,
+                scale=cfg.q_scale,
+                logit_softcap=cfg.attn_logit_softcap,
+                window=_layer_window(cfg, layer_idx),
             )
             return ctx, kc, vc
 
@@ -250,10 +250,17 @@ def forward_paged(
     prefill (T = prompt bucket) and batched decode (T = 1).
     """
     from ..ops.paged_attention import paged_attention, paged_write
+    from ..ops.paged_attention_kernel import paged_attention_decode
+
+    decode = tokens.shape[1] == 1
 
     def attend(layer_idx, q, k, v, kc, vc):
         kc, vc = paged_write(kc, vc, k, v, page_tables, positions)
-        ctx = paged_attention(
+        # Single-token steps take the DMA decode kernel (reads only valid
+        # pages); prefill buckets take the gather path (wide T amortizes
+        # the window materialization, and flash covers contiguous prefill).
+        op = paged_attention_decode if decode else paged_attention
+        ctx = op(
             q, kc, vc, page_tables, positions,
             scale=cfg.q_scale,
             logit_softcap=cfg.attn_logit_softcap,

@@ -52,15 +52,19 @@ def paged_attention(
     """Attention over paged KV; returns [B, T, Hq, D].
 
     Slot j of the gathered window holds position j, so the absolute-position
-    causal mask simultaneously hides unwritten slots and garbage-page tails.
+    causal mask simultaneously hides unwritten slots and garbage-page tails —
+    which also makes the gathered window a valid input for the blockwise
+    flash kernel (ops/flash_attention.py): on TPU at prefill widths it takes
+    the O(T·D + S·D)-traffic path instead of materializing [.., T, S] logits;
+    off-TPU / tiny shapes it falls back to the reference mask internally.
     """
+    from .flash_attention import flash_attention
+
     k, v = paged_gather_kv(k_pages, v_pages, page_tables)
-    S = k.shape[1]
-    kv_pos = jnp.arange(S, dtype=jnp.int32)[None, None, :]
-    mask = kv_pos <= q_positions[:, :, None]
-    if window is not None:
-        mask &= kv_pos > q_positions[:, :, None] - window
-    return attention(q, k, v, mask, scale=scale, logit_softcap=logit_softcap)
+    return flash_attention(
+        q, k, v, q_positions,
+        scale=scale, logit_softcap=logit_softcap, window=window,
+    )
 
 
 def paged_write(

@@ -1,0 +1,275 @@
+"""Pallas paged-attention decode kernel (TPU).
+
+The gather path (ops/paged_attention.py) materializes each sequence's KV
+window in HBM every decode step: `k_pages[page_tables]` reads the pages AND
+writes a [B, P·page_size, Hk, D] copy, so the cache crosses HBM twice. This
+kernel reads each valid page exactly once: one grid program per sequence,
+a double-buffered DMA loop streams that sequence's pages HBM → VMEM while
+the previous page's block attention accumulates into online-softmax state
+(running max m, denominator l, fp32 accumulator) — the same recurrence as
+ops/flash_attention.py, one page per block.
+
+Invalid page-table tails (the reserved garbage page 0) are never DMA'd:
+the loop bound is ceil((position+1)/page_size), data-dependent per sequence,
+and Gemma-2 sliding-window layers also skip pages wholly below
+position - window.
+
+Covers GQA, logit soft-capping, and dynamic sliding windows; falls back to
+the gather implementation off-TPU (`use_kernel` dispatch in
+paged_attention_decode).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _kernel(
+    # scalar prefetch
+    pt_ref,        # [B, P] int32 page tables
+    pos_ref,       # [B] int32 decode position per sequence
+    win_ref,       # [1] int32 sliding window (<=0 → global)
+    # inputs
+    q_ref,         # [1, Hq, D] VMEM block
+    k_pages_ref,   # [N, ps, Hk·D] HBM (heads folded into lanes; manual DMA)
+    v_pages_ref,   # [N, ps, Hk·D] HBM
+    # output
+    out_ref,       # [1, Hq, D]
+    # scratch
+    k_buf,         # [2, ps, Hk·D] VMEM
+    v_buf,
+    k_sems,        # DMA semaphores (2,)
+    v_sems,
+    *,
+    scale: float,
+    logit_softcap: Optional[float],
+    page_size: int,
+    num_tables: int,   # P — static max pages per sequence
+    groups: int,       # Hq // Hk
+):
+    b = pl.program_id(0)
+    q_pos = pos_ref[b]
+    window = win_ref[0]
+
+    # Pages [lo, hi) hold positions visible to this query.
+    hi = jax.lax.div(q_pos, page_size) + 1
+    lo = jnp.where(
+        window > 0,
+        jnp.maximum(jax.lax.div(q_pos - window + 1, page_size), 0),
+        0,
+    )
+
+    def page_dma(p, slot, pages_ref, buf, sems):
+        return pltpu.make_async_copy(
+            pages_ref.at[pt_ref[b, p]], buf.at[slot], sems.at[slot]
+        )
+
+    def start(p, slot):
+        page_dma(p, slot, k_pages_ref, k_buf, k_sems).start()
+        page_dma(p, slot, v_pages_ref, v_buf, v_sems).start()
+
+    def wait(p, slot):
+        page_dma(p, slot, k_pages_ref, k_buf, k_sems).wait()
+        page_dma(p, slot, v_pages_ref, v_buf, v_sems).wait()
+
+    @pl.when(lo < hi)
+    def _first():
+        start(lo, lo % 2)
+
+    Hq, D = q_ref.shape[1], q_ref.shape[2]
+    q = q_ref[0].astype(jnp.float32) * scale                  # [Hq, D]
+
+    def body(p, carry):
+        m, l, acc = carry
+
+        def run(carry):
+            m, l, acc = carry
+            slot = p % 2
+
+            @pl.when(p + 1 < hi)
+            def _next():
+                start(p + 1, (p + 1) % 2)
+
+            wait(p, slot)
+            # Buffers hold [ps, Hk*D] (heads folded into lanes so the DMA
+            # slice stays 128-aligned for any head_dim); per-head slices are
+            # taken in-register.
+            k = k_buf[slot]                                   # [ps, Hk*D]
+            v = v_buf[slot]
+            D = q.shape[1]
+            num_kv = k.shape[1] // D
+            # Mosaic lowers only plain 2D matmuls — unroll over kv heads
+            # (q head h ↔ kv head h//groups, heads grouped contiguously).
+            s = jnp.concatenate(
+                [
+                    jax.lax.dot_general(
+                        q[h * groups:(h + 1) * groups],       # [g, D]
+                        k[:, h * D:(h + 1) * D].astype(jnp.float32),
+                        dimension_numbers=(((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                    for h in range(num_kv)
+                ],
+                axis=0,
+            )                                                 # [Hq, ps]
+            if logit_softcap is not None:
+                s = logit_softcap * jnp.tanh(s / logit_softcap)
+
+            kv_pos = p * page_size + jax.lax.broadcasted_iota(
+                jnp.int32, (Hq, page_size), dimension=1
+            )
+            mask = kv_pos <= q_pos
+            mask &= (window <= 0) | (kv_pos > q_pos - window)
+            s = jnp.where(mask, s, _NEG_INF)
+
+            m_cur = jnp.max(s, axis=1, keepdims=True)         # [Hq, 1]
+            m_new = jnp.maximum(m, m_cur)
+            pexp = jnp.where(mask, jnp.exp(s - m_new), 0.0)   # [Hq, ps]
+            corr = jnp.exp(m - m_new)
+            l_new = corr * l + jnp.sum(pexp, axis=1, keepdims=True)
+            pv = jnp.concatenate(
+                [
+                    jax.lax.dot_general(
+                        pexp[h * groups:(h + 1) * groups],    # [g, ps]
+                        v[:, h * D:(h + 1) * D].astype(jnp.float32),
+                        dimension_numbers=(((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                    for h in range(num_kv)
+                ],
+                axis=0,
+            )                                                 # [Hq, D]
+            acc_new = acc * corr + pv
+            return m_new, l_new, acc_new
+
+        return jax.lax.cond(
+            (p >= lo) & (p < hi), run, lambda c: c, carry
+        )
+
+    m0 = jnp.full((Hq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((Hq, 1), jnp.float32)
+    acc0 = jnp.zeros((Hq, D), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, num_tables, body, (m0, l0, acc0))
+
+    out_ref[0] = (acc / jnp.maximum(l, 1e-9)).astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "logit_softcap", "interpret"),
+)
+def _decode_call(
+    q: jax.Array,             # [B, Hq, D]
+    k_pages: jax.Array,       # [N, ps, Hk, D]
+    v_pages: jax.Array,
+    page_tables: jax.Array,   # [B, P] int32
+    positions: jax.Array,     # [B] int32
+    window: jax.Array,        # [1] int32
+    *,
+    scale: float,
+    logit_softcap: Optional[float],
+    interpret: bool,
+) -> jax.Array:
+    B, Hq, D = q.shape
+    N, ps, Hk, _ = k_pages.shape
+    P = page_tables.shape[1]
+    # Fold heads into the lane dimension: [N, ps, Hk·D] keeps every DMA
+    # slice 128-aligned regardless of head_dim (a contiguous reshape).
+    k_pages = k_pages.reshape(N, ps, Hk * D)
+    v_pages = v_pages.reshape(N, ps, Hk * D)
+
+    kernel = functools.partial(
+        _kernel,
+        scale=scale,
+        logit_softcap=logit_softcap,
+        page_size=ps,
+        num_tables=P,
+        groups=Hq // Hk,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, ps, Hk * D), k_pages.dtype),
+            pltpu.VMEM((2, ps, Hk * D), k_pages.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(
+        page_tables.astype(jnp.int32),
+        positions.astype(jnp.int32),
+        window,
+        q,
+        k_pages,
+        v_pages,
+    )
+
+
+def use_paged_kernel(num_kv_heads: int, head_dim: int) -> bool:
+    """The DMA kernel needs TPU hardware; the folded head-lane dimension
+    (num_kv_heads · head_dim) must be 128-aligned for DMA tiling."""
+    return jax.default_backend() == "tpu" and (num_kv_heads * head_dim) % 128 == 0
+
+
+def paged_attention_decode(
+    q: jax.Array,             # [B, 1, Hq, D] (single decode step)
+    k_pages: jax.Array,       # [N, ps, Hk, D]
+    v_pages: jax.Array,
+    page_tables: jax.Array,   # [B, P]
+    q_positions: jax.Array,   # [B, 1] absolute positions
+    *,
+    scale: float,
+    logit_softcap: Optional[float] = None,
+    window: Optional[jax.Array] = None,
+    interpret: bool = False,
+    force_kernel: bool = False,
+) -> jax.Array:
+    """Decode-step paged attention; returns [B, 1, Hq, D].
+
+    Same contract as ops/paged_attention.paged_attention restricted to T=1.
+    """
+    B = q.shape[0]
+    Hk, D = k_pages.shape[2], k_pages.shape[3]
+
+    if not (force_kernel or interpret or use_paged_kernel(Hk, D)):
+        from .paged_attention import paged_attention
+
+        return paged_attention(
+            q, k_pages, v_pages, page_tables, q_positions,
+            scale=scale, logit_softcap=logit_softcap, window=window,
+        )
+
+    if window is None:
+        win = jnp.zeros((1,), jnp.int32)
+    else:
+        win = jnp.asarray(window, jnp.int32).reshape(1)
+
+    out = _decode_call(
+        q[:, 0], k_pages, v_pages, page_tables,
+        q_positions[:, 0].astype(jnp.int32), win,
+        scale=scale, logit_softcap=logit_softcap, interpret=interpret,
+    )
+    return out[:, None]
