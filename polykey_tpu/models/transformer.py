@@ -182,16 +182,24 @@ def forward(
     tokens: jax.Array,               # [B, T] int32, right-padded
     positions: jax.Array,            # [B, T] absolute positions
     cache: Optional[KVCache] = None,
+    attn_override=None,              # (layer_idx, q, k, v) → ctx; no-cache only
 ) -> tuple[jax.Array, Optional[KVCache]]:
     """Run the stack; returns (hidden [B, T, H], updated cache).
 
     With a cache: new K/V are written at their absolute positions and
     attention spans all cache slots — prefill and decode share this path.
     Without a cache (training / one-shot scoring): attention spans the
-    current sequence only.
+    current sequence only; `attn_override` swaps the attention computation
+    (the sequence-parallel ring path, ops/ring_attention.py, mounts here).
     """
     B, T = tokens.shape
     use_cache = cache is not None
+    if use_cache and attn_override is not None:
+        raise ValueError(
+            "attn_override applies to the no-cache path only (the cached "
+            "path would silently ignore it and run full attention over the "
+            "gathered cache, defeating the override's purpose)"
+        )
     batch_idx = jnp.arange(B, dtype=jnp.int32)[:, None]
     q_pos = positions[:, :, None]                       # [B, T, 1]
 
@@ -216,6 +224,8 @@ def forward(
         kv_pos = positions[:, None, :]                  # kv = current tokens
 
         def attend(layer_idx, q, k, v, kc, vc):
+            if attn_override is not None:
+                return attn_override(layer_idx, q, k, v), kc, vc
             mask = kv_pos <= q_pos
             window = _layer_window(cfg, layer_idx)
             if window is not None:
@@ -272,6 +282,30 @@ def forward_paged(
         params, cfg, tokens, positions, (paged.k, paged.v), attend
     )
     return x, type(paged)(k=new_k, v=new_v)
+
+
+def make_ring_override(cfg: ModelConfig, mesh, positions: jax.Array):
+    """Build an attn_override routing attention through the sequence-
+    parallel ring path (ops/ring_attention.py) over the mesh's sp axis.
+
+    Lives here so the attention-parameter wiring (q_scale, soft-cap,
+    per-layer window interleaving) stays in one module with the dense
+    attend closures; callers (train/train.py) just mount the result.
+    Returns None when the mesh has no sp extent.
+    """
+    if mesh is None or mesh.shape.get("sp", 1) <= 1:
+        return None
+    from ..ops.ring_attention import ring_attention_spmd
+
+    def override(layer_idx, q, k, v):
+        return ring_attention_spmd(
+            q, k, v, positions, positions, mesh,
+            scale=cfg.q_scale,
+            logit_softcap=cfg.attn_logit_softcap,
+            window=_layer_window(cfg, layer_idx),
+        )
+
+    return override
 
 
 def unembed(params: dict, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:

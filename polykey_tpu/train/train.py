@@ -21,7 +21,7 @@ from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.config import ModelConfig
-from ..models.transformer import forward, unembed
+from ..models.transformer import forward, make_ring_override, unembed
 from ..parallel.sharding import batch_sharding, param_shardings
 
 
@@ -38,9 +38,15 @@ def cross_entropy_loss(
     tokens: jax.Array,       # [B, T] input ids
     targets: jax.Array,      # [B, T] next-token ids (-1 → masked)
     positions: jax.Array,    # [B, T]
+    ring_mesh: Optional[Mesh] = None,
 ) -> jax.Array:
+    """Next-token cross-entropy. With `ring_mesh`, attention runs as
+    sequence-parallel ring attention over the mesh's sp axis
+    (ops/ring_attention.py) — KV chunks rotate over ICI instead of XLA
+    all-gathering the full sequence per device."""
+    attn_override = make_ring_override(cfg, ring_mesh, positions)
     checkpointed = jax.checkpoint(
-        lambda p, t, pos: forward(p, cfg, t, pos, None)[0]
+        lambda p, t, pos: forward(p, cfg, t, pos, None, attn_override)[0]
     )
     hidden = checkpointed(params, tokens, positions)
     logits = unembed(params, cfg, hidden)          # [B, T, V] fp32
@@ -78,10 +84,12 @@ def make_train_step(
             step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state
         )
 
+    ring_mesh = mesh if mesh.shape.get("sp", 1) > 1 else None
+
     @partial(jax.jit, donate_argnames=("state",))
     def train_step(state: TrainState, tokens, targets, positions):
         loss, grads = jax.value_and_grad(cross_entropy_loss)(
-            state.params, cfg, tokens, targets, positions
+            state.params, cfg, tokens, targets, positions, ring_mesh
         )
         updates, opt_state = optimizer.update(
             grads, state.opt_state, state.params

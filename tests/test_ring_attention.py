@@ -1,0 +1,94 @@
+"""Ring attention tests: parity vs full attention on a simulated mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from polykey_tpu.ops.attention import attention, make_attention_mask
+from polykey_tpu.ops.ring_attention import ring_attention_spmd
+
+TOL = 2e-5
+
+
+def _case(B, T, Hq, Hk, D, seed=0):
+    return (
+        jax.random.normal(jax.random.PRNGKey(seed), (B, T, Hq, D), jnp.float32),
+        jax.random.normal(jax.random.PRNGKey(seed + 1), (B, T, Hk, D), jnp.float32),
+        jax.random.normal(jax.random.PRNGKey(seed + 2), (B, T, Hk, D), jnp.float32),
+    )
+
+
+@pytest.mark.parametrize("softcap,win", [
+    (None, None), (50.0, None), (None, 24), (30.0, 24),
+])
+def test_ring_matches_full_attention(softcap, win):
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dp", "sp"))
+    B, T, Hq, Hk, D = 2, 64, 4, 2, 32
+    q, k, v = _case(B, T, Hq, Hk, D)
+    pos = jnp.broadcast_to(jnp.arange(T), (B, T))
+
+    mask = make_attention_mask(pos, T, sliding_window=win)
+    ref = attention(q, k, v, mask, scale=0.2, logit_softcap=softcap)
+    w = None if win is None else jnp.int32(win)
+    out = ring_attention_spmd(
+        q, k, v, pos, pos, mesh, scale=0.2, logit_softcap=softcap,
+        window=w, head_axis=None,
+    )
+    assert float(jnp.max(jnp.abs(ref - out))) < TOL
+
+
+def test_ring_with_tp_head_sharding():
+    """Heads sharded over tp inside the same shard_map (GQA: kv heads must
+    divide the tp axis — contiguous head blocks keep q↔kv group alignment)."""
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2), ("dp", "sp", "tp"))
+    B, T, Hq, Hk, D = 2, 32, 8, 2, 16
+    q, k, v = _case(B, T, Hq, Hk, D)
+    pos = jnp.broadcast_to(jnp.arange(T), (B, T))
+
+    ref = attention(q, k, v, make_attention_mask(pos, T), scale=0.25)
+    out = ring_attention_spmd(q, k, v, pos, pos, mesh, scale=0.25)
+    assert float(jnp.max(jnp.abs(ref - out))) < TOL
+
+
+def test_ring_with_offset_positions():
+    """Positions that do not start at 0 (packed/continued sequences)."""
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(1, 4), ("dp", "sp"))
+    B, T, Hq, Hk, D = 1, 64, 2, 2, 16
+    q, k, v = _case(B, T, Hq, Hk, D)
+    pos = jnp.broadcast_to(jnp.arange(T), (B, T)) + 100
+
+    ref = attention(q, k, v, make_attention_mask(pos, T), scale=0.25)
+    # kv slot j holds position 100 + j here, so the reference mask
+    # (kv slot index vs absolute q position) is wrong; build it explicitly.
+    kv_pos = pos[:, None, :]
+    mask = kv_pos <= pos[:, :, None]
+    ref = attention(q, k, v, mask, scale=0.25)
+    out = ring_attention_spmd(q, k, v, pos, pos, mesh, scale=0.25,
+                              head_axis=None)
+    assert float(jnp.max(jnp.abs(ref - out))) < TOL
+
+
+def test_ring_gradients_flow():
+    """ppermute/online-softmax must be differentiable end to end."""
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(1, 4), ("dp", "sp"))
+    B, T, Hq, Hk, D = 1, 32, 2, 1, 16
+    q, k, v = _case(B, T, Hq, Hk, D)
+    pos = jnp.broadcast_to(jnp.arange(T), (B, T))
+
+    def loss_ring(q, k, v):
+        return jnp.sum(
+            ring_attention_spmd(q, k, v, pos, pos, mesh, scale=0.25,
+                                head_axis=None) ** 2
+        )
+
+    def loss_ref(q, k, v):
+        return jnp.sum(
+            attention(q, k, v, make_attention_mask(pos, T), scale=0.25) ** 2
+        )
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        assert float(jnp.max(jnp.abs(a - b))) < 1e-4
