@@ -1,0 +1,222 @@
+"""Speculative decoding: draft/verify generation (measurement config 5).
+
+A small draft model proposes `gamma` tokens autoregressively; the target
+model scores the whole proposal in ONE forward pass (gamma+1 positions —
+prefill-shaped work that uses the MXU efficiently instead of gamma separate
+bandwidth-bound decode steps); a prefix is accepted and one extra token is
+emitted at the first mismatch (greedy) / rejection (sampled). Guarantees:
+
+- temperature == 0: output is EXACTLY the target model's greedy decode,
+  for any draft model (verified in tests/test_speculative.py).
+- temperature > 0: standard rejection sampling [Leviathan et al.] — accept
+  draft token x with prob min(1, p_t(x)/p_d(x)), else resample from the
+  normalized residual max(p_t - p_d, 0); the output distribution equals
+  target-only sampling. top_p and top_k are intentionally unsupported here
+  (truncation filters break the residual-distribution identity);
+  SamplingParams.top_p / .top_k are both ignored in this path.
+
+TPU-shape design: everything is fixed-shape under one jit. Per-row
+divergence (different acceptance counts) is data, not shape: positions,
+done flags, and output counts are [B] arrays, and KV caches are slot-per-
+position (models/transformer.py), so stale entries written for rejected
+draft tokens are simply overwritten when the row's position catches up —
+no cache rewind is needed (slot s is only ever attended once position > s,
+and by then the accepted token's KV has been rewritten there).
+
+No analog exists in the reference (SURVEY.md §2b lists speculative decoding
+as absent); the design follows the north star + PAPERS.md patterns.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..engine.sampling import SamplingParams
+from .config import ModelConfig
+from .transformer import KVCache, forward, init_cache, unembed
+
+
+def _token_probs(logits: jax.Array, temperature: float) -> jax.Array:
+    """[.., V] fp32 probabilities at the given temperature."""
+    return jax.nn.softmax(logits / jnp.maximum(temperature, 1e-6), axis=-1)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("target_cfg", "draft_cfg", "sampling", "max_len", "gamma"),
+)
+def speculative_generate(
+    target_params: dict,
+    target_cfg: ModelConfig,
+    draft_params: dict,
+    draft_cfg: ModelConfig,
+    tokens: jax.Array,        # [B, T] right-padded prompts
+    seq_lens: jax.Array,      # [B]
+    key: jax.Array,
+    sampling: SamplingParams,
+    max_len: int,
+    gamma: int = 4,
+    eos_id: int = -1,
+) -> tuple[jax.Array, jax.Array]:
+    """Draft/verify generation; same contract as models/generate.generate:
+    returns (generated [B, max_new_tokens] int32, num_generated [B])."""
+    B, T = tokens.shape
+    max_new = sampling.max_new_tokens
+    greedy = sampling.temperature == 0.0
+    # +gamma: the final verify window may draft past the last emitted token;
+    # those cache writes must land in real slots (JAX clamps OOB scatters,
+    # which would corrupt the last slot).
+    if T + max_new + gamma > max_len:
+        raise ValueError(
+            f"cache too small: prompt window {T} + max_new_tokens {max_new} "
+            f"+ gamma {gamma} exceeds max_len {max_len}"
+        )
+
+    t_dtype = target_params["embed"].dtype
+    d_dtype = draft_params["embed"].dtype
+    t_cache = init_cache(target_cfg, B, max_len, t_dtype)
+    d_cache = init_cache(draft_cfg, B, max_len, d_dtype)
+
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    rows = jnp.arange(B, dtype=jnp.int32)
+
+    # Prefill both models; sample the first token from the TARGET.
+    t_hidden, t_cache = forward(
+        target_params, target_cfg, tokens, positions, t_cache
+    )
+    _, d_cache = forward(draft_params, draft_cfg, tokens, positions, d_cache)
+    t_logits = unembed(target_params, target_cfg, t_hidden[rows, seq_lens - 1])
+
+    key, k0 = jax.random.split(key)
+    if greedy:
+        first = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+    else:
+        first = jax.random.categorical(
+            k0, t_logits / sampling.temperature, axis=-1
+        ).astype(jnp.int32)
+
+    out_buf = jnp.full((B, max_new), eos_id, jnp.int32)
+    out_buf = out_buf.at[:, 0].set(first)
+    counts = jnp.ones((B,), jnp.int32)
+    done = first == eos_id
+    prev = first                      # last emitted token per row
+    pos = seq_lens                    # position of `prev`
+
+    def draft_step(carry, _):
+        d_cache, tok, p, key = carry
+        key, k = jax.random.split(key)
+        hidden, d_cache = forward(
+            draft_params, draft_cfg, tok[:, None], p[:, None], d_cache
+        )
+        logits = unembed(draft_params, draft_cfg, hidden[:, 0])
+        if greedy:
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            dist = jnp.zeros((B, 0), jnp.float32)     # unused in greedy mode
+        else:
+            dist = _token_probs(logits, sampling.temperature)  # [B, V]
+            nxt = jax.random.categorical(
+                k, logits / sampling.temperature, axis=-1
+            ).astype(jnp.int32)
+        return (d_cache, nxt, p + 1, key), (nxt, dist)
+
+    def cond(state):
+        _, _, _, _, _, done, _, _, it = state
+        return (~done.all()) & (it < max_new)
+
+    def body(state):
+        t_cache, d_cache, out_buf, counts, prev, done, pos, key, it = state
+
+        # --- Draft gamma tokens (autoregressive, consumes prev → drafts). --
+        key, kd = jax.random.split(key)
+        (d_cache, _, _, _), (drafts, d_dists) = jax.lax.scan(
+            draft_step, (d_cache, prev, pos, kd), None, length=gamma
+        )
+        drafts = drafts.T                              # [B, gamma]
+        d_dists = jnp.swapaxes(d_dists, 0, 1)          # [B, gamma, V] (sampled)
+
+        # --- Verify: ONE target forward over [prev, drafts] (gamma+1). ----
+        window = jnp.concatenate([prev[:, None], drafts], axis=1)
+        w_pos = pos[:, None] + jnp.arange(gamma + 1, dtype=jnp.int32)[None, :]
+        t_hidden, t_cache = forward(
+            target_params, target_cfg, window, w_pos, t_cache
+        )
+        t_logits = unembed(target_params, target_cfg, t_hidden)  # [B,γ+1,V]
+
+        # --- Acceptance. --------------------------------------------------
+        key, ka = jax.random.split(key)
+        if greedy:
+            t_choice = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+            match = drafts == t_choice[:, :gamma]      # [B, gamma]
+            acc = jnp.cumprod(match.astype(jnp.int32), axis=1)
+            n_acc = jnp.sum(acc, axis=1)               # [B] accepted drafts
+            # Token emitted after the accepted prefix: target's argmax at
+            # the first mismatch — or the bonus token when all accepted.
+            extra = t_choice[rows, n_acc]
+        else:
+            t_probs = _token_probs(t_logits, sampling.temperature)  # [B,γ+1,V]
+            p_t = jnp.take_along_axis(
+                t_probs[:, :gamma], drafts[..., None], axis=-1
+            )[..., 0]                                  # [B, gamma]
+            p_d = jnp.take_along_axis(
+                d_dists, drafts[..., None], axis=-1
+            )[..., 0]                                  # [B, gamma]
+            u = jax.random.uniform(ka, (B, gamma))
+            accept = u < jnp.minimum(1.0, p_t / jnp.maximum(p_d, 1e-20))
+            acc = jnp.cumprod(accept.astype(jnp.int32), axis=1)
+            n_acc = jnp.sum(acc, axis=1)
+            # First rejection: sample the normalized residual
+            # max(p_t - p_d, 0); all accepted: bonus-sample the target's
+            # distribution at the extra position [Leviathan et al. 2023].
+            all_acc = n_acc == gamma
+            p_t_x = t_probs[rows, n_acc]               # [B, V]
+            p_d_x = d_dists[rows, jnp.minimum(n_acc, gamma - 1)]
+            residual = jnp.maximum(p_t_x - p_d_x, 0.0)
+            res_mass = jnp.sum(residual, axis=-1, keepdims=True)
+            residual = jnp.where(
+                res_mass > 1e-20, residual / jnp.maximum(res_mass, 1e-20),
+                p_t_x,
+            )
+            dist = jnp.where(all_acc[:, None], p_t_x, residual)
+            key, kr = jax.random.split(key)
+            extra = jax.random.categorical(
+                kr, jnp.log(jnp.maximum(dist, 1e-20)), axis=-1
+            ).astype(jnp.int32)
+
+        # --- Emit accepted drafts + the extra token. ----------------------
+        emit = jnp.concatenate(
+            [drafts, jnp.zeros((B, 1), jnp.int32)], axis=1
+        )
+        emit = emit.at[rows, n_acc].set(extra)         # [B, gamma+1]
+        n_out = n_acc + 1
+
+        new_out, new_counts, new_done = out_buf, counts, done
+        eos_seen = jnp.zeros((B,), bool)
+        for j in range(gamma + 1):
+            tok_j = emit[:, j]
+            valid = (j < n_out) & ~new_done & ~eos_seen
+            idx = jnp.where(valid, counts + j, max_new)  # OOB → dropped
+            new_out = new_out.at[rows, idx].set(tok_j, mode="drop")
+            new_counts = new_counts + (valid & (idx < max_new)).astype(jnp.int32)
+            eos_seen = eos_seen | (valid & (tok_j == eos_id))
+        new_done = new_done | eos_seen | (new_counts >= max_new)
+
+        # Rows continue from their last emitted token.
+        last_idx = jnp.clip(new_counts - 1, 0, max_new - 1)
+        new_prev = new_out[rows, last_idx]
+        emitted = new_counts - counts
+        new_pos = pos + jnp.where(done, 0, emitted)
+
+        return (
+            t_cache, d_cache, new_out, new_counts, new_prev, new_done,
+            new_pos, key, it + 1,
+        )
+
+    state = (t_cache, d_cache, out_buf, counts, prev, done, pos, key,
+             jnp.zeros((), jnp.int32))
+    state = jax.lax.while_loop(cond, body, state)
+    _, _, out_buf, counts, _, _, _, _, _ = state
+    return out_buf, counts

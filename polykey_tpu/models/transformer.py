@@ -64,12 +64,19 @@ def init_params(key: jax.Array, cfg: ModelConfig, dtype=jnp.bfloat16) -> dict:
     """Random-init parameter pytree with layers stacked for scan."""
     k_embed, k_layers, k_head = jax.random.split(key, 3)
 
+    # rms_norm computes gain = offset + w (offset 1.0 for the Gemma storage
+    # convention, models with scale_embeddings). Init w so the effective
+    # gain is 1 — zero gains would make every hidden state identically
+    # zero at init, turning random-init tests vacuous.
+    norm_offset = 1.0 if cfg.scale_embeddings else 0.0
+    norm_init = jnp.full((cfg.hidden_size,), 1.0 - norm_offset, dtype)
+
     def one_layer(k: jax.Array) -> dict:
         k_attn, k_mlp = jax.random.split(k)
         layer = {
             "attn": init_attention_params(k_attn, cfg, dtype),
-            "ln1": jnp.zeros((cfg.hidden_size,), dtype),
-            "ln2": jnp.zeros((cfg.hidden_size,), dtype),
+            "ln1": norm_init,
+            "ln2": norm_init,
         }
         if cfg.is_moe:
             k_router, k_experts = jax.random.split(k_mlp)
@@ -87,8 +94,8 @@ def init_params(key: jax.Array, cfg: ModelConfig, dtype=jnp.bfloat16) -> dict:
                 k_mlp, cfg.hidden_size, cfg.intermediate_size, dtype
             )
         if cfg.use_post_norms:
-            layer["post_ln1"] = jnp.zeros((cfg.hidden_size,), dtype)
-            layer["post_ln2"] = jnp.zeros((cfg.hidden_size,), dtype)
+            layer["post_ln1"] = norm_init
+            layer["post_ln2"] = norm_init
         return layer
 
     layers = jax.vmap(one_layer)(jax.random.split(k_layers, cfg.num_layers))
@@ -99,7 +106,7 @@ def init_params(key: jax.Array, cfg: ModelConfig, dtype=jnp.bfloat16) -> dict:
         )
         * cfg.hidden_size**-0.5,
         "layers": layers,
-        "final_norm": jnp.zeros((cfg.hidden_size,), dtype),
+        "final_norm": norm_init,
     }
     if not cfg.tie_embeddings:
         params["lm_head"] = (
