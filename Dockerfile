@@ -1,0 +1,66 @@
+# polykey_tpu container build.
+#
+# Mirrors the reference's multi-stage layout (/root/reference/Dockerfile:
+# builder → tester → production → server) adapted to the Python+C++ stack:
+# there is no static-binary stage to strip, so "builder" compiles the native
+# components and generates protos, "tester" runs the suite hermetically, and
+# the runtime stages carry only the package + venv. The gRPC healthcheck
+# binary (grpc_health_probe in the reference, Dockerfile:30-36) is replaced
+# by an in-tree probe (python -m polykey_tpu.gateway.health) speaking the
+# same grpc.health.v1 protocol.
+
+ARG PYTHON_IMAGE=python:3.12-slim
+
+# ---- builder: native components + protos -----------------------------------
+FROM ${PYTHON_IMAGE} AS builder
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make protobuf-compiler \
+    && rm -rf /var/lib/apt/lists/*
+WORKDIR /src
+COPY Makefile ./
+COPY native/ native/
+COPY protos/ protos/
+COPY scripts/ scripts/
+RUN make native
+
+# ---- deps: python environment ----------------------------------------------
+FROM ${PYTHON_IMAGE} AS deps
+# CPU wheels by default; TPU VMs build with --build-arg JAX_EXTRA=[tpu].
+ARG JAX_EXTRA=
+RUN pip install --no-cache-dir \
+        "jax${JAX_EXTRA}" flax optax grpcio grpcio-health-checking \
+        grpcio-reflection protobuf numpy
+
+# ---- tester: hermetic test run (reference Dockerfile:44-48) -----------------
+FROM deps AS tester
+WORKDIR /app
+RUN pip install --no-cache-dir pytest
+COPY . .
+COPY --from=builder /src/build/ build/
+CMD ["python", "-m", "pytest", "tests/", "-x", "-q"]
+
+# ---- production: minimal serving image (reference Dockerfile:51-55) ---------
+FROM deps AS production
+WORKDIR /app
+COPY polykey_tpu/ polykey_tpu/
+COPY --from=builder /src/build/ build/
+RUN useradd --create-home --uid 10001 appuser
+USER appuser
+ENV LISTEN_ADDR=:50051
+EXPOSE 50051
+HEALTHCHECK --interval=10s --timeout=5s --retries=3 --start-period=20s \
+    CMD ["python", "-m", "polykey_tpu.gateway.health", "localhost:50051"]
+ENTRYPOINT ["python", "-m", "polykey_tpu.gateway.server"]
+
+# ---- server: debuggable runtime with shell (reference Dockerfile:58-66) -----
+FROM deps AS server
+WORKDIR /app
+COPY . .
+COPY --from=builder /src/build/ build/
+RUN useradd --create-home --uid 10001 appuser && chown -R appuser /app
+USER appuser
+ENV LISTEN_ADDR=:50051
+EXPOSE 50051
+HEALTHCHECK --interval=10s --timeout=5s --retries=3 --start-period=20s \
+    CMD ["python", "-m", "polykey_tpu.gateway.health", "localhost:50051"]
+CMD ["python", "-m", "polykey_tpu.gateway.server"]

@@ -51,3 +51,27 @@ dryrun: ## Compile-check the multi-chip sharded step on a virtual mesh
 clean: ## Remove build artifacts and caches
 	rm -rf $(BUILD_DIR) .pytest_cache
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true
+
+# -- container lifecycle (reference Makefile:126-172 compose family) ---------
+.PHONY: docker-build docker-test compose-up compose-down compose-logs compose-client health-probe
+
+docker-build: ## Build the production image
+	docker build --target production -t polykey-tpu:latest .
+
+docker-test: ## Run the test suite inside the tester image
+	docker build --target tester -t polykey-tpu-tester . && docker run --rm polykey-tpu-tester
+
+compose-up: ## Start the server stack (POLYKEY_BACKEND=tpu for the engine)
+	docker compose up -d polykey-server
+
+compose-down: ## Stop and remove the stack
+	docker compose down -v
+
+compose-logs: $(if $(filter true,$(b)),$(BUILD_DIR)/log-beautifier,) ## Tail server logs through the C++ beautifier (b=true)
+	docker compose logs -f polykey-server $(if $(filter true,$(b)),| $(BUILD_DIR)/log-beautifier,)
+
+compose-client: ## Run the containerized dev client against the server
+	docker compose run --rm polykey-dev-client
+
+health-probe: ## Probe a running server's gRPC health (ADDR=localhost:50051)
+	$(PYTHON) -m polykey_tpu.gateway.health $(or $(ADDR),localhost:50051)

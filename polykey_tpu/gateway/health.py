@@ -71,3 +71,28 @@ class HealthService(HealthServicer):
                     continue
                 last_sent = status
             yield health_pb.HealthCheckResponse(status=status)
+
+
+def probe(target: str, service: str = "", timeout: float = 5.0) -> int:
+    """grpc_health_probe equivalent (the reference ships the Go binary in
+    both runtime images, /root/reference/Dockerfile:30-36; container
+    healthchecks exec it, compose.yml:17-22). Returns a process exit code:
+    0 SERVING, 1 anything else/unreachable."""
+    from ..proto.health_v1_grpc import HealthStub
+
+    try:
+        with grpc.insecure_channel(target) as channel:
+            resp = HealthStub(channel).Check(
+                health_pb.HealthCheckRequest(service=service), timeout=timeout
+            )
+        return 0 if resp.status == SERVING else 1
+    except grpc.RpcError:
+        return 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    _target = sys.argv[1] if len(sys.argv) > 1 else "localhost:50051"
+    _service = sys.argv[2] if len(sys.argv) > 2 else ""
+    sys.exit(probe(_target, _service))

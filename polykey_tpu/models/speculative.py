@@ -12,8 +12,8 @@ emitted at the first mismatch (greedy) / rejection (sampled). Guarantees:
   draft token x with prob min(1, p_t(x)/p_d(x)), else resample from the
   normalized residual max(p_t - p_d, 0); the output distribution equals
   target-only sampling. top_p and top_k are intentionally unsupported here
-  (truncation filters break the residual-distribution identity);
-  SamplingParams.top_p / .top_k are both ignored in this path.
+  (truncation filters break the residual-distribution identity) and are
+  rejected at trace time.
 
 TPU-shape design: everything is fixed-shape under one jit. Per-row
 divergence (different acceptance counts) is data, not shape: positions,
@@ -47,7 +47,10 @@ def _token_probs(logits: jax.Array, temperature: float) -> jax.Array:
 
 @partial(
     jax.jit,
-    static_argnames=("target_cfg", "draft_cfg", "sampling", "max_len", "gamma"),
+    static_argnames=(
+        "target_cfg", "draft_cfg", "sampling", "max_len", "gamma",
+        "return_stats",
+    ),
 )
 def speculative_generate(
     target_params: dict,
@@ -61,12 +64,23 @@ def speculative_generate(
     max_len: int,
     gamma: int = 4,
     eos_id: int = -1,
-) -> tuple[jax.Array, jax.Array]:
+    return_stats: bool = False,
+) -> tuple[jax.Array, ...]:
     """Draft/verify generation; same contract as models/generate.generate:
-    returns (generated [B, max_new_tokens] int32, num_generated [B])."""
+    returns (generated [B, max_new_tokens] int32, num_generated [B]).
+    With return_stats, appends (accepted_drafts, proposed_drafts) scalars —
+    the acceptance rate is the speedup dial and regressions in draft-cache
+    bookkeeping are invisible in the (always target-exact) output stream."""
     B, T = tokens.shape
     max_new = sampling.max_new_tokens
     greedy = sampling.temperature == 0.0
+    if sampling.top_k > 0 or sampling.top_p < 1.0:
+        raise ValueError(
+            "speculative_generate supports greedy and plain-temperature "
+            "sampling only: top_k/top_p truncation breaks the rejection-"
+            "sampling residual identity (output would not match target-only "
+            "sampling). Filter-free SamplingParams required."
+        )
     # +gamma: the final verify window may draft past the last emitted token;
     # those cache writes must land in real slots (JAX clamps OOB scatters,
     # which would corrupt the last slot).
@@ -124,11 +138,12 @@ def speculative_generate(
         return (d_cache, nxt, p + 1, key), (nxt, dist)
 
     def cond(state):
-        _, _, _, _, _, done, _, _, it = state
+        done, it = state[5], state[8]
         return (~done.all()) & (it < max_new)
 
     def body(state):
-        t_cache, d_cache, out_buf, counts, prev, done, pos, key, it = state
+        (t_cache, d_cache, out_buf, counts, prev, done, pos, key, it,
+         acc_total, prop_total) = state
 
         # --- Draft gamma tokens (autoregressive, consumes prev → drafts). --
         key, kd = jax.random.split(key)
@@ -145,6 +160,13 @@ def speculative_generate(
             target_params, target_cfg, window, w_pos, t_cache
         )
         t_logits = unembed(target_params, target_cfg, t_hidden)  # [B,γ+1,V]
+
+        # Sync the draft cache over the same window: the draft scan only
+        # wrote slots pos..pos+gamma-1 (each step writes the token it
+        # consumes), so on full acceptance slot pos+gamma (the last draft)
+        # would stay a permanent zero-KV hole — the next round starts past
+        # it, draft predictions diverge, and acceptance silently collapses.
+        _, d_cache = forward(draft_params, draft_cfg, window, w_pos, d_cache)
 
         # --- Acceptance. --------------------------------------------------
         key, ka = jax.random.split(key)
@@ -210,13 +232,20 @@ def speculative_generate(
         emitted = new_counts - counts
         new_pos = pos + jnp.where(done, 0, emitted)
 
+        active = (~done).astype(jnp.int32)
+        acc_total = acc_total + jnp.sum(active * n_acc)
+        prop_total = prop_total + jnp.sum(active) * gamma
+
         return (
             t_cache, d_cache, new_out, new_counts, new_prev, new_done,
-            new_pos, key, it + 1,
+            new_pos, key, it + 1, acc_total, prop_total,
         )
 
     state = (t_cache, d_cache, out_buf, counts, prev, done, pos, key,
+             jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32),
              jnp.zeros((), jnp.int32))
     state = jax.lax.while_loop(cond, body, state)
-    _, _, out_buf, counts, _, _, _, _, _ = state
+    out_buf, counts, acc_total, prop_total = state[2], state[3], state[9], state[10]
+    if return_stats:
+        return out_buf, counts, acc_total, prop_total
     return out_buf, counts

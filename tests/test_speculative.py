@@ -119,3 +119,32 @@ def test_eos_stops_rows_independently():
     for b in range(out.shape[0]):
         n = int(ref_n[b])
         assert (out[b, :n] == ref[b, :n]).all()
+
+
+def test_self_draft_acceptance_rate_is_perfect():
+    """Draft == target greedy must accept EVERY proposal in EVERY round.
+    This is the regression canary for draft-cache bookkeeping: a KV hole
+    (e.g. the last accepted draft's slot never written) leaves outputs
+    exact but collapses acceptance from round 2 on."""
+    t_params, _, tokens, seq_lens = _setup()
+    sampling = SamplingParams(max_new_tokens=24, temperature=0.0)
+    out, n, acc, prop = speculative_generate(
+        t_params, TARGET_CFG, t_params, TARGET_CFG, tokens, seq_lens,
+        jax.random.PRNGKey(3), sampling, max_len=64, gamma=4,
+        return_stats=True,
+    )
+    assert int(acc) == int(prop), (int(acc), int(prop))
+    assert int(prop) > 0
+
+
+def test_filtered_sampling_is_rejected():
+    import pytest
+
+    t_params, d_params, tokens, seq_lens = _setup()
+    with pytest.raises(ValueError, match="top_k/top_p"):
+        speculative_generate(
+            t_params, TARGET_CFG, d_params, DRAFT_CFG, tokens, seq_lens,
+            jax.random.PRNGKey(0),
+            SamplingParams(max_new_tokens=8, temperature=0.8, top_p=0.9),
+            max_len=64,
+        )
