@@ -33,6 +33,7 @@ class EngineConfig:
     tokenizer: str = "byte"              # 'byte' or a local HF tokenizer path
     dtype: str = "bfloat16"
     checkpoint_path: Optional[str] = None  # None → random init (dev/bench)
+    quantize: bool = False               # int8 weight-only (models/quant.py)
 
     # Decode-batch geometry (static shapes; compile-time constants).
     max_decode_slots: int = 8
@@ -64,6 +65,8 @@ class EngineConfig:
             tokenizer=os.environ.get("POLYKEY_TOKENIZER", cls.tokenizer),
             dtype=os.environ.get("POLYKEY_DTYPE", cls.dtype),
             checkpoint_path=os.environ.get("POLYKEY_CHECKPOINT") or None,
+            quantize=os.environ.get("POLYKEY_QUANTIZE", "").lower()
+            in ("1", "true", "int8"),
             max_decode_slots=_env_int("POLYKEY_MAX_DECODE_SLOTS", cls.max_decode_slots),
             page_size=_env_int("POLYKEY_PAGE_SIZE", cls.page_size),
             num_pages=_env_int("POLYKEY_NUM_PAGES", cls.num_pages),

@@ -141,6 +141,12 @@ class InferenceEngine:
                 params = init_params(
                     jax.random.PRNGKey(seed), self.model_cfg, self._dtype
                 )
+        if config.quantize:
+            # Int8 weight-only: halves weight HBM (the single-chip 8B
+            # enabler — v5e has 16 GiB; see models/quant.py).
+            from ..models.quant import quantize_params
+
+            params = quantize_params(params, self.model_cfg)
         self.params = params
 
         B, P = config.max_decode_slots, config.pages_per_seq

@@ -204,6 +204,17 @@ def _default_service(logger: Logger, health: Optional[HealthService] = None) -> 
     """
     backend = os.environ.get("POLYKEY_BACKEND", "mock").lower()
     if backend in ("tpu", "engine"):
+        # Honor JAX_PLATFORMS=cpu before any backend init: some images pin a
+        # TPU plugin via sitecustomize, so the env alone is ignored and the
+        # documented CPU mode (compose.yml, tests) would silently try TPU.
+        if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+            import jax
+
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except RuntimeError:
+                pass  # backend already initialized
+
         from .tpu_service import TpuService
 
         return TpuService.from_env(health=health, logger=logger)

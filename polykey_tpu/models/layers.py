@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models.config import ModelConfig
+from .quant import qdot
 
 
 def rms_norm(
@@ -58,17 +59,17 @@ def _activate(x: jax.Array, activation: str) -> jax.Array:
 
 def mlp(p: dict, x: jax.Array, activation: str) -> jax.Array:
     """Gated MLP (SwiGLU / GeGLU): act(x@gate) * (x@up) @ down."""
-    gate = _activate(x @ p["gate"], activation)
-    return (gate * (x @ p["up"])) @ p["down"]
+    gate = _activate(qdot(x, p["gate"]), activation)
+    return qdot(gate * qdot(x, p["up"]), p["down"])
 
 
 def qkv_project(
     p: dict, x: jax.Array, cfg: ModelConfig
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     B, T, _ = x.shape
-    q = (x @ p["wq"]).reshape(B, T, cfg.num_heads, cfg.head_dim)
-    k = (x @ p["wk"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
-    v = (x @ p["wv"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    q = qdot(x, p["wq"]).reshape(B, T, cfg.num_heads, cfg.head_dim)
+    k = qdot(x, p["wk"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    v = qdot(x, p["wv"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
     return q, k, v
 
 

@@ -1,0 +1,175 @@
+"""Int8 weight-only quantization for serving.
+
+One v5e chip has 16 GiB HBM; Llama-3-8B in bf16 is ~16 GiB of weights alone,
+so the single-chip serving story for 8B-class models (BASELINE.md config 2)
+is int8 weights: per-output-channel symmetric scales, dequantized on the fly
+inside the matmul (`(x @ q) * s` — XLA fuses the int8→bf16 cast into the
+MXU feed, so HBM traffic halves, which is the whole game for bandwidth-bound
+decode). Activations stay bf16; norms/router stay fp (negligible bytes).
+
+Representation: a `QuantizedTensor` pytree leaf-pair (int8 values + fp32
+scales) that flows through jit/sharding like any array pair. The matmul
+seam is `qdot` — every linear in layers.py/transformer.py routes through it
+and dispatches on type, so the same forward serves fp and int8 trees.
+
+The reference has no quantization (25 Go files, no ML — SURVEY.md §2); this
+is owed to the north star's single-chip 8B serving target.
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from .config import ModelConfig
+
+
+@struct.dataclass
+class QuantizedTensor:
+    """Int8 weights with per-output-channel fp32 scales.
+
+    q: int8, original weight shape [..., in, out]
+    s: fp32, [..., out] — scale over the contraction (in) axis.
+    act_dtype: the pre-quantization weight dtype; dequantization targets it
+    so an fp32-configured model is not silently narrowed to bf16 (and
+    callers sizing KV caches off params["embed"].dtype see the activation
+    dtype, not the fp32 scales).
+    """
+
+    q: jax.Array
+    s: jax.Array
+    act_dtype: jnp.dtype = struct.field(pytree_node=False, default=jnp.bfloat16)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self.act_dtype)
+
+
+def quantize(w: jax.Array) -> QuantizedTensor:
+    """Symmetric per-output-channel int8 quantization of [..., in, out]."""
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2)     # [..., out]
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(
+        jnp.round(w.astype(jnp.float32) / scale[..., None, :]), -127, 127
+    ).astype(jnp.int8)
+    return QuantizedTensor(q=q, s=scale, act_dtype=jnp.dtype(w.dtype))
+
+
+def dequantize(w: QuantizedTensor, dtype=jnp.bfloat16) -> jax.Array:
+    return (w.q.astype(jnp.float32) * w.s[..., None, :]).astype(dtype)
+
+
+WeightLike = Union[jax.Array, QuantizedTensor]
+
+
+def qdot(x: jax.Array, w: WeightLike) -> jax.Array:
+    """x @ w with on-the-fly dequantization for QuantizedTensor weights."""
+    if isinstance(w, QuantizedTensor):
+        y = x @ w.q.astype(x.dtype)
+        return y * w.s.astype(x.dtype)
+    return x @ w
+
+
+def qeinsum_expert(
+    pattern: str, x: jax.Array, w: WeightLike, e_axis: int, **kwargs
+):
+    """Expert-stacked einsum: scales are [E, out]; `e_axis` names the expert
+    axis in the OUTPUT (out is always last). Covers both MoE formulations:
+    'bth,ehi->beti' (e_axis=1) and the dispatch path 'ech,ehi->eci'
+    (e_axis=0)."""
+    if isinstance(w, QuantizedTensor):
+        y = jnp.einsum(pattern, x, w.q.astype(x.dtype), **kwargs)
+        shape = [1] * y.ndim
+        shape[e_axis] = w.s.shape[0]
+        shape[-1] = w.s.shape[-1]
+        return y * w.s.reshape(shape).astype(y.dtype)
+    return jnp.einsum(pattern, x, w, **kwargs)
+
+
+def embed_lookup(embed: WeightLike, tokens: jax.Array) -> jax.Array:
+    """Embedding row lookup; scales are per hidden channel ([H] — the same
+    axis the tied unembed contracts, so one tensor serves both uses)."""
+    if isinstance(embed, QuantizedTensor):
+        rows = embed.q[tokens]                         # int8 [..., H]
+        return rows.astype(embed.dtype) * embed.s.astype(embed.dtype)
+    return embed[tokens]
+
+
+def unembed_logits(hidden: jax.Array, embed_or_head: WeightLike, tied: bool):
+    """fp32 vocab logits from either a tied embedding ('...h,vh->...v') or an
+    lm_head ('...h,hv->...v'), quantized or not."""
+    if isinstance(embed_or_head, QuantizedTensor):
+        # int8 values (|q| <= 127) are exact in bf16, so the vocab matmul —
+        # the hottest step at 128k-256k vocab — keeps narrow operands and
+        # accumulates fp32 via preferred_element_type, like the fp path.
+        wdt = embed_or_head.dtype
+        if tied:
+            # Tied: q is [V, H], scales are [H] (contraction axis) — fold the
+            # scale into the activation before the matmul.
+            scaled = hidden.astype(jnp.float32) * embed_or_head.s
+            return jnp.einsum(
+                "...h,vh->...v", scaled.astype(wdt),
+                embed_or_head.q.astype(wdt),
+                preferred_element_type=jnp.float32,
+            )
+        y = jnp.einsum(
+            "...h,hv->...v", hidden.astype(wdt),
+            embed_or_head.q.astype(wdt),
+            preferred_element_type=jnp.float32,
+        )
+        return y * embed_or_head.s
+    if tied:
+        return jnp.einsum(
+            "...h,vh->...v", hidden, embed_or_head,
+            preferred_element_type=jnp.float32,
+        )
+    return jnp.einsum(
+        "...h,hv->...v", hidden, embed_or_head,
+        preferred_element_type=jnp.float32,
+    )
+
+
+_QUANT_LEAVES = ("wq", "wk", "wv", "wo", "gate", "up", "down", "lm_head")
+
+
+def quantize_params(params: dict, cfg: ModelConfig) -> dict:
+    """Quantize every linear weight in the tree; norms, router, and biases
+    stay fp. The embedding is quantized per hidden channel so the same
+    tensor serves lookup and (tied) unembedding."""
+
+    def walk(node):
+        if isinstance(node, dict):
+            out = {}
+            for name, child in node.items():
+                if name in _QUANT_LEAVES and isinstance(child, jax.Array):
+                    out[name] = quantize(child)
+                else:
+                    # Covers the experts subtree too: gate/up/down are in
+                    # _QUANT_LEAVES and quantize() handles the leading
+                    # [L, E, ...] stack axes (scale reduces axis=-2 only).
+                    out[name] = walk(child)
+            return out
+        return node
+
+    out = walk(params)
+    embed = params["embed"]                            # [V, H]
+    absmax = jnp.max(jnp.abs(embed.astype(jnp.float32)), axis=0)  # [H]
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(
+        jnp.round(embed.astype(jnp.float32) / scale[None, :]), -127, 127
+    ).astype(jnp.int8)
+    out["embed"] = QuantizedTensor(q=q, s=scale, act_dtype=jnp.dtype(embed.dtype))
+    return out
+
+
+def params_bytes(params) -> int:
+    """Total parameter storage in bytes (quantized trees count q + s)."""
+    leaves = jax.tree.leaves(params)
+    return sum(leaf.size * leaf.dtype.itemsize for leaf in leaves)

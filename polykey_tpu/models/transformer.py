@@ -26,6 +26,7 @@ from flax import struct
 
 from ..ops.attention import attention
 from .config import ModelConfig
+from .quant import embed_lookup, qdot, unembed_logits
 from .layers import (
     init_attention_params,
     init_mlp_params,
@@ -144,7 +145,7 @@ def _run_stack(params, cfg: ModelConfig, tokens, positions, kv_scanned, attend):
     norm_offset = 1.0 if cfg.scale_embeddings else 0.0
     eps = cfg.rms_norm_eps
 
-    x = params["embed"][tokens]
+    x = embed_lookup(params["embed"], tokens)
     if cfg.scale_embeddings:
         x = (x.astype(jnp.float32) * cfg.hidden_size**0.5).astype(x.dtype)
 
@@ -159,7 +160,7 @@ def _run_stack(params, cfg: ModelConfig, tokens, positions, kv_scanned, attend):
         ctx, kc, vc = attend(layer_idx, q, k, v, kc, vc)
 
         attn_out = ctx.reshape(B, T, cfg.num_heads * cfg.head_dim)
-        attn_out = attn_out @ layer_params["attn"]["wo"]
+        attn_out = qdot(attn_out, layer_params["attn"]["wo"])
         if cfg.use_post_norms:
             attn_out = rms_norm(attn_out, layer_params["post_ln1"], eps, norm_offset)
         x = x + attn_out
@@ -320,15 +321,9 @@ def unembed(params: dict, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:
     soft-cap. Callers gather the positions they need *before* unembedding —
     at 128k-256k vocab the [B, T, V] matmul is the expensive part."""
     if cfg.tie_embeddings:
-        logits = jnp.einsum(
-            "...h,vh->...v", hidden, params["embed"],
-            preferred_element_type=jnp.float32,
-        )
+        logits = unembed_logits(hidden, params["embed"], tied=True)
     else:
-        logits = jnp.einsum(
-            "...h,hv->...v", hidden, params["lm_head"],
-            preferred_element_type=jnp.float32,
-        )
+        logits = unembed_logits(hidden, params["lm_head"], tied=False)
     if cfg.final_logit_softcap is not None:
         logits = cfg.final_logit_softcap * jnp.tanh(
             logits / cfg.final_logit_softcap

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 
 from ..models.config import ModelConfig
 from ..models.layers import _activate
+from ..models.quant import qeinsum_expert
 
 
 def _router_weights(
@@ -50,10 +51,14 @@ def moe_mlp(layer_params: dict, h: jax.Array, cfg: ModelConfig) -> jax.Array:
     combine, _ = _router_weights(layer_params, h, cfg)              # [B,T,E]
     experts = layer_params["experts"]                               # stacked [E,...]
 
-    up = jnp.einsum("bth,ehi->beti", h, experts["up"])
-    gate = _activate(jnp.einsum("bth,ehi->beti", h, experts["gate"]),
-                     cfg.activation)
-    out = jnp.einsum("beti,eih->beth", gate * up, experts["down"])  # [B,E,T,H]
+    up = qeinsum_expert("bth,ehi->beti", h, experts["up"], e_axis=1)
+    gate = _activate(
+        qeinsum_expert("bth,ehi->beti", h, experts["gate"], e_axis=1),
+        cfg.activation,
+    )
+    out = qeinsum_expert(
+        "beti,eih->beth", gate * up, experts["down"], e_axis=1
+    )  # [B,E,T,H]
     return jnp.einsum(
         "beth,bte->bth", out.astype(jnp.float32), combine
     ).astype(h.dtype)
@@ -100,11 +105,14 @@ def moe_mlp_dispatch(
 
     # Expert compute on buckets.
     experts_p = layer_params["experts"]
-    up = jnp.einsum("ech,ehi->eci", buckets, experts_p["up"])
+    up = qeinsum_expert("ech,ehi->eci", buckets, experts_p["up"], e_axis=0)
     gate = _activate(
-        jnp.einsum("ech,ehi->eci", buckets, experts_p["gate"]), cfg.activation
+        qeinsum_expert("ech,ehi->eci", buckets, experts_p["gate"], e_axis=0),
+        cfg.activation,
     )
-    out = jnp.einsum("eci,eih->ech", gate * up, experts_p["down"])  # [E,C,H]
+    out = qeinsum_expert(
+        "eci,eih->ech", gate * up, experts_p["down"], e_axis=0
+    )  # [E,C,H]
 
     # Combine back: each (token, choice) reads its bucket slot.
     gathered = out[flat_expert, flat_slot].reshape(N, k, H)

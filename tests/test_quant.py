@@ -1,0 +1,89 @@
+"""Int8 weight-only quantization tests."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from polykey_tpu.engine.sampling import SamplingParams
+from polykey_tpu.models.config import TINY_LLAMA, TINY_MIXTRAL, TINY_GEMMA
+from polykey_tpu.models.generate import generate
+from polykey_tpu.models.quant import (
+    QuantizedTensor,
+    dequantize,
+    params_bytes,
+    qdot,
+    quantize,
+    quantize_params,
+)
+from polykey_tpu.models.transformer import forward, init_params
+
+
+def test_quantize_roundtrip_error_bound():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32), jnp.float32)
+    qt = quantize(w)
+    assert qt.q.dtype == jnp.int8
+    back = dequantize(qt, jnp.float32)
+    # Per-channel symmetric int8: error <= scale/2 per entry.
+    per_chan = jnp.max(jnp.abs(w), axis=0) / 127.0
+    assert (jnp.abs(back - w) <= per_chan[None, :] * 0.51 + 1e-7).all()
+
+
+def test_qdot_matches_dequantized_matmul():
+    w = jax.random.normal(jax.random.PRNGKey(1), (32, 16), jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(2), (4, 32), jnp.float32)
+    qt = quantize(w)
+    ref = x @ dequantize(qt, jnp.float32)
+    out = qdot(x, qt)
+    assert float(jnp.max(jnp.abs(ref - out))) < 1e-4
+
+
+def test_quantized_tree_halves_storage():
+    cfg = TINY_LLAMA
+    params = init_params(jax.random.PRNGKey(0), cfg, jnp.bfloat16)
+    qparams = quantize_params(params, cfg)
+    # bf16 → int8 (+small fp32 scales): comfortably under 0.62x.
+    assert params_bytes(qparams) < 0.62 * params_bytes(params)
+
+
+def _logit_agreement(cfg, seed=0):
+    params = init_params(jax.random.PRNGKey(seed), cfg, jnp.float32)
+    qparams = quantize_params(params, cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+    pos = jnp.broadcast_to(jnp.arange(16), (2, 16)).astype(jnp.int32)
+    h_fp, _ = forward(params, cfg, tokens, pos, None)
+    h_q, _ = forward(qparams, cfg, tokens, pos, None)
+    assert jnp.isfinite(h_q.astype(jnp.float32)).all()
+    # Int8 per-channel keeps hidden states close at tiny scale.
+    denom = jnp.maximum(jnp.abs(h_fp.astype(jnp.float32)), 1.0)
+    rel = jnp.abs(h_fp.astype(jnp.float32) - h_q.astype(jnp.float32)) / denom
+    assert float(jnp.mean(rel)) < 0.05, float(jnp.mean(rel))
+
+
+def test_quantized_forward_tracks_fp_llama():
+    _logit_agreement(TINY_LLAMA)
+
+
+def test_quantized_forward_tracks_fp_mixtral_both_formulations():
+    _logit_agreement(TINY_MIXTRAL)
+    _logit_agreement(dataclasses.replace(TINY_MIXTRAL, moe_dispatch=True))
+
+
+def test_quantized_forward_tracks_fp_gemma():
+    _logit_agreement(TINY_GEMMA)
+
+
+def test_quantized_greedy_generation_runs_end_to_end():
+    cfg = TINY_LLAMA
+    params = init_params(jax.random.PRNGKey(0), cfg, jnp.float32)
+    qparams = quantize_params(params, cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, cfg.vocab_size)
+    seq_lens = jnp.full((2,), 8, jnp.int32)
+    sampling = SamplingParams(max_new_tokens=12, temperature=0.0)
+    out, n = generate(
+        qparams, cfg, tokens, seq_lens, jax.random.PRNGKey(2), sampling,
+        max_len=32,
+    )
+    assert (n == 12).all()
+    assert ((out >= 0) & (out < cfg.vocab_size)).all()
