@@ -1,0 +1,139 @@
+"""Multi-host distributed runtime: process bootstrap + hybrid DCN meshes.
+
+The reference has no distributed communication at all (SURVEY.md §5: "no
+NCCL/MPI/Gloo/UCX, not even Go multi-process coordination"). The TPU-native
+equivalent is not a socket library: `jax.distributed.initialize` brings every
+host's chips into one global device list, the mesh declares where each named
+axis lives, and XLA emits the collectives — over ICI inside a slice, over
+DCN between slices. The mesh + partition specs ARE the comm backend.
+
+Layout rule (mesh.py's axis order makes this automatic): put ONLY
+data-parallel on DCN — gradient all-reduce is the one collective whose
+volume amortizes DCN latency; tp/sp/ep collectives must stay on ICI.
+`create_hybrid_mesh` encodes exactly that: the dp axis is (num_slices ×
+per-slice dp), every other axis lives inside a slice.
+
+Bootstrap env (standard JAX multi-process contract, overridable for tests):
+    POLYKEY_COORDINATOR   host:port of process 0 (e.g. "10.0.0.1:8476")
+    POLYKEY_NUM_PROCESSES total process count
+    POLYKEY_PROCESS_ID    this process's rank
+On TPU pods these are auto-detected from the metadata server, so
+`initialize_from_env()` with no env set simply calls
+`jax.distributed.initialize()` when running under a multi-host runtime and
+is a no-op on a single host.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .mesh import AXIS_NAMES, MeshConfig
+
+
+def initialize_from_env(logger=None) -> bool:
+    """Bring up the multi-process runtime if configured; returns True when
+    jax.distributed was initialized (idempotent; safe single-host no-op)."""
+    coordinator = os.environ.get("POLYKEY_COORDINATOR")
+    num_procs = os.environ.get("POLYKEY_NUM_PROCESSES")
+    proc_id = os.environ.get("POLYKEY_PROCESS_ID")
+
+    if coordinator is None and num_procs is None:
+        # No explicit config: only auto-initialize under a real multi-host
+        # TPU runtime (where JAX can discover peers); never on CPU/dev.
+        if os.environ.get("TPU_WORKER_HOSTNAMES", "").count(",") == 0:
+            return False
+        try:
+            jax.distributed.initialize()
+            return True
+        except Exception as e:  # already initialized or no runtime support
+            if logger is not None:
+                logger.warn("jax.distributed auto-init skipped", error=str(e))
+            return False
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=int(num_procs) if num_procs else None,
+        process_id=int(proc_id) if proc_id else None,
+    )
+    if logger is not None:
+        logger.info(
+            "distributed runtime initialized",
+            coordinator=coordinator,
+            process_id=jax.process_index(),
+            num_processes=jax.process_count(),
+            global_devices=jax.device_count(),
+        )
+    return True
+
+
+def create_hybrid_mesh(
+    config: MeshConfig,
+    num_slices: int,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Mesh spanning `num_slices` ICI domains connected by DCN.
+
+    The dp axis becomes (num_slices × config.dp) with the slice dimension
+    outermost, so data-parallel gradient reduction is the only collective
+    crossing DCN; pp/sp/ep/tp keep their full extent inside each slice.
+    Axis names are unchanged — training/serving code is layout-agnostic.
+
+    On real multi-slice TPU hardware, `mesh_utils.create_hybrid_device_mesh`
+    assigns devices slice-by-slice; elsewhere (CPU simulation, subsets) the
+    devices are split into equal contiguous groups, which preserves the
+    axis semantics for tests.
+    """
+    if devices is None:
+        devices = jax.devices()
+    per_slice = config.num_devices
+    if per_slice * num_slices != len(devices):
+        raise ValueError(
+            f"hybrid mesh needs {per_slice} × {num_slices} devices, "
+            f"have {len(devices)}"
+        )
+
+    if num_slices == 1:
+        from .mesh import create_mesh
+
+        return create_mesh(config, devices)
+
+    try:
+        from jax.experimental import mesh_utils
+
+        dcn_shape = (num_slices,) + (1,) * (len(AXIS_NAMES) - 1)
+        device_array = mesh_utils.create_hybrid_device_mesh(
+            config.shape, dcn_shape, devices=np.asarray(devices)
+        )
+    except Exception:
+        # CPU simulation / device subsets: contiguous per-slice groups.
+        device_array = np.asarray(devices).reshape(
+            (num_slices,) + config.shape
+        )
+        device_array = device_array.reshape(
+            (num_slices * config.dp,) + config.shape[1:]
+        )
+    return Mesh(device_array, AXIS_NAMES)
+
+
+def mesh_from_env(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Mesh from POLYKEY_{DP,PP,SP,EP,TP,NUM_SLICES} (defaults: everything 1
+    except dp, which absorbs the remaining devices)."""
+    if devices is None:
+        devices = jax.devices()
+    axes = {
+        name: int(os.environ.get(f"POLYKEY_{name.upper()}", "0") or 0)
+        for name in AXIS_NAMES
+    }
+    num_slices = int(os.environ.get("POLYKEY_NUM_SLICES", "1") or 1)
+    known = 1
+    for v in axes.values():
+        known *= max(v, 1)
+    if axes["dp"] == 0:
+        axes["dp"] = len(devices) // (known * num_slices)
+    config = MeshConfig(**{k: max(v, 1) for k, v in axes.items()})
+    return create_hybrid_mesh(config, num_slices, devices)
