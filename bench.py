@@ -1,21 +1,29 @@
-"""Benchmark harness: single-chip generation throughput.
+"""Benchmark harness: serving-engine throughput + TTFT on one chip.
 
 Prints exactly ONE JSON line to stdout:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N|null, ...}
 
-The north-star target (BASELINE.md) is >= 2,000 tok/s/chip greedy decode at
-8B on v5e. One v5e chip has 16 GiB HBM, so bf16 8B weights alone fill it;
-the harness benches the llama-1b-bench config (models/config.py) by default
-and reports vs_baseline = value / 2000 against the 8B target so the driver
-has a stable, monotonic number to track across rounds.
+What it measures (VERDICT r1 #1: bench what the north star names):
+- Phase A — the continuous-batching engine (InferenceEngine: paged KV,
+  slot-batched decode) on llama-1b-bench bf16: tok/s and p50 TTFT under a
+  closed-loop load with in-flight capped at the slot count.
+- Phase B — the 8B-class single-chip config BASELINE.md's target is defined
+  for: llama-3-8b with int8 weights (fabricated values, real shapes/dtypes —
+  throughput doesn't depend on weight values), same engine path. Its tok/s
+  is the headline `value`, and `vs_baseline` = value / 2000 (the BASELINE.md
+  north-star tok/s/chip). Per ADVICE r1, vs_baseline is null when the 8B
+  phase didn't run — a 1B number is not comparable to the 8B target.
 
-Measures the fused generate path (models/generate.py: jitted prefill +
-lax.scan decode, one dispatch for the whole sequence), end-to-end including
-prefill. Sync is via device_get of the result — block_until_ready alone does
-not drain the axon-tunnel queue on this image.
+Robustness (round 1 shipped rc=1 and zero evidence): the TPU backend is
+probed in a SUBPROCESS with a timeout, retried with backoff — a hung plugin
+init can't wedge the harness. If the TPU never comes up, the engine phase
+runs on CPU with a tiny model so the line still carries evidence, with
+"platform": "cpu" and vs_baseline null. Any crash still prints a diagnostic
+JSON line and exits 0.
 
-Knobs (env): POLYKEY_BENCH_MODEL, POLYKEY_BENCH_BATCH, POLYKEY_BENCH_PROMPT,
-POLYKEY_BENCH_NEW_TOKENS.
+Knobs (env): POLYKEY_BENCH_MODEL, POLYKEY_BENCH_REQUESTS, POLYKEY_BENCH_PROMPT,
+POLYKEY_BENCH_NEW_TOKENS, POLYKEY_BENCH_SKIP_8B=1, POLYKEY_BENCH_PROBE_TRIES,
+POLYKEY_BENCH_PROBE_TIMEOUT.
 
 All progress chatter goes to stderr; stdout carries only the JSON line.
 """
@@ -24,62 +32,272 @@ from __future__ import annotations
 
 import json
 import os
+import statistics
+import subprocess
 import sys
 import time
-
-import jax
-import jax.numpy as jnp
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def main() -> None:
-    from polykey_tpu.engine.sampling import SamplingParams
-    from polykey_tpu.models.config import get_config
-    from polykey_tpu.models.generate import generate
+def probe_backend() -> str | None:
+    """Probe TPU init in a subprocess (a hung C-level init can't be
+    interrupted in-process). Returns the platform string or None."""
+    tries = int(os.environ.get("POLYKEY_BENCH_PROBE_TRIES", "3"))
+    timeout = float(os.environ.get("POLYKEY_BENCH_PROBE_TIMEOUT", "180"))
+    for attempt in range(tries):
+        t0 = time.monotonic()
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; d = jax.devices(); "
+                 "print(d[0].platform, d[0].device_kind, len(d))"],
+                capture_output=True, text=True, timeout=timeout,
+            )
+            if out.returncode == 0 and out.stdout.strip():
+                log(f"backend probe ok ({time.monotonic() - t0:.1f}s): "
+                    f"{out.stdout.strip()}")
+                return out.stdout.split()[0]
+            log(f"probe attempt {attempt + 1}/{tries} rc={out.returncode}: "
+                f"{out.stderr.strip().splitlines()[-1] if out.stderr.strip() else '?'}")
+        except subprocess.TimeoutExpired:
+            log(f"probe attempt {attempt + 1}/{tries} timed out after {timeout}s")
+        if attempt + 1 < tries:
+            backoff = 15 * (attempt + 1)
+            log(f"retrying backend probe in {backoff}s")
+            time.sleep(backoff)
+    return None
+
+
+def fabricate_params(cfg, dtype, quantize: bool):
+    """Random params with real shapes/dtypes, built leaf-by-leaf on the host
+    so an 8B tree never materializes at fp32 on device (or at all): int8
+    leaves are filled directly — the engine's throughput doesn't depend on
+    weight values, only on shapes, dtypes, and placement."""
+    import jax
+    import ml_dtypes
+    import numpy as np
+
+    from polykey_tpu.models.quant import quantize_params
     from polykey_tpu.models.transformer import init_params
 
-    model_name = os.environ.get("POLYKEY_BENCH_MODEL", "llama-1b-bench")
-    B = int(os.environ.get("POLYKEY_BENCH_BATCH", "64"))
-    T = int(os.environ.get("POLYKEY_BENCH_PROMPT", "128"))
-    N = int(os.environ.get("POLYKEY_BENCH_NEW_TOKENS", "128"))
+    def build():
+        p = init_params(jax.random.PRNGKey(0), cfg, dtype)
+        return quantize_params(p, cfg) if quantize else p
 
-    dev = jax.devices()[0]
-    log(f"device: {dev.platform} ({dev.device_kind})")
-    cfg = get_config(model_name)
-    log(f"model: {cfg.name} ({cfg.num_params() / 1e9:.2f}B params), "
-        f"batch={B} prompt={T} new_tokens={N}")
+    tree = jax.eval_shape(build)
+    rng = np.random.default_rng(0)
 
-    key = jax.random.PRNGKey(0)
-    params = init_params(key, cfg, jnp.bfloat16)
-    tokens = jax.random.randint(key, (B, T), 0, cfg.vocab_size, jnp.int32)
-    seq_lens = jnp.full((B,), T, jnp.int32)
-    sampling = SamplingParams(max_new_tokens=N)
+    def make(sd):
+        if sd.dtype == np.int8:
+            return rng.integers(-64, 65, sd.shape, dtype=np.int8)
+        arr = rng.standard_normal(sd.shape, np.float32) * 0.02
+        if sd.dtype == np.float32:
+            return arr
+        return arr.astype(ml_dtypes.bfloat16)
 
-    t0 = time.perf_counter()
-    _, num = generate(params, cfg, tokens, seq_lens, key, sampling, max_len=T + N)
-    jax.device_get(num)
-    log(f"warmup (incl. compile): {time.perf_counter() - t0:.2f}s")
+    return jax.tree.map(make, tree)
 
-    t0 = time.perf_counter()
-    _, num = generate(params, cfg, tokens, seq_lens, key, sampling, max_len=T + N)
-    jax.device_get(num)
-    elapsed = time.perf_counter() - t0
 
-    tok_s = B * N / elapsed
-    log(f"generate: batch {B} x {N} tokens in {elapsed:.3f}s -> {tok_s:.1f} tok/s "
-        "(end-to-end incl. prefill)")
+def bench_engine(
+    engine_cfg, params, n_requests: int, prompt_len: int, max_new: int
+) -> dict:
+    """Closed-loop engine bench: in-flight capped at the slot count, so TTFT
+    reflects prefill + scheduling under steady load, not an artificial
+    all-at-once queue."""
+    import threading
 
-    baseline = 2000.0  # BASELINE.md north star: tok/s/chip, 8B greedy on v5e
-    print(json.dumps({
-        "metric": f"{cfg.name}_generate_tok_s_per_chip",
-        "value": round(tok_s, 1),
-        "unit": "tok/s",
-        "vs_baseline": round(tok_s / baseline, 3),
-    }), flush=True)
+    import numpy as np
+
+    from polykey_tpu.engine.engine import GenRequest, InferenceEngine
+
+    rng = np.random.default_rng(7)
+
+    def prompt() -> str:
+        return "".join(chr(c) for c in rng.integers(97, 123, prompt_len))
+
+    engine = InferenceEngine(engine_cfg, params=params)
+    try:
+        log("warmup (compiles prefill bucket + decode step)...")
+        t0 = time.monotonic()
+        for _ in range(2):
+            r = GenRequest(prompt=prompt(), max_new_tokens=max_new)
+            engine.submit(r)
+            while r.out.get(timeout=600.0)[0] == "token":
+                pass
+        log(f"warmup done in {time.monotonic() - t0:.1f}s")
+
+        slots = engine_cfg.max_decode_slots
+        in_flight = threading.Semaphore(slots)
+        timings, errors, lock = [], [], threading.Lock()
+
+        def drain(r: GenRequest) -> None:
+            try:
+                while True:
+                    kind, value = r.out.get(timeout=600.0)
+                    if kind == "done":
+                        with lock:
+                            timings.append(value)
+                        return
+                    if kind == "error":
+                        with lock:
+                            errors.append(value)
+                        return
+            except Exception as e:  # incl. queue.Empty: a hung request must
+                with lock:          # surface, not silently deflate tok/s
+                    errors.append(f"drain: {type(e).__name__}: {e}")
+            finally:
+                in_flight.release()
+
+        t0 = time.monotonic()
+        drainers = []
+        for _ in range(n_requests):
+            in_flight.acquire()
+            r = GenRequest(prompt=prompt(), max_new_tokens=max_new)
+            engine.submit(r)
+            th = threading.Thread(target=drain, args=(r,), daemon=True)
+            th.start()
+            drainers.append(th)
+        for th in drainers:
+            th.join(timeout=600.0)
+        elapsed = time.monotonic() - t0
+
+        if errors:
+            raise RuntimeError(f"{len(errors)} requests failed: {errors[0]}")
+        total_tokens = sum(t.completion_tokens for t in timings)
+        tok_s = total_tokens / elapsed
+        p50_ttft = statistics.median(t.ttft_ms for t in timings)
+        log(f"{len(timings)} requests, {total_tokens} tokens in "
+            f"{elapsed:.2f}s -> {tok_s:.1f} tok/s, p50 TTFT {p50_ttft:.1f} ms")
+        return {
+            "tok_s": round(tok_s, 1),
+            "p50_ttft_ms": round(p50_ttft, 1),
+            "requests": len(timings),
+            "total_tokens": total_tokens,
+            "elapsed_s": round(elapsed, 2),
+        }
+    finally:
+        engine.shutdown()
+
+
+def main() -> None:
+    platform = probe_backend()
+    result: dict = {"platform": platform or "cpu"}
+
+    import jax
+
+    if platform is None:
+        log("TPU backend unavailable after retries; falling back to CPU "
+            "with a tiny model (evidence-bearing but not target-comparable)")
+        jax.config.update("jax_platforms", "cpu")
+        result["error"] = "tpu backend unavailable; cpu fallback"
+
+    from polykey_tpu.engine.config import EngineConfig
+
+    on_tpu = platform == "tpu"
+    n_req = int(os.environ.get(
+        "POLYKEY_BENCH_REQUESTS", "64" if on_tpu else "6"))
+    prompt_len = int(os.environ.get("POLYKEY_BENCH_PROMPT", "128"))
+    max_new = int(os.environ.get(
+        "POLYKEY_BENCH_NEW_TOKENS", "128" if on_tpu else "16"))
+
+    # --- Phase A: engine bench, 1B-class bf16 (tiny on CPU fallback). ---
+    model_a = os.environ.get(
+        "POLYKEY_BENCH_MODEL", "llama-1b-bench" if on_tpu else "tiny-llama")
+    cfg_a = EngineConfig(
+        model=model_a,
+        dtype="bfloat16" if on_tpu else "float32",
+        max_decode_slots=32 if on_tpu else 4,
+        page_size=16,
+        num_pages=2048 if on_tpu else 128,
+        max_seq_len=512 if on_tpu else 128,
+        prefill_buckets=(prompt_len,) if on_tpu else (32, 64),
+        max_new_tokens_cap=max_new,
+    )
+    try:
+        log(f"--- phase A: engine bench, {model_a} ---")
+        phase_a = bench_engine(
+            cfg_a, None, n_req, prompt_len if on_tpu else 24, max_new)
+        result["engine_1b"] = {"model": model_a, **phase_a}
+    except Exception as e:
+        log(f"phase A failed: {e}")
+        result["engine_1b"] = {"model": model_a, "error": str(e)}
+
+    # --- Phase B: 8B-int8 — the config the 2,000 tok/s target names. ---
+    phase_b = None
+    if on_tpu and os.environ.get("POLYKEY_BENCH_SKIP_8B", "") != "1":
+        try:
+            log("--- phase B: engine bench, llama-3-8b int8 ---")
+            from polykey_tpu.models.config import get_config
+
+            cfg8 = get_config("llama-3-8b")
+            t0 = time.monotonic()
+            params8 = fabricate_params(cfg8, "bfloat16", quantize=True)
+            log(f"fabricated 8B int8 tree in {time.monotonic() - t0:.1f}s")
+            cfg_b = EngineConfig(
+                model="llama-3-8b",
+                dtype="bfloat16",
+                quantize=False,  # params arrive pre-quantized
+                max_decode_slots=16,
+                page_size=16,
+                num_pages=512,
+                max_seq_len=512,
+                prefill_buckets=(prompt_len,),
+                max_new_tokens_cap=max_new,
+            )
+            phase_b = bench_engine(cfg_b, params8, 32, prompt_len, max_new)
+            result["engine_8b_int8"] = phase_b
+        except Exception as e:
+            log(f"phase B failed: {e}")
+            result["engine_8b_int8"] = {"error": str(e)}
+
+    # --- Compose the single line. Headline = the target-comparable number
+    # when it exists (8B-class engine tok/s), else the phase-A number with
+    # vs_baseline null (ADVICE r1: no apples-to-oranges ratio). ---
+    baseline = 2000.0  # BASELINE.md: tok/s/chip, 8B-class greedy on v5e
+    if phase_b is not None:
+        line = {
+            "metric": "llama3_8b_int8_engine_tok_s_per_chip",
+            "value": phase_b["tok_s"],
+            "unit": "tok/s",
+            "vs_baseline": round(phase_b["tok_s"] / baseline, 3),
+            "p50_ttft_ms": phase_b["p50_ttft_ms"],
+            "details": result,
+        }
+    elif "tok_s" in result.get("engine_1b", {}):
+        a = result["engine_1b"]
+        line = {
+            "metric": f"{a['model']}_engine_tok_s_per_chip",
+            "value": a["tok_s"],
+            "unit": "tok/s",
+            "vs_baseline": None,
+            "p50_ttft_ms": a["p50_ttft_ms"],
+            "details": result,
+        }
+    else:
+        line = {
+            "metric": "bench_failed",
+            "value": 0.0,
+            "unit": "tok/s",
+            "vs_baseline": None,
+            "details": result,
+        }
+    print(json.dumps(line), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # never exit nonzero without a JSON line
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps({
+            "metric": "bench_failed",
+            "value": 0.0,
+            "unit": "tok/s",
+            "vs_baseline": None,
+            "details": {"error": str(e)},
+        }), flush=True)

@@ -48,6 +48,13 @@ class EngineConfig:
     tp: int = 1
     dp: int = 1
 
+    # Speculative decoding (engine/spec_decode.py): a draft model name turns
+    # it on; gamma = drafts per verify round. Draft must share the target's
+    # vocab. top_p<1 requests fall back to the plain decode step.
+    draft_model: Optional[str] = None
+    draft_checkpoint_path: Optional[str] = None  # None → random init
+    spec_gamma: int = 4
+
     # Liveness. The watchdog window must comfortably exceed worst-case XLA
     # compile time (each new prefill bucket compiles on first use).
     watchdog_timeout_s: float = 300.0
@@ -82,6 +89,10 @@ class EngineConfig:
             ),
             tp=_env_int("POLYKEY_TP", cls.tp),
             dp=_env_int("POLYKEY_DP", cls.dp),
+            draft_model=os.environ.get("POLYKEY_DRAFT_MODEL") or None,
+            draft_checkpoint_path=os.environ.get("POLYKEY_DRAFT_CHECKPOINT")
+            or None,
+            spec_gamma=_env_int("POLYKEY_SPEC_GAMMA", cls.spec_gamma),
             watchdog_timeout_s=_env_float(
                 "POLYKEY_WATCHDOG_TIMEOUT", cls.watchdog_timeout_s
             ),
@@ -102,3 +113,5 @@ class EngineConfig:
                 )
         if not self.prefill_buckets:
             raise ValueError("need at least one prefill bucket")
+        if self.draft_model is not None and self.spec_gamma < 1:
+            raise ValueError("spec_gamma must be >= 1")

@@ -39,11 +39,13 @@ import numpy as np
 
 from ..models.config import ModelConfig, get_config
 from ..models.transformer import forward_paged, init_params, unembed
+from ..parallel.mesh import MeshConfig, create_mesh
+from ..parallel.sharding import paged_kv_sharding, shard_params
 from .config import EngineConfig
 from .kv_cache import AllocationError, BlockAllocator, PagedKV, init_paged_kv
 from .metrics import EngineMetrics, RequestTimings
 from .sampling import sample_dynamic
-from .tokenizer import ByteTokenizer, load_tokenizer
+from .tokenizer import load_tokenizer
 
 
 @dataclass
@@ -71,8 +73,7 @@ class _Slot:
     position_cap: int = 0      # absolute position limit for this request
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("paged",))
-def _prefill_step(
+def _prefill_fn(
     params, cfg: ModelConfig, paged: PagedKV,
     tokens, seq_len, page_table, key, temperature, top_p,
 ):
@@ -86,8 +87,7 @@ def _prefill_step(
     return token[0], paged
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("paged",))
-def _decode_step(
+def _decode_fn(
     params, cfg: ModelConfig, paged: PagedKV,
     last_tokens, seq_lens, page_tables, active, key, temperature, top_p,
 ):
@@ -95,7 +95,9 @@ def _decode_step(
 
     seq_lens counts tokens including `last_tokens` (sampled but not yet in
     cache); the step writes their KV at position seq_lens-1 and samples the
-    next token for every active slot.
+    next token for every active slot. Returns the advanced seq_lens too, so
+    steady-state decode keeps its state device-resident (no per-step
+    host→device re-upload of slot arrays).
     """
     positions = jnp.maximum(seq_lens - 1, 0)[:, None]      # [B, 1]
     hidden, paged = forward_paged(
@@ -104,7 +106,8 @@ def _decode_step(
     logits = unembed(params, cfg, hidden[:, 0])            # [B, V]
     tokens = sample_dynamic(logits, key, temperature, top_p)
     tokens = jnp.where(active, tokens, 0)
-    return tokens, paged
+    new_seq_lens = seq_lens + active.astype(jnp.int32)
+    return tokens, new_seq_lens, paged
 
 
 class EngineDeadError(RuntimeError):
@@ -129,6 +132,47 @@ class InferenceEngine:
         self.logger = logger
         self._dtype = jnp.dtype(config.dtype)
 
+        # --- Serving mesh: tp shards heads/hidden (Megatron specs,
+        # parallel/sharding.py), dp shards the decode-slot batch. tp=dp=1
+        # degenerates to a single-device mesh with identical code paths
+        # (specs over size-1 axes are no-ops, so there is no unsharded
+        # special case to keep in sync).
+        n_devices = config.tp * config.dp
+        devices = jax.devices()
+        if n_devices > len(devices):
+            raise ValueError(
+                f"tp={config.tp} x dp={config.dp} needs {n_devices} "
+                f"devices, have {len(devices)}"
+            )
+        if self.model_cfg.num_kv_heads % config.tp != 0:
+            raise ValueError(
+                f"tp={config.tp} must divide num_kv_heads="
+                f"{self.model_cfg.num_kv_heads} ({self.model_cfg.name})"
+            )
+        if config.max_decode_slots % config.dp != 0:
+            raise ValueError(
+                f"dp={config.dp} must divide max_decode_slots="
+                f"{config.max_decode_slots}"
+            )
+        self.mesh = create_mesh(
+            MeshConfig(dp=config.dp, tp=config.tp), devices=devices[:n_devices]
+        )
+        from jax.sharding import NamedSharding, PartitionSpec
+        self._pool_sharding = paged_kv_sharding(self.mesh)
+        self._repl = NamedSharding(self.mesh, PartitionSpec())
+        self._dp_vec = NamedSharding(self.mesh, PartitionSpec("dp"))
+        self._dp_mat = NamedSharding(self.mesh, PartitionSpec("dp", None))
+        # Pinned output shardings keep the donated pool's layout stable
+        # across steps (donation requires matching input/output shardings).
+        self._jit_prefill = jax.jit(
+            _prefill_fn, static_argnames=("cfg",), donate_argnames=("paged",),
+            out_shardings=(self._repl, self._pool_sharding),
+        )
+        self._jit_decode = jax.jit(
+            _decode_fn, static_argnames=("cfg",), donate_argnames=("paged",),
+            out_shardings=(self._dp_vec, self._dp_vec, self._pool_sharding),
+        )
+
         if params is None:
             if config.checkpoint_path:
                 from ..models.loader import load_checkpoint
@@ -147,15 +191,83 @@ class InferenceEngine:
             from ..models.quant import quantize_params
 
             params = quantize_params(params, self.model_cfg)
-        self.params = params
+        self.params = shard_params(params, self.model_cfg, self.mesh)
 
         B, P = config.max_decode_slots, config.pages_per_seq
-        self.paged = init_paged_kv(
-            self.model_cfg, config.num_pages, config.page_size, self._dtype
+        self.paged = jax.device_put(
+            init_paged_kv(
+                self.model_cfg, config.num_pages, config.page_size, self._dtype
+            ),
+            self._pool_sharding,
         )
         self.allocator = BlockAllocator(config.num_pages)
 
-        # Host mirrors of per-slot device state (engine thread only).
+        # --- Speculative decoding: draft model + its own page pool, same
+        # page tables (position → (page, offset) is model-independent).
+        self._spec = config.draft_model is not None
+        self._gamma = config.spec_gamma if self._spec else 0
+        if self._spec:
+            from .spec_decode import spec_decode_fn, spec_prefill_fn
+
+            self.draft_cfg = get_config(config.draft_model)
+            if self.draft_cfg.vocab_size != self.model_cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {self.draft_cfg.vocab_size} != target "
+                    f"vocab {self.model_cfg.vocab_size}"
+                )
+            if self.draft_cfg.num_kv_heads % config.tp != 0:
+                raise ValueError(
+                    f"tp={config.tp} must divide draft num_kv_heads="
+                    f"{self.draft_cfg.num_kv_heads}"
+                )
+            if config.draft_checkpoint_path:
+                from ..models.loader import load_checkpoint
+
+                d_params = load_checkpoint(
+                    config.draft_checkpoint_path, self.draft_cfg, self._dtype
+                )
+            else:
+                d_params = init_params(
+                    jax.random.PRNGKey(seed + 2), self.draft_cfg, self._dtype
+                )
+            if config.quantize:
+                # The engine-wide int8 knob covers the draft too — the
+                # draft exists to save bandwidth, and an unquantized draft
+                # could push the HBM budget the flag exists to protect.
+                from ..models.quant import quantize_params
+
+                d_params = quantize_params(d_params, self.draft_cfg)
+            self.draft_params = shard_params(d_params, self.draft_cfg, self.mesh)
+            self.d_paged = jax.device_put(
+                init_paged_kv(
+                    self.draft_cfg, config.num_pages, config.page_size,
+                    self._dtype,
+                ),
+                self._pool_sharding,
+            )
+            self._jit_spec_prefill = jax.jit(
+                spec_prefill_fn,
+                static_argnames=("t_cfg", "d_cfg"),
+                donate_argnames=("t_paged", "d_paged"),
+                out_shardings=(
+                    self._repl, self._pool_sharding, self._pool_sharding,
+                ),
+            )
+            self._jit_spec_decode = jax.jit(
+                spec_decode_fn,
+                static_argnames=("t_cfg", "d_cfg", "gamma"),
+                donate_argnames=("t_paged", "d_paged"),
+                out_shardings=(
+                    self._dp_mat, self._dp_vec, self._dp_vec, self._dp_vec,
+                    self._pool_sharding, self._pool_sharding,
+                ),
+            )
+
+        # Host mirrors of per-slot device state (engine thread only). They
+        # are the source of truth at slot transitions (admit/finish mark
+        # `_dev_dirty` → re-upload); between transitions the decode state
+        # stays device-resident (`_dev`) and advances on-device, so steady
+        # decode uploads only the RNG key per step.
         self._page_tables = np.zeros((B, P), dtype=np.int32)
         self._seq_lens = np.zeros((B,), dtype=np.int32)
         self._last_tokens = np.zeros((B,), dtype=np.int32)
@@ -163,6 +275,8 @@ class InferenceEngine:
         self._temperature = np.zeros((B,), dtype=np.float32)
         self._top_p = np.ones((B,), dtype=np.float32)
         self._slots: list[Optional[_Slot]] = [None] * B
+        self._dev: dict = {}
+        self._dev_dirty = True
 
         self._key = jax.random.PRNGKey(seed + 1)
         self._submit: queue.Queue[GenRequest] = queue.Queue()
@@ -306,12 +420,17 @@ class InferenceEngine:
         max_new = max(
             1,
             min(request.max_new_tokens, cfg.max_new_tokens_cap,
-                cfg.max_seq_len - 1),
+                cfg.max_seq_len - 1 - self._gamma),
         )
         # Leave room for generation within the per-request position cap
-        # (max_new ≤ max_seq_len-1 guarantees max_prompt ≥ 1, so the
-        # tail-truncation slice below can never be [-0:]).
-        max_prompt = min(max(cfg.prefill_buckets), cfg.max_seq_len - max_new)
+        # (max_new ≤ max_seq_len-1-gamma guarantees max_prompt ≥ 1, so the
+        # tail-truncation slice below can never be [-0:]). The gamma slack
+        # keeps the final speculative verify window's overdraft inside the
+        # request's own pages (spec_decode.py module docstring).
+        max_prompt = min(
+            max(cfg.prefill_buckets),
+            cfg.max_seq_len - max_new - self._gamma,
+        )
         if len(prompt_ids) > max_prompt:
             prompt_ids = prompt_ids[-max_prompt:]  # keep the prompt tail
         prompt_len = len(prompt_ids)
@@ -321,7 +440,7 @@ class InferenceEngine:
         assert bucket is not None  # max_prompt <= max bucket
 
         total_len = prompt_len + max_new
-        num_pages = -(-total_len // cfg.page_size)  # ceil
+        num_pages = -(-(total_len + self._gamma) // cfg.page_size)  # ceil
         pages = self.allocator.alloc(num_pages)     # may raise AllocationError
 
         try:
@@ -332,17 +451,25 @@ class InferenceEngine:
             tokens[0, :prompt_len] = prompt_ids
 
             self._key, key = jax.random.split(self._key)
-            first_token, self.paged = _prefill_step(
-                self.params,
-                self.model_cfg,
-                self.paged,
-                jnp.asarray(tokens),
-                jnp.asarray([prompt_len], dtype=jnp.int32),
-                jnp.asarray(page_table),
-                key,
-                jnp.asarray([request.temperature], dtype=jnp.float32),
-                jnp.asarray([request.top_p], dtype=jnp.float32),
+            put = partial(jax.device_put, device=self._repl)
+            args = (
+                put(tokens),
+                put(np.asarray([prompt_len], dtype=np.int32)),
+                put(page_table),
+                put(key),
+                put(np.asarray([request.temperature], dtype=np.float32)),
+                put(np.asarray([request.top_p], dtype=np.float32)),
             )
+            if self._spec:
+                first_token, self.paged, self.d_paged = self._jit_spec_prefill(
+                    self.params, self.draft_params,
+                    self.model_cfg, self.draft_cfg,
+                    self.paged, self.d_paged, *args,
+                )
+            else:
+                first_token, self.paged = self._jit_prefill(
+                    self.params, self.model_cfg, self.paged, *args
+                )
             first_token = int(first_token)
         except Exception:
             # Pages are only owned by a _Slot after prefill succeeds; give
@@ -359,26 +486,55 @@ class InferenceEngine:
         self._active[slot_idx] = True
         self._temperature[slot_idx] = request.temperature
         self._top_p[slot_idx] = request.top_p
+        self._dev_dirty = True
 
         request.timings.first_token = time.monotonic()
         request.out.put(("token", first_token))
         self._maybe_finish(slot_idx, first_token)
 
+    def _upload_slot_state(self) -> None:
+        self._dev = {
+            "last_tokens": jax.device_put(self._last_tokens, self._dp_vec),
+            "seq_lens": jax.device_put(self._seq_lens, self._dp_vec),
+            "page_tables": jax.device_put(self._page_tables, self._dp_mat),
+            "active": jax.device_put(self._active, self._dp_vec),
+            "temperature": jax.device_put(self._temperature, self._dp_vec),
+            "top_p": jax.device_put(self._top_p, self._dp_vec),
+        }
+        self._dev_dirty = False
+
     def _step(self) -> None:
+        if self._dev_dirty:
+            self._upload_slot_state()
+        dev = self._dev
         self._key, key = jax.random.split(self._key)
-        tokens, self.paged = _decode_step(
+        # top_p truncation breaks the rejection-sampling identity, so a
+        # batch containing any top_p<1 row takes the plain step. Note the
+        # blast radius is batch-wide, not per-request: speculation is off
+        # for every slot while such a row is active, and the plain steps
+        # leave draft-cache holes for all rows, so acceptance stays
+        # collapsed for surviving streams afterwards. Correctness never
+        # degrades; throughput recovers as those streams retire.
+        if self._spec and bool(np.all(self._top_p[self._active] >= 1.0)):
+            self._spec_step(dev, key)
+            return
+        tokens_dev, seq_lens_dev, self.paged = self._jit_decode(
             self.params,
             self.model_cfg,
             self.paged,
-            jnp.asarray(self._last_tokens),
-            jnp.asarray(self._seq_lens),
-            jnp.asarray(self._page_tables),
-            jnp.asarray(self._active),
-            key,
-            jnp.asarray(self._temperature),
-            jnp.asarray(self._top_p),
+            dev["last_tokens"],
+            dev["seq_lens"],
+            dev["page_tables"],
+            dev["active"],
+            jax.device_put(key, self._repl),
+            dev["temperature"],
+            dev["top_p"],
         )
-        tokens = np.asarray(tokens)  # blocks until the step completes
+        # Feed the sampled tokens / advanced lengths straight back as next
+        # step's inputs; host mirrors update below for bookkeeping only.
+        dev["last_tokens"] = tokens_dev
+        dev["seq_lens"] = seq_lens_dev
+        tokens = np.asarray(tokens_dev)  # blocks until the step completes
 
         emitted = 0
         for i, slot in enumerate(self._slots):
@@ -395,6 +551,50 @@ class InferenceEngine:
             emitted += 1
             self._maybe_finish(i, token)
         self.metrics.on_step(emitted)
+
+    def _spec_step(self, dev: dict, key) -> None:
+        """One draft/verify round (spec_decode.py); emits ≤ gamma+1 tokens
+        per slot, truncated on host by EOS / budget caps."""
+        (emit_dev, n_out_dev, new_last, new_seq, self.paged,
+         self.d_paged) = self._jit_spec_decode(
+            self.params, self.draft_params,
+            self.model_cfg, self.draft_cfg,
+            self.paged, self.d_paged,
+            dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
+            dev["active"], jax.device_put(key, self._repl),
+            dev["temperature"], gamma=self._gamma,
+        )
+        dev["last_tokens"] = new_last
+        dev["seq_lens"] = new_seq
+        emit = np.asarray(emit_dev)      # blocks until the round completes
+        n_out = np.asarray(n_out_dev)
+
+        emitted = accepted = proposed = 0
+        for i, slot in enumerate(self._slots):
+            if slot is None or not self._active[i]:
+                continue
+            if slot.request.cancelled.is_set():
+                self._finish(i, error="cancelled")
+                continue
+            sent = 0
+            for j in range(int(n_out[i])):
+                token = int(emit[i, j])
+                slot.generated += 1
+                self._seq_lens[i] += 1
+                self._last_tokens[i] = token
+                slot.request.out.put(("token", token))
+                sent += 1
+                self._maybe_finish(i, token)
+                if self._slots[i] is None:   # finished mid-window
+                    break
+            emitted += sent
+            # ADVICE r1: acceptance counted over actually-emitted tokens
+            # only (the stat is the speedup tuning dial — budget-truncated
+            # tail tokens must not inflate it).
+            accepted += min(int(n_out[i]) - 1, sent)
+            proposed += self._gamma
+        self.metrics.on_step(emitted)
+        self.metrics.on_spec(accepted, proposed)
 
     def _maybe_finish(self, slot_idx: int, token: int) -> None:
         slot = self._slots[slot_idx]
@@ -422,6 +622,7 @@ class InferenceEngine:
         self._seq_lens[slot_idx] = 0
         self._last_tokens[slot_idx] = 0
         self._page_tables[slot_idx] = 0
+        self._dev_dirty = True
         if error is not None:
             request.out.put(("error", error))
             self.metrics.on_finish(request.timings, failed=True)

@@ -50,6 +50,8 @@ class EngineMetrics:
         self.decode_steps = 0
         self.ttft_ms_sum = 0.0
         self.ttft_ms_count = 0
+        self.drafts_accepted = 0
+        self.drafts_proposed = 0
         self._window_start = time.monotonic()
         self._window_tokens = 0
         self.tokens_per_sec = 0.0
@@ -70,6 +72,13 @@ class EngineMetrics:
                 self._window_start = now
                 self._window_tokens = 0
 
+    def on_spec(self, accepted: int, proposed: int) -> None:
+        """Per-round speculative counters; acceptance rate is the speedup
+        dial (engine._spec_step counts emitted tokens only — ADVICE r1)."""
+        with self._lock:
+            self.drafts_accepted += accepted
+            self.drafts_proposed += proposed
+
     def on_finish(self, timings: RequestTimings, failed: bool = False) -> None:
         with self._lock:
             if failed:
@@ -87,7 +96,7 @@ class EngineMetrics:
                 if self.ttft_ms_count
                 else 0.0
             )
-            return {
+            snap = {
                 "requests_admitted": self.requests_admitted,
                 "requests_completed": self.requests_completed,
                 "requests_failed": self.requests_failed,
@@ -96,3 +105,10 @@ class EngineMetrics:
                 "tokens_per_sec": round(self.tokens_per_sec, 2),
                 "mean_ttft_ms": round(mean_ttft, 2),
             }
+            if self.drafts_proposed:
+                snap["drafts_accepted"] = self.drafts_accepted
+                snap["drafts_proposed"] = self.drafts_proposed
+                snap["spec_acceptance"] = round(
+                    self.drafts_accepted / self.drafts_proposed, 3
+                )
+            return snap

@@ -1,0 +1,155 @@
+"""Speculative decoding over the paged cache: the engine's draft/verify step.
+
+models/speculative.py proves the draft/verify recurrence on the contiguous
+cache; this module carries it into the serving path (measurement config 5 —
+BASELINE.md: "server-streamed gRPC with speculative decode"). The cache-
+rewind question the contiguous design dodges (VERDICT r1 weak #7) resolves
+the same way for the paged layout: position p always maps to the same
+physical slot (page_tables[p // page_size], p % page_size), so stale KV
+written for rejected drafts at positions ≥ the accepted frontier is
+overwritten by the next verify window's own writes *before* any query
+attends it — the window starts exactly at the frontier and spans gamma+1
+positions, which covers every stale slot (positions advance by ≤ gamma+1
+per round). The engine allocates `gamma` extra positions of page slack per
+request so the final window's overdraft lands in owned pages, never page 0.
+
+Per-row sampling settings are data (temperature [B]): greedy rows accept by
+exact argmax match; sampled rows use Leviathan-style rejection sampling.
+top_p is NOT supported on this path — truncation breaks the residual
+identity — so the engine routes any step whose batch contains a top_p < 1
+row through the plain decode step instead (engine.py `_step`).
+
+Both functions are pure; the engine jits them with its mesh out_shardings.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..models.config import ModelConfig
+from ..models.transformer import forward_paged, unembed
+
+
+def spec_prefill_fn(
+    t_params, d_params, t_cfg: ModelConfig, d_cfg: ModelConfig,
+    t_paged, d_paged,
+    tokens, seq_len, page_table, key, temperature, top_p,
+):
+    """Prefill BOTH caches for one request; first token from the TARGET.
+
+    Same contract as engine._prefill_fn plus the draft pool: the draft model
+    must see the full prompt or its proposals start from a cold cache and
+    acceptance collapses.
+    """
+    from .sampling import sample_dynamic
+
+    T = tokens.shape[1]
+    positions = jnp.arange(T, dtype=jnp.int32)[None, :]
+    hidden, t_paged = forward_paged(
+        t_params, t_cfg, tokens, positions, t_paged, page_table
+    )
+    _, d_paged = forward_paged(
+        d_params, d_cfg, tokens, positions, d_paged, page_table
+    )
+    last = hidden[0, seq_len[0] - 1][None]
+    logits = unembed(t_params, t_cfg, last)
+    token = sample_dynamic(logits, key, temperature, top_p)
+    return token[0], t_paged, d_paged
+
+
+def spec_decode_fn(
+    t_params, d_params, t_cfg: ModelConfig, d_cfg: ModelConfig,
+    t_paged, d_paged,
+    last_tokens, seq_lens, page_tables, active, key, temperature,
+    gamma: int,
+):
+    """One draft/verify round for the whole slot batch.
+
+    Returns (emit [B, gamma+1], n_out [B], new_last [B], new_seq_lens [B],
+    t_paged, d_paged). Row semantics: `last_tokens` is
+    the already-emitted token at position seq_lens-1 whose KV is not yet
+    written (the same invariant as the plain decode step); the round emits
+    n_out = n_acc+1 tokens per active row. Greedy rows reproduce the
+    target's exact greedy chain for any draft model.
+    """
+    B = last_tokens.shape[0]
+    rows = jnp.arange(B, dtype=jnp.int32)
+    pos = jnp.maximum(seq_lens - 1, 0)
+    greedy_row = temperature == 0.0                       # [B]
+    temp = jnp.maximum(temperature, 1e-6)                 # [B]
+
+    # --- Draft gamma tokens autoregressively (bandwidth-light model). -----
+    def draft_step(carry, k):
+        d_paged, tok, p = carry
+        hidden, d_paged = forward_paged(
+            d_params, d_cfg, tok[:, None], p[:, None], d_paged, page_tables
+        )
+        logits = unembed(d_params, d_cfg, hidden[:, 0])   # [B, V]
+        dist = jax.nn.softmax(logits / temp[:, None], axis=-1)
+        sampled = jax.random.categorical(
+            k, logits / temp[:, None], axis=-1
+        ).astype(jnp.int32)
+        nxt = jnp.where(
+            greedy_row, jnp.argmax(logits, axis=-1).astype(jnp.int32), sampled
+        )
+        return (d_paged, nxt, p + 1), (nxt, dist)
+
+    key, kd = jax.random.split(key)
+    (d_paged, _, _), (drafts, d_dists) = jax.lax.scan(
+        draft_step, (d_paged, last_tokens, pos), jax.random.split(kd, gamma)
+    )
+    drafts = drafts.T                                     # [B, gamma]
+    d_dists = jnp.swapaxes(d_dists, 0, 1)                 # [B, gamma, V]
+
+    # --- Verify: ONE target forward over [prev, drafts] (gamma+1 wide —
+    # prefill-shaped MXU work instead of gamma bandwidth-bound steps). -----
+    window = jnp.concatenate([last_tokens[:, None], drafts], axis=1)
+    w_pos = pos[:, None] + jnp.arange(gamma + 1, dtype=jnp.int32)[None, :]
+    t_hidden, t_paged = forward_paged(
+        t_params, t_cfg, window, w_pos, t_paged, page_tables
+    )
+    t_logits = unembed(t_params, t_cfg, t_hidden)         # [B, gamma+1, V]
+    # Draft-cache sync over the same window: the scan wrote pos..pos+γ-1
+    # only, so on full acceptance slot pos+γ would be a permanent hole
+    # (models/speculative.py:164-169 rationale, ported to pages).
+    _, d_paged = forward_paged(
+        d_params, d_cfg, window, w_pos, d_paged, page_tables
+    )
+
+    # --- Acceptance: exact-match for greedy rows, rejection sampling else
+    # (shared math: models/speculative.py rejection_accept /
+    # residual_extra_dist — one implementation for both cache layouts). ---
+    from ..models.speculative import rejection_accept, residual_extra_dist
+
+    t_choice = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # [B, γ+1]
+    match = drafts == t_choice[:, :gamma]
+
+    t_probs = jax.nn.softmax(t_logits / temp[:, None, None], axis=-1)
+    key, ka = jax.random.split(key)
+    u = jax.random.uniform(ka, (B, gamma))
+    accept_sampled = rejection_accept(t_probs, d_dists, drafts, u)
+
+    accept = jnp.where(greedy_row[:, None], match, accept_sampled)
+    acc = jnp.cumprod(accept.astype(jnp.int32), axis=1)
+    n_acc = jnp.sum(acc, axis=1)                          # [B]
+
+    # Extra token: target argmax at the frontier (greedy) / residual or
+    # bonus sample (sampled rows) [Leviathan et al. 2023].
+    dist = residual_extra_dist(t_probs, d_dists, n_acc)
+    key, kr = jax.random.split(key)
+    extra_sampled = jax.random.categorical(
+        kr, jnp.log(jnp.maximum(dist, 1e-20)), axis=-1
+    ).astype(jnp.int32)
+    extra = jnp.where(greedy_row, t_choice[rows, n_acc], extra_sampled)
+
+    # --- Emit accepted prefix + extra; advance per-row state. -------------
+    emit = jnp.concatenate([drafts, jnp.zeros((B, 1), jnp.int32)], axis=1)
+    emit = emit.at[rows, n_acc].set(extra)                # [B, gamma+1]
+    n_out = (n_acc + 1) * active.astype(jnp.int32)
+    emit = jnp.where(active[:, None], emit, 0)
+    new_seq_lens = seq_lens + n_out
+    new_last = jnp.where(
+        active, emit[rows, jnp.maximum(n_out - 1, 0)], last_tokens
+    )
+    return emit, n_out, new_last, new_seq_lens, t_paged, d_paged

@@ -45,6 +45,47 @@ def _token_probs(logits: jax.Array, temperature: float) -> jax.Array:
     return jax.nn.softmax(logits / jnp.maximum(temperature, 1e-6), axis=-1)
 
 
+def rejection_accept(
+    t_probs: jax.Array,       # [B, gamma(+1), V] target probs
+    d_dists: jax.Array,       # [B, gamma, V] draft probs (as sampled)
+    drafts: jax.Array,        # [B, gamma] draft tokens
+    u: jax.Array,             # [B, gamma] uniform(0,1)
+) -> jax.Array:
+    """Leviathan acceptance test: accept draft x with prob
+    min(1, p_t(x)/p_d(x)). Shared by the contiguous path below and the
+    paged serving path (engine/spec_decode.py) so a numerical fix lands in
+    both."""
+    gamma = drafts.shape[1]
+    p_t = jnp.take_along_axis(
+        t_probs[:, :gamma], drafts[..., None], axis=-1
+    )[..., 0]
+    p_d = jnp.take_along_axis(d_dists, drafts[..., None], axis=-1)[..., 0]
+    return u < jnp.minimum(1.0, p_t / jnp.maximum(p_d, 1e-20))
+
+
+def residual_extra_dist(
+    t_probs: jax.Array,       # [B, gamma+1, V]
+    d_dists: jax.Array,       # [B, gamma, V]
+    n_acc: jax.Array,         # [B] accepted-prefix lengths
+) -> jax.Array:
+    """[B, V] distribution for the extra token: the normalized residual
+    max(p_t - p_d, 0) at the first rejection, or the target's distribution
+    at the bonus position when all gamma drafts were accepted; degenerate
+    zero-mass residuals fall back to the target distribution."""
+    B, g1, _ = t_probs.shape
+    gamma = g1 - 1
+    rows = jnp.arange(B, dtype=jnp.int32)
+    all_acc = n_acc == gamma
+    p_t_x = t_probs[rows, n_acc]
+    p_d_x = d_dists[rows, jnp.minimum(n_acc, gamma - 1)]
+    residual = jnp.maximum(p_t_x - p_d_x, 0.0)
+    res_mass = jnp.sum(residual, axis=-1, keepdims=True)
+    residual = jnp.where(
+        res_mass > 1e-20, residual / jnp.maximum(res_mass, 1e-20), p_t_x
+    )
+    return jnp.where(all_acc[:, None], p_t_x, residual)
+
+
 @partial(
     jax.jit,
     static_argnames=(
@@ -180,29 +221,14 @@ def speculative_generate(
             extra = t_choice[rows, n_acc]
         else:
             t_probs = _token_probs(t_logits, sampling.temperature)  # [B,γ+1,V]
-            p_t = jnp.take_along_axis(
-                t_probs[:, :gamma], drafts[..., None], axis=-1
-            )[..., 0]                                  # [B, gamma]
-            p_d = jnp.take_along_axis(
-                d_dists, drafts[..., None], axis=-1
-            )[..., 0]                                  # [B, gamma]
             u = jax.random.uniform(ka, (B, gamma))
-            accept = u < jnp.minimum(1.0, p_t / jnp.maximum(p_d, 1e-20))
+            accept = rejection_accept(t_probs, d_dists, drafts, u)
             acc = jnp.cumprod(accept.astype(jnp.int32), axis=1)
             n_acc = jnp.sum(acc, axis=1)
             # First rejection: sample the normalized residual
             # max(p_t - p_d, 0); all accepted: bonus-sample the target's
             # distribution at the extra position [Leviathan et al. 2023].
-            all_acc = n_acc == gamma
-            p_t_x = t_probs[rows, n_acc]               # [B, V]
-            p_d_x = d_dists[rows, jnp.minimum(n_acc, gamma - 1)]
-            residual = jnp.maximum(p_t_x - p_d_x, 0.0)
-            res_mass = jnp.sum(residual, axis=-1, keepdims=True)
-            residual = jnp.where(
-                res_mass > 1e-20, residual / jnp.maximum(res_mass, 1e-20),
-                p_t_x,
-            )
-            dist = jnp.where(all_acc[:, None], p_t_x, residual)
+            dist = residual_extra_dist(t_probs, d_dists, n_acc)
             key, kr = jax.random.split(key)
             extra = jax.random.categorical(
                 kr, jnp.log(jnp.maximum(dist, 1e-20)), axis=-1

@@ -52,9 +52,18 @@ _TOP_RULES: dict[tuple[str, ...], P] = {
 
 
 def _spec_for_path(path: tuple[str, ...]) -> P:
+    # Quantized leaves (models/quant.py QuantizedTensor): `q` keeps the
+    # weight's spec; `s` is the weight shape minus the contraction (-2)
+    # axis, so its spec is the weight spec with that axis dropped
+    # (e.g. wq [L, H, out] P("pp", None, "tp") → s [L, out] P("pp", "tp")).
+    if path and path[-1] in ("q", "s"):
+        base = _spec_for_path(path[:-1])
+        if path[-1] == "q":
+            return base
+        return P(*base[:-2], base[-1]) if len(base) >= 2 else base
     if path in _TOP_RULES:
         return _TOP_RULES[path]
-    if path[0] == "layers":
+    if path and path[0] == "layers":
         layer_path = path[1:]
         if layer_path in _LAYER_RULES:
             inner = _LAYER_RULES[layer_path]
@@ -67,6 +76,8 @@ def _path_keys(path) -> tuple[str, ...]:
     for entry in path:
         if isinstance(entry, jax.tree_util.DictKey):
             keys.append(str(entry.key))
+        elif isinstance(entry, jax.tree_util.GetAttrKey):
+            keys.append(entry.name)  # QuantizedTensor fields: 'q' / 's'
         else:
             keys.append(str(entry))
     return tuple(keys)

@@ -1,0 +1,120 @@
+"""Meshed serving engine: tp/dp-sharded decode must match single-device.
+
+VERDICT r1 #2: the engine's tp/dp knobs must actually shard params, page
+pools, and the decode batch (parallel/sharding.py specs). The acceptance
+check is exact greedy equality — same tokens from a tp=2 / dp=2 / tp×dp
+engine as from the tp=dp=1 engine (fp32 on the simulated CPU mesh, so
+reduction-order drift can't flip an argmax for these seeds).
+"""
+
+import dataclasses
+import queue
+import time
+
+import jax
+import pytest
+
+from polykey_tpu.engine.config import EngineConfig
+from polykey_tpu.engine.engine import GenRequest, InferenceEngine
+
+BASE_CONFIG = EngineConfig(
+    model="tiny-llama",
+    tokenizer="byte",
+    dtype="float32",
+    max_decode_slots=4,
+    page_size=8,
+    num_pages=64,
+    max_seq_len=64,
+    prefill_buckets=(16, 32),
+    max_new_tokens_cap=32,
+    default_max_new_tokens=8,
+)
+
+PROMPTS = ["hello mesh", "sharded decoding", "a", "the quick brown fox"]
+
+
+def _collect(request: GenRequest, timeout=60.0):
+    tokens, done, error = [], None, None
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            kind, value = request.out.get(timeout=deadline - time.monotonic())
+        except queue.Empty:
+            break
+        if kind == "token":
+            tokens.append(value)
+        elif kind == "done":
+            done = value
+            break
+        else:
+            error = value
+            break
+    return tokens, done, error
+
+
+def _run_prompts(config: EngineConfig, quantize: bool = False):
+    eng = InferenceEngine(dataclasses.replace(config, quantize=quantize))
+    try:
+        requests = [GenRequest(prompt=p, max_new_tokens=8) for p in PROMPTS]
+        for r in requests:
+            eng.submit(r)
+        outs = []
+        for r in requests:
+            tokens, done, error = _collect(r)
+            assert error is None, error
+            assert done is not None
+            outs.append(tokens)
+        return outs
+    finally:
+        eng.shutdown()
+
+
+@pytest.fixture(scope="module")
+def reference_outputs():
+    return _run_prompts(BASE_CONFIG)
+
+
+def _needs(n):
+    return pytest.mark.skipif(
+        jax.device_count() < n, reason=f"needs {n} devices"
+    )
+
+
+@_needs(2)
+def test_tp2_matches_single_device(reference_outputs):
+    assert _run_prompts(
+        dataclasses.replace(BASE_CONFIG, tp=2)
+    ) == reference_outputs
+
+
+@_needs(2)
+def test_dp2_matches_single_device(reference_outputs):
+    assert _run_prompts(
+        dataclasses.replace(BASE_CONFIG, dp=2)
+    ) == reference_outputs
+
+
+@_needs(4)
+def test_tp2_dp2_matches_single_device(reference_outputs):
+    assert _run_prompts(
+        dataclasses.replace(BASE_CONFIG, tp=2, dp=2)
+    ) == reference_outputs
+
+
+@_needs(2)
+def test_tp2_quantized_matches_quantized(reference_outputs):
+    # Quantized trees shard through the same specs (QuantizedTensor q/s
+    # leaves — parallel/sharding._spec_for_path); equality target is the
+    # single-device *quantized* engine since int8 changes the logits.
+    ref = _run_prompts(BASE_CONFIG, quantize=True)
+    assert _run_prompts(
+        dataclasses.replace(BASE_CONFIG, tp=2), quantize=True
+    ) == ref
+
+
+def test_bad_geometry_rejected():
+    with pytest.raises(ValueError):
+        InferenceEngine(dataclasses.replace(BASE_CONFIG, dp=3))  # 3 ∤ 4 slots
+    with pytest.raises(ValueError):
+        # tiny-llama has 2 kv heads; tp=4 can't shard them.
+        InferenceEngine(dataclasses.replace(BASE_CONFIG, tp=4))
