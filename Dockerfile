@@ -25,11 +25,16 @@ RUN make native
 
 # ---- deps: python environment ----------------------------------------------
 FROM ${PYTHON_IMAGE} AS deps
+# Exact pins from the committed lockfile so tester/production/CI images are
+# reproducible and don't drift with upstream releases (a jax minor bump can
+# silently change Pallas/shard_map behavior the kernels depend on).
 # CPU wheels by default; TPU VMs build with --build-arg JAX_EXTRA=[tpu].
 ARG JAX_EXTRA=
-RUN pip install --no-cache-dir \
-        "jax${JAX_EXTRA}" flax optax grpcio grpcio-health-checking \
-        grpcio-reflection protobuf numpy
+COPY requirements.lock ./
+RUN pip install --no-cache-dir -r requirements.lock \
+    && if [ -n "${JAX_EXTRA}" ]; then \
+         pip install --no-cache-dir "jax${JAX_EXTRA}==$(pip show jax | awk '/^Version/{print $2}')"; \
+       fi
 
 # ---- tester: hermetic test run (reference Dockerfile:44-48) -----------------
 FROM deps AS tester

@@ -36,12 +36,18 @@ class EngineConfig:
     quantize: bool = False               # int8 weight-only (models/quant.py)
 
     # Decode-batch geometry (static shapes; compile-time constants).
-    max_decode_slots: int = 8
+    # Defaults target real serving lengths (VERDICT r1 #5): 4k positions
+    # per request, 32k pooled KV token-slots. Prompts longer than the
+    # largest bucket prefill in `prefill_chunk`-sized chunks interleaved
+    # with decode steps, so a long prompt never stalls running streams for
+    # more than one chunk.
+    max_decode_slots: int = 16
     page_size: int = 16
-    num_pages: int = 512                 # includes reserved garbage page 0
-    max_seq_len: int = 256               # per-request position cap
-    prefill_buckets: tuple[int, ...] = (32, 64, 128)
-    max_new_tokens_cap: int = 128
+    num_pages: int = 2048                # includes reserved garbage page 0
+    max_seq_len: int = 4096              # per-request position cap
+    prefill_buckets: tuple[int, ...] = (128, 512)
+    prefill_chunk: int = 0               # 0 → max(prefill_buckets)
+    max_new_tokens_cap: int = 1024
     default_max_new_tokens: int = 64
 
     # Parallelism axes (parallel/mesh.py); 1 → axis unused.
@@ -81,6 +87,7 @@ class EngineConfig:
             prefill_buckets=tuple(
                 int(x) for x in buckets.split(",")
             ) if buckets else cls.prefill_buckets,
+            prefill_chunk=_env_int("POLYKEY_PREFILL_CHUNK", cls.prefill_chunk),
             max_new_tokens_cap=_env_int(
                 "POLYKEY_MAX_NEW_TOKENS_CAP", cls.max_new_tokens_cap
             ),
@@ -115,3 +122,5 @@ class EngineConfig:
             raise ValueError("need at least one prefill bucket")
         if self.draft_model is not None and self.spec_gamma < 1:
             raise ValueError("spec_gamma must be >= 1")
+        if self.prefill_chunk < 0:
+            raise ValueError("prefill_chunk must be >= 0 (0 → max bucket)")

@@ -71,17 +71,30 @@ class _Slot:
     pages: list[int]
     generated: int = 0
     position_cap: int = 0      # absolute position limit for this request
+    # Chunked-prefill state: prompts longer than the largest bucket hold
+    # their ids here and prefill one chunk per engine-loop iteration;
+    # `pending is None` ⇔ the slot is decoding (or short-prompt prefilled).
+    pending: Optional[np.ndarray] = None
+    filled: int = 0            # prompt positions already prefilled
 
 
 def _prefill_fn(
     params, cfg: ModelConfig, paged: PagedKV,
-    tokens, seq_len, page_table, key, temperature, top_p,
+    tokens, start, last_rel, page_table, key, temperature, top_p,
 ):
-    """Prefill one request (tokens [1, T_bucket]) and sample its first token."""
+    """Prefill one window (tokens [1, T]) at absolute positions
+    start..start+T-1 and sample from the hidden state at relative index
+    last_rel. Short prompts run as one window; long prompts run as a chain
+    of fixed-size chunks through this same function (the engine discards
+    the sampled token for all but the final chunk), so one compiled shape
+    serves both paths. Padded tail positions write KV that is either
+    masked (position > any query), overwritten by later decode steps, or
+    lands on the reserved garbage page — never read.
+    """
     T = tokens.shape[1]
-    positions = jnp.arange(T, dtype=jnp.int32)[None, :]
+    positions = start[0] + jnp.arange(T, dtype=jnp.int32)[None, :]
     hidden, paged = forward_paged(params, cfg, tokens, positions, paged, page_table)
-    last = hidden[0, seq_len[0] - 1][None]                 # [1, H]
+    last = hidden[0, last_rel[0]][None]                    # [1, H]
     logits = unembed(params, cfg, last)                    # [1, V]
     token = sample_dynamic(logits, key, temperature, top_p)
     return token[0], paged
@@ -202,6 +215,8 @@ class InferenceEngine:
         )
         self.allocator = BlockAllocator(config.num_pages)
 
+        self._chunk = config.prefill_chunk or max(config.prefill_buckets)
+
         # --- Speculative decoding: draft model + its own page pool, same
         # page tables (position → (page, offset) is model-independent).
         self._spec = config.draft_model is not None
@@ -312,7 +327,7 @@ class InferenceEngine:
         snap.update(
             {
                 "model": self.model_cfg.name,
-                "slots_busy": int(self._active.sum()),
+                "slots_busy": sum(s is not None for s in self._slots),
                 "slots_total": self.config.max_decode_slots,
                 "pages_free": self.allocator.num_free,
                 "pages_total": self.config.num_pages,
@@ -323,7 +338,11 @@ class InferenceEngine:
 
     @property
     def busy(self) -> bool:
-        return bool(self._active.any()) or not self._submit.empty()
+        return (
+            bool(self._active.any())
+            or not self._submit.empty()
+            or any(s is not None for s in self._slots)
+        )
 
     def shutdown(self, timeout: float = 10.0) -> None:
         self._stop.set()
@@ -339,9 +358,16 @@ class InferenceEngine:
                     self._fail_all(self.dead)
                     return
                 # While streams are decoding, admit at most one prefill per
-                # step so running streams stall for ≤ one prefill bucket.
+                # step so running streams stall for ≤ one prefill bucket;
+                # long prompts advance one chunk per iteration for the same
+                # reason (chunked prefill — never more than one chunk of
+                # stall between decode steps).
                 limit = 1 if self._active.any() else None
                 worked = self._admit(limit)
+                chunk_slot = self._chunk_pending_slot()
+                if chunk_slot is not None:
+                    self._prefill_one_chunk(chunk_slot)
+                    worked = True
                 if self._active.any():
                     self._step()
                     worked = True
@@ -426,40 +452,68 @@ class InferenceEngine:
         # (max_new ≤ max_seq_len-1-gamma guarantees max_prompt ≥ 1, so the
         # tail-truncation slice below can never be [-0:]). The gamma slack
         # keeps the final speculative verify window's overdraft inside the
-        # request's own pages (spec_decode.py module docstring).
-        max_prompt = min(
-            max(cfg.prefill_buckets),
-            cfg.max_seq_len - max_new - self._gamma,
-        )
+        # request's own pages (spec_decode.py module docstring). Prompts
+        # beyond the largest bucket go through chunked prefill, so the cap
+        # is the position budget, not the bucket table.
+        max_prompt = cfg.max_seq_len - max_new - self._gamma
         if len(prompt_ids) > max_prompt:
             prompt_ids = prompt_ids[-max_prompt:]  # keep the prompt tail
         prompt_len = len(prompt_ids)
         request.timings.prompt_tokens = prompt_len
 
-        bucket = self._bucket_for(prompt_len)
-        assert bucket is not None  # max_prompt <= max bucket
-
         total_len = prompt_len + max_new
         num_pages = -(-(total_len + self._gamma) // cfg.page_size)  # ceil
         pages = self.allocator.alloc(num_pages)     # may raise AllocationError
 
-        try:
-            page_table = np.zeros((1, cfg.pages_per_seq), dtype=np.int32)
-            page_table[0, : len(pages)] = pages
+        page_table = np.zeros((1, cfg.pages_per_seq), dtype=np.int32)
+        page_table[0, : len(pages)] = pages
+        slot = _Slot(request=request, pages=pages, position_cap=total_len)
+        bucket = self._bucket_for(prompt_len)
 
+        if bucket is None:
+            # Long prompt: register the slot in prefilling state; the
+            # engine loop runs one chunk per iteration (interleaved with
+            # decode steps) until the prompt is in cache.
+            slot.pending = np.asarray(prompt_ids, dtype=np.int32)
+            self._slots[slot_idx] = slot
+            self._page_tables[slot_idx] = page_table[0]
+            return
+
+        try:
             tokens = np.zeros((1, bucket), dtype=np.int32)
             tokens[0, :prompt_len] = prompt_ids
-
-            self._key, key = jax.random.split(self._key)
-            put = partial(jax.device_put, device=self._repl)
-            args = (
-                put(tokens),
-                put(np.asarray([prompt_len], dtype=np.int32)),
-                put(page_table),
-                put(key),
-                put(np.asarray([request.temperature], dtype=np.float32)),
-                put(np.asarray([request.top_p], dtype=np.float32)),
+            first_token = self._run_prefill(
+                tokens, 0, prompt_len - 1, page_table, request
             )
+        except Exception:
+            # Pages are only owned by a _Slot after prefill succeeds; give
+            # them back on any failure in between or they leak forever.
+            self.allocator.release_all(pages)
+            raise
+
+        self._slots[slot_idx] = slot
+        self._page_tables[slot_idx] = page_table[0]
+        self._activate_slot(slot_idx, slot, prompt_len, first_token)
+
+    def _run_prefill(
+        self, tokens: np.ndarray, start: int, last_rel: int,
+        page_table: np.ndarray, request: GenRequest,
+    ) -> int:
+        """One prefill window at absolute offset `start`, sampling from
+        relative index `last_rel` (callers discard the sample for non-final
+        chunks)."""
+        self._key, key = jax.random.split(self._key)
+        put = partial(jax.device_put, device=self._repl)
+        args = (
+            put(tokens),
+            put(np.asarray([start], dtype=np.int32)),
+            put(np.asarray([last_rel], dtype=np.int32)),
+            put(np.ascontiguousarray(page_table)),
+            put(key),
+            put(np.asarray([request.temperature], dtype=np.float32)),
+            put(np.asarray([request.top_p], dtype=np.float32)),
+        )
+        with jax.profiler.TraceAnnotation("polykey/prefill"):
             if self._spec:
                 first_token, self.paged, self.d_paged = self._jit_spec_prefill(
                     self.params, self.draft_params,
@@ -470,17 +524,15 @@ class InferenceEngine:
                 first_token, self.paged = self._jit_prefill(
                     self.params, self.model_cfg, self.paged, *args
                 )
-            first_token = int(first_token)
-        except Exception:
-            # Pages are only owned by a _Slot after prefill succeeds; give
-            # them back on any failure in between or they leak forever.
-            self.allocator.release_all(pages)
-            raise
+            return int(first_token)
 
-        slot = _Slot(request=request, pages=pages, generated=1,
-                     position_cap=total_len)
-        self._slots[slot_idx] = slot
-        self._page_tables[slot_idx] = page_table[0]
+    def _activate_slot(
+        self, slot_idx: int, slot: _Slot, prompt_len: int, first_token: int
+    ) -> None:
+        """Move a fully-prefilled slot into the decode batch."""
+        request = slot.request
+        slot.generated = 1
+        slot.pending = None
         self._seq_lens[slot_idx] = prompt_len + 1  # prompt + sampled token
         self._last_tokens[slot_idx] = first_token
         self._active[slot_idx] = True
@@ -491,6 +543,40 @@ class InferenceEngine:
         request.timings.first_token = time.monotonic()
         request.out.put(("token", first_token))
         self._maybe_finish(slot_idx, first_token)
+
+    def _chunk_pending_slot(self) -> Optional[int]:
+        for i, s in enumerate(self._slots):
+            if s is not None and s.pending is not None:
+                return i
+        return None
+
+    def _prefill_one_chunk(self, slot_idx: int) -> None:
+        """Advance a long-prompt slot by one fixed-size chunk; the final
+        chunk samples the first token and activates the slot."""
+        slot = self._slots[slot_idx]
+        assert slot is not None and slot.pending is not None
+        request = slot.request
+        if request.cancelled.is_set():
+            self._finish(slot_idx, error="cancelled")
+            return
+        C = self._chunk
+        prompt_len = len(slot.pending)
+        take = min(C, prompt_len - slot.filled)
+        tokens = np.zeros((1, C), dtype=np.int32)
+        tokens[0, :take] = slot.pending[slot.filled:slot.filled + take]
+        final = slot.filled + take >= prompt_len
+        try:
+            token = self._run_prefill(
+                tokens, slot.filled, take - 1,
+                self._page_tables[slot_idx:slot_idx + 1], request,
+            )
+        except Exception as e:
+            self._finish(slot_idx, error=f"prefill failed: {e}")
+            return
+        if final:
+            self._activate_slot(slot_idx, slot, prompt_len, token)
+        else:
+            slot.filled += take
 
     def _upload_slot_state(self) -> None:
         self._dev = {
@@ -518,23 +604,24 @@ class InferenceEngine:
         if self._spec and bool(np.all(self._top_p[self._active] >= 1.0)):
             self._spec_step(dev, key)
             return
-        tokens_dev, seq_lens_dev, self.paged = self._jit_decode(
-            self.params,
-            self.model_cfg,
-            self.paged,
-            dev["last_tokens"],
-            dev["seq_lens"],
-            dev["page_tables"],
-            dev["active"],
-            jax.device_put(key, self._repl),
-            dev["temperature"],
-            dev["top_p"],
-        )
-        # Feed the sampled tokens / advanced lengths straight back as next
-        # step's inputs; host mirrors update below for bookkeeping only.
-        dev["last_tokens"] = tokens_dev
-        dev["seq_lens"] = seq_lens_dev
-        tokens = np.asarray(tokens_dev)  # blocks until the step completes
+        with jax.profiler.TraceAnnotation("polykey/decode"):
+            tokens_dev, seq_lens_dev, self.paged = self._jit_decode(
+                self.params,
+                self.model_cfg,
+                self.paged,
+                dev["last_tokens"],
+                dev["seq_lens"],
+                dev["page_tables"],
+                dev["active"],
+                jax.device_put(key, self._repl),
+                dev["temperature"],
+                dev["top_p"],
+            )
+            # Feed the sampled tokens / advanced lengths straight back as
+            # next step's inputs; host mirrors update below for bookkeeping.
+            dev["last_tokens"] = tokens_dev
+            dev["seq_lens"] = seq_lens_dev
+            tokens = np.asarray(tokens_dev)  # blocks until step completes
 
         emitted = 0
         for i, slot in enumerate(self._slots):
@@ -555,19 +642,20 @@ class InferenceEngine:
     def _spec_step(self, dev: dict, key) -> None:
         """One draft/verify round (spec_decode.py); emits ≤ gamma+1 tokens
         per slot, truncated on host by EOS / budget caps."""
-        (emit_dev, n_out_dev, new_last, new_seq, self.paged,
-         self.d_paged) = self._jit_spec_decode(
-            self.params, self.draft_params,
-            self.model_cfg, self.draft_cfg,
-            self.paged, self.d_paged,
-            dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
-            dev["active"], jax.device_put(key, self._repl),
-            dev["temperature"], gamma=self._gamma,
-        )
-        dev["last_tokens"] = new_last
-        dev["seq_lens"] = new_seq
-        emit = np.asarray(emit_dev)      # blocks until the round completes
-        n_out = np.asarray(n_out_dev)
+        with jax.profiler.TraceAnnotation("polykey/spec_decode"):
+            (emit_dev, n_out_dev, new_last, new_seq, self.paged,
+             self.d_paged) = self._jit_spec_decode(
+                self.params, self.draft_params,
+                self.model_cfg, self.draft_cfg,
+                self.paged, self.d_paged,
+                dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
+                dev["active"], jax.device_put(key, self._repl),
+                dev["temperature"], gamma=self._gamma,
+            )
+            dev["last_tokens"] = new_last
+            dev["seq_lens"] = new_seq
+            emit = np.asarray(emit_dev)  # blocks until the round completes
+            n_out = np.asarray(n_out_dev)
 
         emitted = accepted = proposed = 0
         for i, slot in enumerate(self._slots):
@@ -588,11 +676,12 @@ class InferenceEngine:
                 if self._slots[i] is None:   # finished mid-window
                     break
             emitted += sent
-            # ADVICE r1: acceptance counted over actually-emitted tokens
-            # only (the stat is the speedup tuning dial — budget-truncated
-            # tail tokens must not inflate it).
+            # ADVICE r1: the dial counts only drafts with a chance to be
+            # emitted — a truncated round (EOS/budget) contributes `sent`
+            # to both sides, so a perfect draft still reads exactly 1.0.
+            truncated = sent < int(n_out[i])
             accepted += min(int(n_out[i]) - 1, sent)
-            proposed += self._gamma
+            proposed += sent if truncated else self._gamma
         self.metrics.on_step(emitted)
         self.metrics.on_spec(accepted, proposed)
 

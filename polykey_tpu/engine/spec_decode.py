@@ -34,25 +34,26 @@ from ..models.transformer import forward_paged, unembed
 def spec_prefill_fn(
     t_params, d_params, t_cfg: ModelConfig, d_cfg: ModelConfig,
     t_paged, d_paged,
-    tokens, seq_len, page_table, key, temperature, top_p,
+    tokens, start, last_rel, page_table, key, temperature, top_p,
 ):
-    """Prefill BOTH caches for one request; first token from the TARGET.
+    """Prefill BOTH caches for one window; first token from the TARGET.
 
-    Same contract as engine._prefill_fn plus the draft pool: the draft model
-    must see the full prompt or its proposals start from a cold cache and
-    acceptance collapses.
+    Same contract as engine._prefill_fn (start offset + relative sampling
+    index → serves whole short prompts and long-prompt chunks alike) plus
+    the draft pool: the draft model must see the full prompt or its
+    proposals start from a cold cache and acceptance collapses.
     """
     from .sampling import sample_dynamic
 
     T = tokens.shape[1]
-    positions = jnp.arange(T, dtype=jnp.int32)[None, :]
+    positions = start[0] + jnp.arange(T, dtype=jnp.int32)[None, :]
     hidden, t_paged = forward_paged(
         t_params, t_cfg, tokens, positions, t_paged, page_table
     )
     _, d_paged = forward_paged(
         d_params, d_cfg, tokens, positions, d_paged, page_table
     )
-    last = hidden[0, seq_len[0] - 1][None]
+    last = hidden[0, last_rel[0]][None]
     logits = unembed(t_params, t_cfg, last)
     token = sample_dynamic(logits, key, temperature, top_p)
     return token[0], t_paged, d_paged

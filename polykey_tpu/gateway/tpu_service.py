@@ -36,13 +36,24 @@ _LLM_TOOLS = frozenset({"llm_generate", "generate"})
 
 
 class TpuService(Service):
-    def __init__(self, engine: InferenceEngine, watchdog: Optional[Watchdog] = None):
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        watchdog: Optional[Watchdog] = None,
+        secrets=None,
+        logger=None,
+    ):
         self.engine = engine
         self.watchdog = watchdog
+        self.secrets = secrets      # gateway.security.SecretStore or None
+        self.logger = logger
         self._mock = MockService()
+        self._profile_dir: Optional[str] = None
 
     @classmethod
     def from_env(cls, health=None, logger=None) -> "TpuService":
+        from .security import SecretStore
+
         config = EngineConfig.from_env()
         engine = InferenceEngine(config, health=health, logger=logger)
         watchdog = Watchdog(engine, health=health, logger=logger)
@@ -55,7 +66,22 @@ class TpuService(Service):
                 pages=config.num_pages,
                 page_size=config.page_size,
             )
-        return cls(engine, watchdog)
+        return cls(engine, watchdog,
+                   secrets=SecretStore.from_env(logger), logger=logger)
+
+    def _resolve_secret(self, secret_id) -> None:
+        """Resolve `secret_id` through the encrypted store (the consumption
+        the reference's dead cipher adapter was scaffolding for). Unknown
+        ids are NOT errors — the reference ignores secret_id entirely, so
+        resolution only adds observability, never failure."""
+        if not secret_id or self.secrets is None:
+            return
+        resolved = self.secrets.resolve(secret_id) is not None
+        if self.logger is not None:
+            self.logger.info(
+                "secret resolved" if resolved else "secret unknown",
+                secret_id=secret_id,
+            )
 
     def close(self) -> None:
         if self.watchdog is not None:
@@ -93,7 +119,50 @@ class TpuService(Service):
 
     # -- Service interface --------------------------------------------------
 
+    def _engine_profile(self, parameters) -> pk.ExecuteToolResponse:
+        """jax.profiler trace capture (SURVEY §5 tracing obligation).
+
+        params: action = start | stop | status; log_dir (start only).
+        Captured traces carry the polykey/prefill, polykey/decode and
+        polykey/spec_decode annotations around the engine's device steps
+        (engine.py) and open in TensorBoard / xprof.
+        """
+        import jax
+
+        params = dict(parameters) if parameters is not None else {}
+        action = params.get("action", "status")
+        if action == "start":
+            log_dir = str(params.get("log_dir", "/tmp/polykey_profile"))
+            if self._profile_dir is not None:
+                raise ValueError(
+                    f"profiler already tracing to {self._profile_dir}"
+                )
+            jax.profiler.start_trace(log_dir)
+            self._profile_dir = log_dir
+        elif action == "stop":
+            if self._profile_dir is None:
+                raise ValueError("profiler is not tracing")
+            jax.profiler.stop_trace()
+            self._profile_dir = None
+            if self.logger is not None:
+                self.logger.info("profiler trace captured")
+        elif action != "status":
+            raise ValueError(
+                f"unknown profiler action {action!r}; use start/stop/status"
+            )
+        response = pk.ExecuteToolResponse(
+            status=cmn.Status(code=200, message="Tool executed successfully")
+        )
+        response.struct_output.update({
+            "profiling": self._profile_dir is not None,
+            "log_dir": self._profile_dir or "",
+        })
+        return response
+
     def execute_tool(self, tool_name, parameters, secret_id, metadata):
+        self._resolve_secret(secret_id)
+        if tool_name == "engine_profile":
+            return self._engine_profile(parameters)
         if tool_name == "engine_stats":
             response = pk.ExecuteToolResponse(
                 status=cmn.Status(code=200, message="Tool executed successfully")
@@ -123,6 +192,7 @@ class TpuService(Service):
     def execute_tool_stream(
         self, tool_name, parameters, secret_id, metadata
     ) -> Iterator[pk.ExecuteToolStreamChunk]:
+        self._resolve_secret(secret_id)
         if tool_name not in _LLM_TOOLS:
             yield from self._mock.execute_tool_stream(
                 tool_name, parameters, secret_id, metadata

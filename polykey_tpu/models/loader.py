@@ -71,27 +71,65 @@ def load_checkpoint(
         return ckptr.restore(path, template)
 
 
-# HF Llama-style key mapping: framework param path → HF tensor name pattern.
+# HF key mappings: framework param path → (HF tensor name pattern, transpose).
 # HF stores linear layers as [out, in]; this framework uses [in, out], so
-# every matmul weight transposes on import.
-_HF_LAYER_MAP = {
-    ("attn", "wq"): "model.layers.{i}.self_attn.q_proj.weight",
-    ("attn", "wk"): "model.layers.{i}.self_attn.k_proj.weight",
-    ("attn", "wv"): "model.layers.{i}.self_attn.v_proj.weight",
-    ("attn", "wo"): "model.layers.{i}.self_attn.o_proj.weight",
-    ("mlp", "gate"): "model.layers.{i}.mlp.gate_proj.weight",
-    ("mlp", "up"): "model.layers.{i}.mlp.up_proj.weight",
-    ("mlp", "down"): "model.layers.{i}.mlp.down_proj.weight",
-    ("ln1",): "model.layers.{i}.input_layernorm.weight",
-    ("ln2",): "model.layers.{i}.post_attention_layernorm.weight",
+# every matmul weight transposes on import (norms don't).
+_HF_ATTN_MAP = {
+    ("attn", "wq"): ("model.layers.{i}.self_attn.q_proj.weight", True),
+    ("attn", "wk"): ("model.layers.{i}.self_attn.k_proj.weight", True),
+    ("attn", "wv"): ("model.layers.{i}.self_attn.v_proj.weight", True),
+    ("attn", "wo"): ("model.layers.{i}.self_attn.o_proj.weight", True),
+    ("ln1",): ("model.layers.{i}.input_layernorm.weight", False),
 }
 
 
-def import_safetensors(path: str, cfg: ModelConfig, dtype=jnp.bfloat16) -> dict:
-    """Map a local HF Llama-family safetensors checkpoint into a param pytree.
+def _hf_layer_map(cfg: ModelConfig) -> dict:
+    """Per-family HF tensor-name map covering all three served families.
 
-    Dense models only (Mixtral/Gemma import can extend _HF_LAYER_MAP); layer
-    tensors are stacked on the leading axis for the scan-based forward.
+    - Llama-3 (dense): mlp.{gate,up,down}_proj, post_attention_layernorm
+      as the pre-MLP norm.
+    - Mixtral (MoE): block_sparse_moe.gate is the router ([E, H] in HF →
+      transposed to this framework's [H, E]); experts.{e}.w1/w2/w3 map to
+      gate/down/up and stack over the expert axis ([E, in, out]).
+    - Gemma-2: four norms per layer — HF's post_attention_layernorm is the
+      *post*-norm (our post_ln1) and pre/post_feedforward_layernorm are
+      ln2/post_ln2. HF Gemma RMSNorm stores w with gain = 1 + w, which is
+      exactly this framework's storage convention for scale_embeddings
+      models (transformer.init_params norm_offset), so values copy as-is.
+    """
+    m = dict(_HF_ATTN_MAP)
+    if cfg.use_post_norms:
+        m[("ln2",)] = (
+            "model.layers.{i}.pre_feedforward_layernorm.weight", False)
+        m[("post_ln1",)] = (
+            "model.layers.{i}.post_attention_layernorm.weight", False)
+        m[("post_ln2",)] = (
+            "model.layers.{i}.post_feedforward_layernorm.weight", False)
+    else:
+        m[("ln2",)] = (
+            "model.layers.{i}.post_attention_layernorm.weight", False)
+    if cfg.is_moe:
+        m[("router",)] = (
+            "model.layers.{i}.block_sparse_moe.gate.weight", True)
+        m[("experts", "gate")] = (
+            "model.layers.{i}.block_sparse_moe.experts.{e}.w1.weight", True)
+        m[("experts", "down")] = (
+            "model.layers.{i}.block_sparse_moe.experts.{e}.w2.weight", True)
+        m[("experts", "up")] = (
+            "model.layers.{i}.block_sparse_moe.experts.{e}.w3.weight", True)
+    else:
+        m[("mlp", "gate")] = ("model.layers.{i}.mlp.gate_proj.weight", True)
+        m[("mlp", "up")] = ("model.layers.{i}.mlp.up_proj.weight", True)
+        m[("mlp", "down")] = ("model.layers.{i}.mlp.down_proj.weight", True)
+    return m
+
+
+def import_safetensors(path: str, cfg: ModelConfig, dtype=jnp.bfloat16) -> dict:
+    """Map a local HF safetensors checkpoint into a param pytree.
+
+    Covers the three served families (Llama-3, Mixtral, Gemma-2 — see
+    _hf_layer_map); layer tensors are stacked on the leading axis for the
+    scan-based forward, expert tensors additionally over the expert axis.
     """
     try:
         from safetensors import safe_open  # optional dep; gate at call time
@@ -128,11 +166,20 @@ def import_safetensors(path: str, cfg: ModelConfig, dtype=jnp.bfloat16) -> dict:
         return arr.T if transpose else arr
 
     layers: dict = {}
-    for key_path, pattern in _HF_LAYER_MAP.items():
-        per_layer = [
-            get(pattern.format(i=i), transpose=len(key_path) == 2)
-            for i in range(cfg.num_layers)
-        ]
+    for key_path, (pattern, transpose) in _hf_layer_map(cfg).items():
+        if "{e}" in pattern:
+            per_layer = [
+                jnp.stack([
+                    get(pattern.format(i=i, e=e), transpose)
+                    for e in range(cfg.num_experts)
+                ])
+                for i in range(cfg.num_layers)
+            ]  # → [L, E, in, out]
+        else:
+            per_layer = [
+                get(pattern.format(i=i), transpose)
+                for i in range(cfg.num_layers)
+            ]
         node = layers
         for k in key_path[:-1]:
             node = node.setdefault(k, {})

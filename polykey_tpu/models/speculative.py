@@ -259,8 +259,17 @@ def speculative_generate(
         new_pos = pos + jnp.where(done, 0, emitted)
 
         active = (~done).astype(jnp.int32)
-        acc_total = acc_total + jnp.sum(active * n_acc)
-        prop_total = prop_total + jnp.sum(active) * gamma
+        # Stats count only drafts that had a chance to be emitted (clip by
+        # the row's remaining budget before the round — ADVICE r1): budget-
+        # truncated tail drafts must neither inflate nor deflate the dial,
+        # so a perfect draft still reads exactly 1.0 (the self-draft canary
+        # in tests/test_speculative.py). Same convention as the engine
+        # (engine._spec_step).
+        budget = jnp.maximum(max_new - counts, 0)
+        acc_total = acc_total + jnp.sum(active * jnp.minimum(n_acc, budget))
+        prop_total = prop_total + jnp.sum(
+            active * jnp.minimum(jnp.int32(gamma), budget)
+        )
 
         return (
             t_cache, d_cache, new_out, new_counts, new_prev, new_done,

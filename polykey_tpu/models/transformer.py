@@ -2,8 +2,8 @@
 
 One implementation handles Llama-3 (GQA + RoPE + SwiGLU), Gemma-2 (post
 norms, logit soft-capping, interleaved sliding-window layers, scaled
-embeddings), and — via the MoE hook — Mixtral. Family façades live in
-llama.py / gemma.py / mixtral.py.
+embeddings), and — via the MoE hook — Mixtral. Families are selected by
+config (models/config.py registry), not by per-family modules.
 
 TPU-first design choices:
 - layers stacked on a leading axis, driven by `lax.scan`: one compiled block,
@@ -292,9 +292,14 @@ def forward_paged(
     return x, type(paged)(k=new_k, v=new_v)
 
 
-def make_ring_override(cfg: ModelConfig, mesh, positions: jax.Array):
-    """Build an attn_override routing attention through the sequence-
-    parallel ring path (ops/ring_attention.py) over the mesh's sp axis.
+def make_sp_override(
+    cfg: ModelConfig, mesh, positions: jax.Array, impl: str = "ring"
+):
+    """Build an attn_override routing attention through a sequence-parallel
+    path over the mesh's sp axis: ``impl="ring"`` rotates KV via ppermute
+    (ops/ring_attention.py — any head count, sp-1 hops), ``impl="ulysses"``
+    re-shards heads via all-to-all (ops/ulysses_attention.py — two
+    collectives, needs per-device head counts divisible by sp).
 
     Lives here so the attention-parameter wiring (q_scale, soft-cap,
     per-layer window interleaving) stays in one module with the dense
@@ -303,10 +308,17 @@ def make_ring_override(cfg: ModelConfig, mesh, positions: jax.Array):
     """
     if mesh is None or mesh.shape.get("sp", 1) <= 1:
         return None
-    from ..ops.ring_attention import ring_attention_spmd
+    if impl == "ring":
+        from ..ops.ring_attention import ring_attention_spmd as sp_attention
+    elif impl == "ulysses":
+        from ..ops.ulysses_attention import (
+            ulysses_attention_spmd as sp_attention,
+        )
+    else:
+        raise ValueError(f"unknown sp attention impl {impl!r}")
 
     def override(layer_idx, q, k, v):
-        return ring_attention_spmd(
+        return sp_attention(
             q, k, v, positions, positions, mesh,
             scale=cfg.q_scale,
             logit_softcap=cfg.attn_logit_softcap,
@@ -314,6 +326,11 @@ def make_ring_override(cfg: ModelConfig, mesh, positions: jax.Array):
         )
 
     return override
+
+
+def make_ring_override(cfg: ModelConfig, mesh, positions: jax.Array):
+    """Back-compat alias for make_sp_override(impl="ring")."""
+    return make_sp_override(cfg, mesh, positions, impl="ring")
 
 
 def unembed(params: dict, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:

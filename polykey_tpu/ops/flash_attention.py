@@ -209,15 +209,22 @@ def _pad_to(x: jax.Array, axis: int, multiple: int, value=0) -> jax.Array:
     return jnp.pad(x, widths, constant_values=value)
 
 
+# Head dims proven against Mosaic's 128-lane tiling (the served families
+# use 64/128/256; an odd D like 40 or 72 must take the reference fallback
+# rather than risk a kernel compile failure on hardware — ADVICE r1).
+_FLASH_HEAD_DIMS = frozenset({64, 128, 256})
+
+
 def use_flash(T: int, S: int, head_dim: int) -> bool:
     """Dispatch policy: the kernel wins when the logits matrix is large
     enough that not materializing it matters; the reference path keeps tiny
-    shapes (decode against short caches, unit tests) and non-TPU backends."""
+    shapes (decode against short caches, unit tests), unusual head dims,
+    and non-TPU backends."""
     return (
         jax.default_backend() == "tpu"
         and T >= 128
         and S >= 128
-        and head_dim <= 256
+        and head_dim in _FLASH_HEAD_DIMS
     )
 
 

@@ -21,7 +21,7 @@ from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.config import ModelConfig
-from ..models.transformer import forward, make_ring_override, unembed
+from ..models.transformer import forward, make_sp_override, unembed
 from ..parallel.sharding import batch_sharding, param_shardings
 
 
@@ -38,13 +38,15 @@ def cross_entropy_loss(
     tokens: jax.Array,       # [B, T] input ids
     targets: jax.Array,      # [B, T] next-token ids (-1 → masked)
     positions: jax.Array,    # [B, T]
-    ring_mesh: Optional[Mesh] = None,
+    sp_mesh: Optional[Mesh] = None,
+    sp_impl: str = "ring",
 ) -> jax.Array:
-    """Next-token cross-entropy. With `ring_mesh`, attention runs as
-    sequence-parallel ring attention over the mesh's sp axis
-    (ops/ring_attention.py) — KV chunks rotate over ICI instead of XLA
+    """Next-token cross-entropy. With `sp_mesh`, attention runs
+    sequence-parallel over the mesh's sp axis — ring (KV chunks rotate
+    over ICI, ops/ring_attention.py) or ulysses (head re-shard via
+    all-to-all, ops/ulysses_attention.py) per `sp_impl` — instead of XLA
     all-gathering the full sequence per device."""
-    attn_override = make_ring_override(cfg, ring_mesh, positions)
+    attn_override = make_sp_override(cfg, sp_mesh, positions, sp_impl)
     checkpointed = jax.checkpoint(
         lambda p, t, pos: forward(p, cfg, t, pos, None, attn_override)[0]
     )
@@ -61,6 +63,7 @@ def make_train_step(
     cfg: ModelConfig,
     mesh: Mesh,
     optimizer: Optional[optax.GradientTransformation] = None,
+    sp_impl: str = "ring",
 ):
     """Returns (init_state, train_step, shard_batch) bound to the mesh.
 
@@ -84,12 +87,13 @@ def make_train_step(
             step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state
         )
 
-    ring_mesh = mesh if mesh.shape.get("sp", 1) > 1 else None
+    sp_mesh = mesh if mesh.shape.get("sp", 1) > 1 else None
 
     @partial(jax.jit, donate_argnames=("state",))
     def train_step(state: TrainState, tokens, targets, positions):
         loss, grads = jax.value_and_grad(cross_entropy_loss)(
-            state.params, cfg, tokens, targets, positions, ring_mesh
+            state.params, cfg, tokens, targets, positions, sp_mesh,
+            sp_impl,
         )
         updates, opt_state = optimizer.update(
             grads, state.opt_state, state.params

@@ -1,0 +1,80 @@
+"""jax.profiler trace endpoint (VERDICT r1 #7, SURVEY §5 tracing).
+
+engine_profile start → serve a request (annotated prefill/decode steps) →
+stop must leave a real trace artifact on disk.
+"""
+
+import glob
+import os
+
+import pytest
+from google.protobuf import struct_pb2
+
+from polykey_tpu.engine.config import EngineConfig
+from polykey_tpu.engine.engine import GenRequest, InferenceEngine
+from polykey_tpu.gateway.tpu_service import TpuService
+
+CONFIG = EngineConfig(
+    model="tiny-llama",
+    tokenizer="byte",
+    dtype="float32",
+    max_decode_slots=2,
+    page_size=8,
+    num_pages=32,
+    max_seq_len=64,
+    prefill_buckets=(16, 32),
+    max_new_tokens_cap=16,
+)
+
+
+def _params(**kv) -> struct_pb2.Struct:
+    s = struct_pb2.Struct()
+    s.update(kv)
+    return s
+
+
+def test_profile_capture_roundtrip(tmp_path):
+    engine = InferenceEngine(CONFIG)
+    service = TpuService(engine)
+    try:
+        log_dir = str(tmp_path / "trace")
+        resp = service.execute_tool(
+            "engine_profile", _params(action="start", log_dir=log_dir),
+            None, None,
+        )
+        assert resp.struct_output["profiling"] is True
+
+        # Double-start is an error.
+        with pytest.raises(ValueError):
+            service.execute_tool(
+                "engine_profile", _params(action="start"), None, None
+            )
+
+        # Generate under the trace so prefill/decode annotations land.
+        resp = service.execute_tool(
+            "llm_generate", _params(prompt="profile me", max_tokens=4),
+            None, None,
+        )
+        assert resp.status.code == 200
+
+        resp = service.execute_tool(
+            "engine_profile", _params(action="stop"), None, None
+        )
+        assert resp.struct_output["profiling"] is False
+
+        traces = glob.glob(
+            os.path.join(log_dir, "**", "*.xplane.pb"), recursive=True
+        )
+        assert traces, f"no trace artifact under {log_dir}"
+
+        # Stop without start is an error; status is not.
+        with pytest.raises(ValueError):
+            service.execute_tool(
+                "engine_profile", _params(action="stop"), None, None
+            )
+        resp = service.execute_tool(
+            "engine_profile", _params(action="status"), None, None
+        )
+        assert resp.struct_output["profiling"] is False
+    finally:
+        engine.shutdown()
