@@ -66,10 +66,28 @@ def test_ring_attention_loss_matches_unsharded():
     mesh = create_mesh(MeshConfig(dp=2, sp=4), jax.devices()[:8])
     ring = float(
         cross_entropy_loss(
-            params, CFG, tokens, targets, positions, ring_mesh=mesh
+            params, CFG, tokens, targets, positions, sp_mesh=mesh
         )
     )
     assert abs(ref - ring) < 1e-4, (ref, ring)
+
+
+def test_ulysses_attention_loss_matches_unsharded():
+    """sp_impl='ulysses' re-shards heads via all-to-all
+    (ops/ulysses_attention.py); loss must match the reference too."""
+    params = init_params(jax.random.PRNGKey(0), CFG, jnp.float32)
+    tokens, targets, positions = _toy_batch(jax.random.PRNGKey(1), B=4, T=32)
+
+    ref = float(cross_entropy_loss(params, CFG, tokens, targets, positions))
+
+    mesh = create_mesh(MeshConfig(dp=2, sp=4), jax.devices()[:8])
+    uly = float(
+        cross_entropy_loss(
+            params, CFG, tokens, targets, positions, sp_mesh=mesh,
+            sp_impl="ulysses",
+        )
+    )
+    assert abs(ref - uly) < 1e-4, (ref, uly)
 
 
 def test_train_step_improves_under_sp_ring():
