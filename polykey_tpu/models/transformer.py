@@ -132,48 +132,65 @@ def _layer_window(cfg: ModelConfig, layer_idx: jax.Array):
     return jnp.where(layer_idx % 2 == 0, cfg.sliding_window, cfg.max_seq_len)
 
 
-def _run_stack(params, cfg: ModelConfig, tokens, positions, kv_scanned, attend):
-    """Shared transformer stack: embed → scan(layer body) → final norm.
-
-    The KV mechanics (where K/V are written, what attention reads) differ
-    between the contiguous-cache, no-cache, and paged paths, so they are
-    injected via `attend(layer_idx, q, k, v, kc, vc) → (ctx, kc, vc)`;
-    everything else — norms, projections, RoPE, residuals, MLP/MoE,
-    Gemma post-norms — is this one body.
-    """
-    B, T = tokens.shape
-    norm_offset = 1.0 if cfg.scale_embeddings else 0.0
-    eps = cfg.rms_norm_eps
-
+def embed_tokens(params: dict, cfg: ModelConfig, tokens: jax.Array) -> jax.Array:
+    """Token embedding lookup (+ Gemma's sqrt(H) scaling)."""
     x = embed_lookup(params["embed"], tokens)
     if cfg.scale_embeddings:
         x = (x.astype(jnp.float32) * cfg.hidden_size**0.5).astype(x.dtype)
+    return x
+
+
+def apply_layer(layer_params, layer_idx, x, positions, cfg: ModelConfig, attend, kc, vc):
+    """One transformer block at absolute layer index `layer_idx`.
+
+    Norms, projections, RoPE, residuals, MLP/MoE, and Gemma post-norms live
+    here; the KV mechanics are injected via
+    `attend(layer_idx, q, k, v, kc, vc) → (ctx, kc, vc)`. Shared by the
+    scanned stack (_run_stack) and the pipeline-parallel stage bodies
+    (parallel/pipeline.py), so a stage runs the exact computation the
+    unsharded stack runs.
+    """
+    B, T = x.shape[:2]
+    norm_offset = 1.0 if cfg.scale_embeddings else 0.0
+    eps = cfg.rms_norm_eps
+
+    h = rms_norm(x, layer_params["ln1"], eps, norm_offset)
+    q, k, v = qkv_project(layer_params["attn"], h, cfg)
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+
+    ctx, kc, vc = attend(layer_idx, q, k, v, kc, vc)
+
+    attn_out = ctx.reshape(B, T, cfg.num_heads * cfg.head_dim)
+    attn_out = qdot(attn_out, layer_params["attn"]["wo"])
+    if cfg.use_post_norms:
+        attn_out = rms_norm(attn_out, layer_params["post_ln1"], eps, norm_offset)
+    x = x + attn_out
+
+    h = rms_norm(x, layer_params["ln2"], eps, norm_offset)
+    if cfg.is_moe:
+        mlp_out = _moe_mlp(layer_params, h, cfg)
+    else:
+        mlp_out = mlp(layer_params["mlp"], h, cfg.activation)
+    if cfg.use_post_norms:
+        mlp_out = rms_norm(mlp_out, layer_params["post_ln2"], eps, norm_offset)
+    x = x + mlp_out
+
+    return x, kc, vc
+
+
+def _run_stack(params, cfg: ModelConfig, tokens, positions, kv_scanned, attend):
+    """Shared transformer stack: embed → scan(layer body) → final norm."""
+    norm_offset = 1.0 if cfg.scale_embeddings else 0.0
+    eps = cfg.rms_norm_eps
+
+    x = embed_tokens(params, cfg, tokens)
 
     def body(x, scanned):
         layer_params, layer_idx, kc, vc = scanned
-
-        h = rms_norm(x, layer_params["ln1"], eps, norm_offset)
-        q, k, v = qkv_project(layer_params["attn"], h, cfg)
-        q = rope(q, positions, cfg.rope_theta)
-        k = rope(k, positions, cfg.rope_theta)
-
-        ctx, kc, vc = attend(layer_idx, q, k, v, kc, vc)
-
-        attn_out = ctx.reshape(B, T, cfg.num_heads * cfg.head_dim)
-        attn_out = qdot(attn_out, layer_params["attn"]["wo"])
-        if cfg.use_post_norms:
-            attn_out = rms_norm(attn_out, layer_params["post_ln1"], eps, norm_offset)
-        x = x + attn_out
-
-        h = rms_norm(x, layer_params["ln2"], eps, norm_offset)
-        if cfg.is_moe:
-            mlp_out = _moe_mlp(layer_params, h, cfg)
-        else:
-            mlp_out = mlp(layer_params["mlp"], h, cfg.activation)
-        if cfg.use_post_norms:
-            mlp_out = rms_norm(mlp_out, layer_params["post_ln2"], eps, norm_offset)
-        x = x + mlp_out
-
+        x, kc, vc = apply_layer(
+            layer_params, layer_idx, x, positions, cfg, attend, kc, vc
+        )
         return x, (kc, vc)
 
     layer_ids = jnp.arange(cfg.num_layers, dtype=jnp.int32)
@@ -182,6 +199,28 @@ def _run_stack(params, cfg: ModelConfig, tokens, positions, kv_scanned, attend):
     )
     x = rms_norm(x, params["final_norm"], eps, norm_offset)
     return x, new_k, new_v
+
+
+def make_causal_attend(cfg: ModelConfig, positions: jax.Array):
+    """No-cache causal attention closure over `positions` [B, T]: attention
+    spans the current tokens only, masked by position (with Gemma's
+    per-layer sliding-window interleaving). The training/scoring attend;
+    pipeline stages (parallel/pipeline.py) build one per microbatch."""
+    q_pos = positions[:, :, None]                       # [B, T, 1]
+    kv_pos = positions[:, None, :]                      # [B, 1, S]
+
+    def attend(layer_idx, q, k, v, kc, vc):
+        mask = kv_pos <= q_pos
+        window = _layer_window(cfg, layer_idx)
+        if window is not None:
+            mask &= kv_pos > q_pos - window
+        ctx = attention(
+            q, k, v, mask,
+            scale=cfg.q_scale, logit_softcap=cfg.attn_logit_softcap,
+        )
+        return ctx, kc, vc
+
+    return attend
 
 
 def forward(
@@ -200,7 +239,7 @@ def forward(
     current sequence only; `attn_override` swaps the attention computation
     (the sequence-parallel ring path, ops/ring_attention.py, mounts here).
     """
-    B, T = tokens.shape
+    B = tokens.shape[0]
     use_cache = cache is not None
     if use_cache and attn_override is not None:
         raise ValueError(
@@ -209,7 +248,6 @@ def forward(
             "gathered cache, defeating the override's purpose)"
         )
     batch_idx = jnp.arange(B, dtype=jnp.int32)[:, None]
-    q_pos = positions[:, :, None]                       # [B, T, 1]
 
     if use_cache:
         # Inference-only path → flash kernel is safe (no VJP needed); it
@@ -229,20 +267,12 @@ def forward(
 
         kv_scanned = (cache.k, cache.v)
     else:
-        kv_pos = positions[:, None, :]                  # kv = current tokens
+        causal = make_causal_attend(cfg, positions)
 
         def attend(layer_idx, q, k, v, kc, vc):
             if attn_override is not None:
                 return attn_override(layer_idx, q, k, v), kc, vc
-            mask = kv_pos <= q_pos
-            window = _layer_window(cfg, layer_idx)
-            if window is not None:
-                mask &= kv_pos > q_pos - window
-            ctx = attention(
-                q, k, v, mask,
-                scale=cfg.q_scale, logit_softcap=cfg.attn_logit_softcap,
-            )
-            return ctx, kc, vc
+            return causal(layer_idx, q, k, v, kc, vc)
 
         empty = jnp.zeros((cfg.num_layers, 0), dtype=jnp.float32)
         kv_scanned = (empty, empty)

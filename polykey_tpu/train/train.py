@@ -40,16 +40,32 @@ def cross_entropy_loss(
     positions: jax.Array,    # [B, T]
     sp_mesh: Optional[Mesh] = None,
     sp_impl: str = "ring",
+    pp_mesh: Optional[Mesh] = None,
+    pp_microbatches: int = 4,
 ) -> jax.Array:
     """Next-token cross-entropy. With `sp_mesh`, attention runs
     sequence-parallel over the mesh's sp axis — ring (KV chunks rotate
     over ICI, ops/ring_attention.py) or ulysses (head re-shard via
     all-to-all, ops/ulysses_attention.py) per `sp_impl` — instead of XLA
-    all-gathering the full sequence per device."""
-    attn_override = make_sp_override(cfg, sp_mesh, positions, sp_impl)
-    checkpointed = jax.checkpoint(
-        lambda p, t, pos: forward(p, cfg, t, pos, None, attn_override)[0]
-    )
+    all-gathering the full sequence per device. With `pp_mesh`, the stack
+    runs the GPipe microbatch schedule over the mesh's pp axis
+    (parallel/pipeline.py); sp and pp are mutually exclusive here (ring
+    attention inside a pipeline stage would need per-stage sp submeshes)."""
+    if pp_mesh is not None and pp_mesh.shape.get("pp", 1) > 1:
+        if sp_mesh is not None and sp_mesh.shape.get("sp", 1) > 1:
+            raise ValueError("sp>1 and pp>1 are mutually exclusive")
+        from ..parallel.pipeline import pipeline_forward
+
+        checkpointed = jax.checkpoint(
+            lambda p, t, pos: pipeline_forward(
+                p, cfg, t, pos, pp_mesh, pp_microbatches
+            )
+        )
+    else:
+        attn_override = make_sp_override(cfg, sp_mesh, positions, sp_impl)
+        checkpointed = jax.checkpoint(
+            lambda p, t, pos: forward(p, cfg, t, pos, None, attn_override)[0]
+        )
     hidden = checkpointed(params, tokens, positions)
     logits = unembed(params, cfg, hidden)          # [B, T, V] fp32
     mask = targets >= 0
@@ -64,6 +80,7 @@ def make_train_step(
     mesh: Mesh,
     optimizer: Optional[optax.GradientTransformation] = None,
     sp_impl: str = "ring",
+    pp_microbatches: int = 4,
 ):
     """Returns (init_state, train_step, shard_batch) bound to the mesh.
 
@@ -88,12 +105,13 @@ def make_train_step(
         )
 
     sp_mesh = mesh if mesh.shape.get("sp", 1) > 1 else None
+    pp_mesh = mesh if mesh.shape.get("pp", 1) > 1 else None
 
     @partial(jax.jit, donate_argnames=("state",))
     def train_step(state: TrainState, tokens, targets, positions):
         loss, grads = jax.value_and_grad(cross_entropy_loss)(
             state.params, cfg, tokens, targets, positions, sp_mesh,
-            sp_impl,
+            sp_impl, pp_mesh, pp_microbatches,
         )
         updates, opt_state = optimizer.update(
             grads, state.opt_state, state.params
