@@ -50,6 +50,14 @@ class EngineConfig:
     max_new_tokens_cap: int = 1024
     default_max_new_tokens: int = 64
 
+    # Decode steps per dispatch: the jitted decode runs `decode_block_steps`
+    # steps in one lax.scan call, with device-side EOS/budget stopping, so
+    # per-dispatch host overhead (Python + transfer latency — dominant when
+    # the accelerator sits behind a network tunnel) amortizes K-fold.
+    # Tokens stream out in blocks of ≤K per request; prefills interleave at
+    # block boundaries. 1 → token-at-a-time (lowest streaming latency).
+    decode_block_steps: int = 8
+
     # Parallelism axes (parallel/mesh.py); 1 → axis unused.
     tp: int = 1
     dp: int = 1
@@ -94,6 +102,9 @@ class EngineConfig:
             default_max_new_tokens=_env_int(
                 "POLYKEY_DEFAULT_MAX_NEW_TOKENS", cls.default_max_new_tokens
             ),
+            decode_block_steps=_env_int(
+                "POLYKEY_DECODE_BLOCK", cls.decode_block_steps
+            ),
             tp=_env_int("POLYKEY_TP", cls.tp),
             dp=_env_int("POLYKEY_DP", cls.dp),
             draft_model=os.environ.get("POLYKEY_DRAFT_MODEL") or None,
@@ -124,3 +135,5 @@ class EngineConfig:
             raise ValueError("spec_gamma must be >= 1")
         if self.prefill_chunk < 0:
             raise ValueError("prefill_chunk must be >= 0 (0 → max bucket)")
+        if self.decode_block_steps < 1:
+            raise ValueError("decode_block_steps must be >= 1")

@@ -76,11 +76,19 @@ class _Slot:
     # `pending is None` ⇔ the slot is decoding (or short-prompt prefilled).
     pending: Optional[np.ndarray] = None
     filled: int = 0            # prompt positions already prefilled
+    # The slot's page table stays HERE until activation: the decode batch's
+    # inactive lanes write garbage KV at position 0 through whatever table
+    # the device holds, so a mid-prefill slot's real table must never reach
+    # the device mirrors — only the reserved garbage page 0 (see
+    # _upload_slot_state) — or decode blocks would corrupt the prompt's
+    # position-0 KV between prefill chunks.
+    table: Optional[np.ndarray] = None
 
 
 def _prefill_fn(
     params, cfg: ModelConfig, paged: PagedKV,
     tokens, start, last_rel, page_table, key, temperature, top_p,
+    *, greedy: bool,
 ):
     """Prefill one window (tokens [1, T]) at absolute positions
     start..start+T-1 and sample from the hidden state at relative index
@@ -90,37 +98,74 @@ def _prefill_fn(
     serves both paths. Padded tail positions write KV that is either
     masked (position > any query), overwritten by later decode steps, or
     lands on the reserved garbage page — never read.
+
+    `greedy` is a static variant selector: the all-greedy request takes a
+    pure-argmax tail (no full-vocab sort, no RNG use) — at 128k-256k vocab
+    the top-p sort is a real per-step cost, and greedy is the north-star
+    benchmark mode. The key threads through both variants so the engine
+    keeps one device-resident RNG chain.
     """
     T = tokens.shape[1]
     positions = start[0] + jnp.arange(T, dtype=jnp.int32)[None, :]
     hidden, paged = forward_paged(params, cfg, tokens, positions, paged, page_table)
     last = hidden[0, last_rel[0]][None]                    # [1, H]
     logits = unembed(params, cfg, last)                    # [1, V]
-    token = sample_dynamic(logits, key, temperature, top_p)
-    return token[0], paged
+    if greedy:
+        token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        new_key = key
+    else:
+        new_key, sub = jax.random.split(key)
+        token = sample_dynamic(logits, sub, temperature, top_p)
+    return token[0], new_key, paged
 
 
 def _decode_fn(
     params, cfg: ModelConfig, paged: PagedKV,
-    last_tokens, seq_lens, page_tables, active, key, temperature, top_p,
+    last_tokens, seq_lens, page_tables, active, caps, key, temperature, top_p,
+    *, greedy: bool, steps: int, eos_id: int,
 ):
-    """One decode step for the whole slot batch.
+    """`steps` decode steps for the whole slot batch in ONE dispatch.
 
-    seq_lens counts tokens including `last_tokens` (sampled but not yet in
-    cache); the step writes their KV at position seq_lens-1 and samples the
-    next token for every active slot. Returns the advanced seq_lens too, so
-    steady-state decode keeps its state device-resident (no per-step
-    host→device re-upload of slot arrays).
+    A lax.scan drives the block: each sub-step writes KV for the current
+    tokens at position seq_lens-1, samples the next token for live slots,
+    and advances device-resident state. Live-ness mirrors the host's
+    _maybe_finish ON DEVICE — a slot stops at EOS or when seq_lens reaches
+    its position cap — so a finished stream neither advances nor pollutes
+    its own cache beyond its final position (its lane keeps computing
+    masked garbage that the host discards via the returned emit masks).
+
+    Blocking the decode this way amortizes per-dispatch host overhead
+    (Python + transfer latency; dominant when the chip sits behind a
+    network tunnel) over `steps` tokens. The host uploads nothing per block
+    and downloads only the [steps, B] tokens + masks.
+
+    `greedy` (static) selects the argmax-only tail when every active slot
+    is greedy, skipping sample_dynamic's [B, vocab] sort entirely.
     """
-    positions = jnp.maximum(seq_lens - 1, 0)[:, None]      # [B, 1]
-    hidden, paged = forward_paged(
-        params, cfg, last_tokens[:, None], positions, paged, page_tables
+
+    def one(carry, _):
+        last, seq, act, key, paged = carry
+        positions = jnp.maximum(seq - 1, 0)[:, None]       # [B, 1]
+        hidden, paged = forward_paged(
+            params, cfg, last[:, None], positions, paged, page_tables
+        )
+        logits = unembed(params, cfg, hidden[:, 0])        # [B, V]
+        if greedy:
+            tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            new_key = key
+        else:
+            new_key, sub = jax.random.split(key)
+            tokens = sample_dynamic(logits, sub, temperature, top_p)
+        tokens = jnp.where(act, tokens, 0)
+        new_seq = seq + act.astype(jnp.int32)
+        cont = act & (tokens != eos_id) & (new_seq < caps)
+        return (tokens, new_seq, cont, new_key, paged), (tokens, act)
+
+    carry = (last_tokens, seq_lens, active, key, paged)
+    (last, seq, act, key, paged), (toks, emit) = jax.lax.scan(
+        one, carry, None, length=steps
     )
-    logits = unembed(params, cfg, hidden[:, 0])            # [B, V]
-    tokens = sample_dynamic(logits, key, temperature, top_p)
-    tokens = jnp.where(active, tokens, 0)
-    new_seq_lens = seq_lens + active.astype(jnp.int32)
-    return tokens, new_seq_lens, paged
+    return toks, emit, last, seq, act, key, paged
 
 
 class EngineDeadError(RuntimeError):
@@ -178,12 +223,18 @@ class InferenceEngine:
         # Pinned output shardings keep the donated pool's layout stable
         # across steps (donation requires matching input/output shardings).
         self._jit_prefill = jax.jit(
-            _prefill_fn, static_argnames=("cfg",), donate_argnames=("paged",),
-            out_shardings=(self._repl, self._pool_sharding),
+            _prefill_fn, static_argnames=("cfg", "greedy"),
+            donate_argnames=("paged",),
+            out_shardings=(self._repl, self._repl, self._pool_sharding),
         )
+        self._dp_steps = NamedSharding(self.mesh, PartitionSpec(None, "dp"))
         self._jit_decode = jax.jit(
-            _decode_fn, static_argnames=("cfg",), donate_argnames=("paged",),
-            out_shardings=(self._dp_vec, self._dp_vec, self._pool_sharding),
+            _decode_fn, static_argnames=("cfg", "greedy", "steps", "eos_id"),
+            donate_argnames=("paged",),
+            out_shardings=(
+                self._dp_steps, self._dp_steps, self._dp_vec, self._dp_vec,
+                self._dp_vec, self._repl, self._pool_sharding,
+            ),
         )
 
         if params is None:
@@ -216,6 +267,7 @@ class InferenceEngine:
         self.allocator = BlockAllocator(config.num_pages)
 
         self._chunk = config.prefill_chunk or max(config.prefill_buckets)
+        self._block_steps = config.decode_block_steps
 
         # --- Speculative decoding: draft model + its own page pool, same
         # page tables (position → (page, offset) is model-independent).
@@ -287,13 +339,19 @@ class InferenceEngine:
         self._seq_lens = np.zeros((B,), dtype=np.int32)
         self._last_tokens = np.zeros((B,), dtype=np.int32)
         self._active = np.zeros((B,), dtype=bool)
+        self._caps = np.zeros((B,), dtype=np.int32)
         self._temperature = np.zeros((B,), dtype=np.float32)
         self._top_p = np.ones((B,), dtype=np.float32)
         self._slots: list[Optional[_Slot]] = [None] * B
         self._dev: dict = {}
         self._dev_dirty = True
 
-        self._key = jax.random.PRNGKey(seed + 1)
+        # Device-resident RNG chain: non-spec steps advance it inside the
+        # jitted call (zero per-step host ops); spec paths advance it via
+        # _advance_key (their jitted fns take a key but don't return one).
+        self._key_dev = jax.device_put(
+            jax.random.PRNGKey(seed + 1), self._repl
+        )
         self._submit: queue.Queue[GenRequest] = queue.Queue()
         self._wake = threading.Event()
         self._stop = threading.Event()
@@ -473,10 +531,13 @@ class InferenceEngine:
         if bucket is None:
             # Long prompt: register the slot in prefilling state; the
             # engine loop runs one chunk per iteration (interleaved with
-            # decode steps) until the prompt is in cache.
+            # decode steps) until the prompt is in cache. Its page table
+            # stays slot-local (NOT in the device mirrors) so concurrent
+            # decode blocks keep writing this lane's garbage through the
+            # reserved page 0 instead of over the chunks already prefilled.
             slot.pending = np.asarray(prompt_ids, dtype=np.int32)
+            slot.table = page_table
             self._slots[slot_idx] = slot
-            self._page_tables[slot_idx] = page_table[0]
             return
 
         try:
@@ -495,6 +556,13 @@ class InferenceEngine:
         self._page_tables[slot_idx] = page_table[0]
         self._activate_slot(slot_idx, slot, prompt_len, first_token)
 
+    def _advance_key(self):
+        """Split the device-resident key chain; returns the subkey (for the
+        spec-decode jitted fns, which consume but don't return keys)."""
+        keys = jax.random.split(self._key_dev)
+        self._key_dev = keys[0]
+        return keys[1]
+
     def _run_prefill(
         self, tokens: np.ndarray, start: int, last_rel: int,
         page_table: np.ndarray, request: GenRequest,
@@ -502,14 +570,14 @@ class InferenceEngine:
         """One prefill window at absolute offset `start`, sampling from
         relative index `last_rel` (callers discard the sample for non-final
         chunks)."""
-        self._key, key = jax.random.split(self._key)
         put = partial(jax.device_put, device=self._repl)
-        args = (
+        common = (
             put(tokens),
             put(np.asarray([start], dtype=np.int32)),
             put(np.asarray([last_rel], dtype=np.int32)),
             put(np.ascontiguousarray(page_table)),
-            put(key),
+        )
+        sampling = (
             put(np.asarray([request.temperature], dtype=np.float32)),
             put(np.asarray([request.top_p], dtype=np.float32)),
         )
@@ -518,11 +586,14 @@ class InferenceEngine:
                 first_token, self.paged, self.d_paged = self._jit_spec_prefill(
                     self.params, self.draft_params,
                     self.model_cfg, self.draft_cfg,
-                    self.paged, self.d_paged, *args,
+                    self.paged, self.d_paged,
+                    *common, self._advance_key(), *sampling,
                 )
             else:
-                first_token, self.paged = self._jit_prefill(
-                    self.params, self.model_cfg, self.paged, *args
+                first_token, self._key_dev, self.paged = self._jit_prefill(
+                    self.params, self.model_cfg, self.paged,
+                    *common, self._key_dev, *sampling,
+                    greedy=request.temperature == 0.0,
                 )
             return int(first_token)
 
@@ -533,9 +604,16 @@ class InferenceEngine:
         request = slot.request
         slot.generated = 1
         slot.pending = None
+        if slot.table is not None:
+            # Chunked-prefill slot: its table enters the device mirrors only
+            # now that the lane is active (inactive lanes write through
+            # their mirror table — see _Slot.table).
+            self._page_tables[slot_idx] = slot.table[0]
+            slot.table = None
         self._seq_lens[slot_idx] = prompt_len + 1  # prompt + sampled token
         self._last_tokens[slot_idx] = first_token
         self._active[slot_idx] = True
+        self._caps[slot_idx] = slot.position_cap
         self._temperature[slot_idx] = request.temperature
         self._top_p[slot_idx] = request.top_p
         self._dev_dirty = True
@@ -567,8 +645,7 @@ class InferenceEngine:
         final = slot.filled + take >= prompt_len
         try:
             token = self._run_prefill(
-                tokens, slot.filled, take - 1,
-                self._page_tables[slot_idx:slot_idx + 1], request,
+                tokens, slot.filled, take - 1, slot.table, request,
             )
         except Exception as e:
             self._finish(slot_idx, error=f"prefill failed: {e}")
@@ -584,6 +661,7 @@ class InferenceEngine:
             "seq_lens": jax.device_put(self._seq_lens, self._dp_vec),
             "page_tables": jax.device_put(self._page_tables, self._dp_mat),
             "active": jax.device_put(self._active, self._dp_vec),
+            "caps": jax.device_put(self._caps, self._dp_vec),
             "temperature": jax.device_put(self._temperature, self._dp_vec),
             "top_p": jax.device_put(self._top_p, self._dp_vec),
         }
@@ -593,7 +671,6 @@ class InferenceEngine:
         if self._dev_dirty:
             self._upload_slot_state()
         dev = self._dev
-        self._key, key = jax.random.split(self._key)
         # top_p truncation breaks the rejection-sampling identity, so a
         # batch containing any top_p<1 row takes the plain step. Note the
         # blast radius is batch-wide, not per-request: speculation is off
@@ -602,10 +679,15 @@ class InferenceEngine:
         # collapsed for surviving streams afterwards. Correctness never
         # degrades; throughput recovers as those streams retire.
         if self._spec and bool(np.all(self._top_p[self._active] >= 1.0)):
-            self._spec_step(dev, key)
+            self._spec_step(dev, self._advance_key())
             return
+        # Static variant: an all-greedy batch (the benchmark mode) skips
+        # sample_dynamic's [B, vocab] sort and all RNG work. At most two
+        # compiled variants exist; the mix flips only at slot transitions.
+        greedy = bool(np.all(self._temperature[self._active] == 0.0))
         with jax.profiler.TraceAnnotation("polykey/decode"):
-            tokens_dev, seq_lens_dev, self.paged = self._jit_decode(
+            (toks_dev, emit_dev, last_dev, seq_dev, act_dev, self._key_dev,
+             self.paged) = self._jit_decode(
                 self.params,
                 self.model_cfg,
                 self.paged,
@@ -613,15 +695,21 @@ class InferenceEngine:
                 dev["seq_lens"],
                 dev["page_tables"],
                 dev["active"],
-                jax.device_put(key, self._repl),
+                dev["caps"],
+                self._key_dev,
                 dev["temperature"],
                 dev["top_p"],
+                greedy=greedy,
+                steps=self._block_steps,
+                eos_id=self.tokenizer.eos_id,
             )
-            # Feed the sampled tokens / advanced lengths straight back as
-            # next step's inputs; host mirrors update below for bookkeeping.
-            dev["last_tokens"] = tokens_dev
-            dev["seq_lens"] = seq_lens_dev
-            tokens = np.asarray(tokens_dev)  # blocks until step completes
+            # Feed final state straight back as the next block's inputs;
+            # host mirrors update below for bookkeeping.
+            dev["last_tokens"] = last_dev
+            dev["seq_lens"] = seq_dev
+            dev["active"] = act_dev
+            toks = np.asarray(toks_dev)   # [K, B]; blocks until block done
+            emit = np.asarray(emit_dev)   # [K, B] live-mask per sub-step
 
         emitted = 0
         for i, slot in enumerate(self._slots):
@@ -630,13 +718,18 @@ class InferenceEngine:
             if slot.request.cancelled.is_set():
                 self._finish(i, error="cancelled")
                 continue
-            token = int(tokens[i])
-            slot.generated += 1
-            self._seq_lens[i] += 1
-            self._last_tokens[i] = token
-            slot.request.out.put(("token", token))
-            emitted += 1
-            self._maybe_finish(i, token)
+            for k in range(self._block_steps):
+                if not emit[k, i]:
+                    break
+                token = int(toks[k, i])
+                slot.generated += 1
+                self._seq_lens[i] += 1
+                self._last_tokens[i] = token
+                slot.request.out.put(("token", token))
+                emitted += 1
+                self._maybe_finish(i, token)
+                if self._slots[i] is None:  # finished mid-block
+                    break
         self.metrics.on_step(emitted)
 
     def _spec_step(self, dev: dict, key) -> None:
@@ -708,6 +801,7 @@ class InferenceEngine:
         self.allocator.release_all(slot.pages)
         self._slots[slot_idx] = None
         self._active[slot_idx] = False
+        self._caps[slot_idx] = 0
         self._seq_lens[slot_idx] = 0
         self._last_tokens[slot_idx] = 0
         self._page_tables[slot_idx] = 0

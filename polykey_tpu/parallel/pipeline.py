@@ -120,37 +120,36 @@ def _staged(cfg: ModelConfig, mesh: Mesh, M: int, B: int, T: int):
 
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
         x_state = jnp.zeros_like(xs[0])
-        pos_state = jnp.zeros_like(pos[0])
         outs = jnp.zeros_like(xs)
 
         def tick(carry, t):
-            x_state, pos_state, outs = carry
+            x_state, outs = carry
             m = t - p                                   # this stage's microbatch
+            m_c = jnp.clip(m, 0, M - 1)
             valid = jnp.logical_and(m >= 0, m < M)
             inject = jnp.logical_and(p == 0, t < M)     # stage 0 feeds in
-            t_c = jnp.clip(t, 0, M - 1)
             x_in = jnp.where(
-                inject, lax.dynamic_index_in_dim(xs, t_c, 0, False), x_state
+                inject,
+                lax.dynamic_index_in_dim(xs, jnp.clip(t, 0, M - 1), 0, False),
+                x_state,
             )
-            pos_in = jnp.where(
-                inject, lax.dynamic_index_in_dim(pos, t_c, 0, False), pos_state
-            )
+            # Positions are pp-replicated input — index the local copy by
+            # microbatch instead of rotating them over the ICI.
+            pos_in = lax.dynamic_index_in_dim(pos, m_c, 0, False)
             y = run_local(x_in, pos_in)
             # Last stage banks finished microbatches.
-            m_c = jnp.clip(m, 0, M - 1)
             write = jnp.logical_and(valid, p == n_stages - 1)
             prev = lax.dynamic_index_in_dim(outs, m_c, 0, False)
             outs = lax.dynamic_update_index_in_dim(
                 outs, jnp.where(write, y, prev), m_c, 0
             )
-            # Rotate activations (and their positions) to the next stage.
+            # Rotate activations to the next stage.
             x_next = lax.ppermute(y, "pp", perm)
-            pos_next = lax.ppermute(pos_in, "pp", perm)
-            return (x_next, pos_next, outs), None
+            return (x_next, outs), None
 
-        (x_state, pos_state, outs), _ = lax.scan(
+        (x_state, outs), _ = lax.scan(
             tick,
-            (x_state, pos_state, outs),
+            (x_state, outs),
             jnp.arange(M + n_stages - 1, dtype=jnp.int32),
         )
         # Results live on the last stage only; masked psum replicates.

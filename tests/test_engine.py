@@ -127,6 +127,33 @@ def test_batched_greedy_matches_solo(engine):
     assert probe_tokens == solo_tokens
 
 
+def test_decode_block_steps_equivalence():
+    """Blocked decode (K steps per dispatch, device-side EOS/budget stop)
+    must be a pure batching of the K=1 step loop: identical greedy tokens,
+    including for requests whose budget is not a multiple of K."""
+    import dataclasses
+
+    outs = {}
+    for k in (1, 8):
+        eng = InferenceEngine(
+            dataclasses.replace(TEST_CONFIG, decode_block_steps=k)
+        )
+        try:
+            reqs = [
+                GenRequest(prompt=p, max_new_tokens=n)
+                for p, n in (("block probe", 11), ("x", 3), ("longer one", 8))
+            ]
+            for r in reqs:
+                eng.submit(r)
+            outs[k] = [_collect(r) for r in reqs]
+        finally:
+            eng.shutdown()
+    for (t1, d1, e1), (t8, d8, e8) in zip(outs[1], outs[8]):
+        assert e1 is None and e8 is None
+        assert t1 == t8
+        assert d1.completion_tokens == d8.completion_tokens
+
+
 def test_cancellation_frees_slot(engine):
     request = GenRequest(prompt="cancel me", max_new_tokens=32, temperature=1.0)
     engine.submit(request)

@@ -126,6 +126,37 @@ def test_long_prompt_does_not_block_short_streams():
         eng.shutdown()
 
 
+def test_chunked_prefill_unharmed_by_concurrent_decode():
+    """Regression: while a long prompt chunk-prefills, concurrent decode
+    blocks run its slot as an inactive lane and write garbage KV at
+    position 0 through whatever page table the device holds. The pending
+    slot's real table must stay out of the device mirrors until activation
+    (slot transitions mid-prefill force re-uploads), or the prompt's first
+    page is corrupted and the greedy output diverges."""
+    prompt = _prompt(600, seed=4)
+    ref, _ = _run_one(LONG_CONFIG, prompt)
+
+    eng = InferenceEngine(LONG_CONFIG)
+    try:
+        # Shorts first: they occupy the decode batch, and their staggered
+        # finishes mark the device state dirty mid-prefill (the trigger).
+        shorts = [
+            GenRequest(prompt=f"noise {i}", max_new_tokens=4 + 6 * i)
+            for i in range(3)
+        ]
+        for r in shorts:
+            eng.submit(r)
+        long_r = GenRequest(prompt=prompt, max_new_tokens=8)
+        eng.submit(long_r)
+        tokens, done, error = _collect(long_r)
+        for r in shorts:
+            _collect(r)
+        assert error is None, error
+        assert tokens == ref
+    finally:
+        eng.shutdown()
+
+
 def test_cancel_during_chunked_prefill():
     eng = InferenceEngine(LONG_CONFIG)
     try:
