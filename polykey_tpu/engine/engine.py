@@ -83,6 +83,12 @@ class _Slot:
     # _upload_slot_state) — or decode blocks would corrupt the prompt's
     # position-0 KV between prefill chunks.
     table: Optional[np.ndarray] = None
+    # Async prefill: the dispatched-but-unread sampled token (a device
+    # scalar) and the prompt length, resolved by _resolve_prefills AFTER the
+    # next decode block is dispatched — admission never blocks the loop on
+    # a device→host sync.
+    token_dev: Optional[jax.Array] = None
+    prompt_len: int = 0
 
 
 def _prefill_fn(
@@ -110,12 +116,7 @@ def _prefill_fn(
     hidden, paged = forward_paged(params, cfg, tokens, positions, paged, page_table)
     last = hidden[0, last_rel[0]][None]                    # [1, H]
     logits = unembed(params, cfg, last)                    # [1, V]
-    if greedy:
-        token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        new_key = key
-    else:
-        new_key, sub = jax.random.split(key)
-        token = sample_dynamic(logits, sub, temperature, top_p)
+    token, new_key = _sample_tail(logits, key, temperature, top_p, greedy)
     return token[0], new_key, paged
 
 
@@ -150,12 +151,7 @@ def _decode_fn(
             params, cfg, last[:, None], positions, paged, page_tables
         )
         logits = unembed(params, cfg, hidden[:, 0])        # [B, V]
-        if greedy:
-            tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            new_key = key
-        else:
-            new_key, sub = jax.random.split(key)
-            tokens = sample_dynamic(logits, sub, temperature, top_p)
+        tokens, new_key = _sample_tail(logits, key, temperature, top_p, greedy)
         tokens = jnp.where(act, tokens, 0)
         new_seq = seq + act.astype(jnp.int32)
         cont = act & (tokens != eos_id) & (new_seq < caps)
@@ -166,6 +162,16 @@ def _decode_fn(
         one, carry, None, length=steps
     )
     return toks, emit, last, seq, act, key, paged
+
+
+def _sample_tail(logits, key, temperature, top_p, greedy: bool):
+    """Shared sampling tail for prefill and decode: greedy takes pure
+    argmax and leaves the key chain untouched; otherwise split + per-row
+    dynamic sampling."""
+    if greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), key
+    new_key, sub = jax.random.split(key)
+    return sample_dynamic(logits, sub, temperature, top_p), new_key
 
 
 class EngineDeadError(RuntimeError):
@@ -332,9 +338,9 @@ class InferenceEngine:
 
         # Host mirrors of per-slot device state (engine thread only). They
         # are the source of truth at slot transitions (admit/finish mark
-        # `_dev_dirty` → re-upload); between transitions the decode state
-        # stays device-resident (`_dev`) and advances on-device, so steady
-        # decode uploads only the RNG key per step.
+        # `_dev_dirty` → re-upload); between transitions the decode state —
+        # RNG key included — stays device-resident (`_dev`) and advances
+        # on-device, so steady decode uploads nothing per block.
         self._page_tables = np.zeros((B, P), dtype=np.int32)
         self._seq_lens = np.zeros((B,), dtype=np.int32)
         self._last_tokens = np.zeros((B,), dtype=np.int32)
@@ -419,15 +425,22 @@ class InferenceEngine:
                 # step so running streams stall for ≤ one prefill bucket;
                 # long prompts advance one chunk per iteration for the same
                 # reason (chunked prefill — never more than one chunk of
-                # stall between decode steps).
+                # stall between decode steps). Prefills are DISPATCHED here
+                # and resolved only after the decode block is also in
+                # flight, so the host never sits in a device sync while the
+                # device has undispatched work.
                 limit = 1 if self._active.any() else None
                 worked = self._admit(limit)
                 chunk_slot = self._chunk_pending_slot()
                 if chunk_slot is not None:
                     self._prefill_one_chunk(chunk_slot)
                     worked = True
-                if self._active.any():
-                    self._step()
+                block = (
+                    self._dispatch_step() if self._active.any() else None
+                )
+                self._resolve_prefills()
+                if block is not None:
+                    self._process_step(block)
                     worked = True
                 if worked:
                     self.last_progress = time.monotonic()
@@ -528,6 +541,9 @@ class InferenceEngine:
         slot = _Slot(request=request, pages=pages, position_cap=total_len)
         bucket = self._bucket_for(prompt_len)
 
+        slot.table = page_table
+        slot.prompt_len = prompt_len
+
         if bucket is None:
             # Long prompt: register the slot in prefilling state; the
             # engine loop runs one chunk per iteration (interleaved with
@@ -536,25 +552,24 @@ class InferenceEngine:
             # decode blocks keep writing this lane's garbage through the
             # reserved page 0 instead of over the chunks already prefilled.
             slot.pending = np.asarray(prompt_ids, dtype=np.int32)
-            slot.table = page_table
             self._slots[slot_idx] = slot
             return
 
         try:
             tokens = np.zeros((1, bucket), dtype=np.int32)
             tokens[0, :prompt_len] = prompt_ids
-            first_token = self._run_prefill(
+            slot.token_dev = self._run_prefill(
                 tokens, 0, prompt_len - 1, page_table, request
             )
         except Exception:
-            # Pages are only owned by a _Slot after prefill succeeds; give
-            # them back on any failure in between or they leak forever.
+            # Pages are only owned by a _Slot after registration succeeds;
+            # give them back on any failure in between or they leak forever.
             self.allocator.release_all(pages)
             raise
 
+        # Registered but inactive until _resolve_prefills reads the token —
+        # after the next decode block is dispatched, so prefill overlaps it.
         self._slots[slot_idx] = slot
-        self._page_tables[slot_idx] = page_table[0]
-        self._activate_slot(slot_idx, slot, prompt_len, first_token)
 
     def _advance_key(self):
         """Split the device-resident key chain; returns the subkey (for the
@@ -566,10 +581,12 @@ class InferenceEngine:
     def _run_prefill(
         self, tokens: np.ndarray, start: int, last_rel: int,
         page_table: np.ndarray, request: GenRequest,
-    ) -> int:
+    ) -> jax.Array:
         """One prefill window at absolute offset `start`, sampling from
-        relative index `last_rel` (callers discard the sample for non-final
-        chunks)."""
+        relative index `last_rel`. Returns the sampled token as a DEVICE
+        scalar — callers either discard it (non-final chunks, no sync at
+        all) or resolve it later (_resolve_prefills), so dispatching a
+        prefill never blocks the engine loop on the device."""
         put = partial(jax.device_put, device=self._repl)
         common = (
             put(tokens),
@@ -595,7 +612,24 @@ class InferenceEngine:
                     *common, self._key_dev, *sampling,
                     greedy=request.temperature == 0.0,
                 )
-            return int(first_token)
+            return first_token
+
+    def _resolve_prefills(self) -> None:
+        """Read the sampled tokens of dispatched prefills and activate their
+        slots. Called after the decode block is dispatched, so the device
+        works through prefill + block while the host blocks here at most
+        once for work already in flight."""
+        for i, slot in enumerate(self._slots):
+            if slot is None or slot.token_dev is None:
+                continue
+            try:
+                token = int(slot.token_dev)
+            except Exception as e:
+                slot.token_dev = None
+                self._finish(i, error=f"prefill failed: {e}")
+                continue
+            slot.token_dev = None
+            self._activate_slot(i, slot, slot.prompt_len, token)
 
     def _activate_slot(
         self, slot_idx: int, slot: _Slot, prompt_len: int, first_token: int
@@ -605,9 +639,9 @@ class InferenceEngine:
         slot.generated = 1
         slot.pending = None
         if slot.table is not None:
-            # Chunked-prefill slot: its table enters the device mirrors only
-            # now that the lane is active (inactive lanes write through
-            # their mirror table — see _Slot.table).
+            # The table enters the device mirrors only now that the lane is
+            # active (inactive lanes write through their mirror table — see
+            # _Slot.table).
             self._page_tables[slot_idx] = slot.table[0]
             slot.table = None
         self._seq_lens[slot_idx] = prompt_len + 1  # prompt + sampled token
@@ -644,14 +678,18 @@ class InferenceEngine:
         tokens[0, :take] = slot.pending[slot.filled:slot.filled + take]
         final = slot.filled + take >= prompt_len
         try:
-            token = self._run_prefill(
+            token_dev = self._run_prefill(
                 tokens, slot.filled, take - 1, slot.table, request,
             )
         except Exception as e:
             self._finish(slot_idx, error=f"prefill failed: {e}")
             return
         if final:
-            self._activate_slot(slot_idx, slot, prompt_len, token)
+            # Leave chunking state; _resolve_prefills reads the token and
+            # activates after the next decode block is dispatched. Non-final
+            # chunks never sync at all — the device token is discarded.
+            slot.pending = None
+            slot.token_dev = token_dev
         else:
             slot.filled += take
 
@@ -667,7 +705,11 @@ class InferenceEngine:
         }
         self._dev_dirty = False
 
-    def _step(self) -> None:
+    def _dispatch_step(self):
+        """Dispatch one decode block (or spec round) without waiting for it;
+        returns an opaque record for _process_step. Between dispatch and
+        process the engine resolves pending prefills, overlapping their
+        device time with the block's."""
         if self._dev_dirty:
             self._upload_slot_state()
         dev = self._dev
@@ -679,8 +721,7 @@ class InferenceEngine:
         # collapsed for surviving streams afterwards. Correctness never
         # degrades; throughput recovers as those streams retire.
         if self._spec and bool(np.all(self._top_p[self._active] >= 1.0)):
-            self._spec_step(dev, self._advance_key())
-            return
+            return ("spec", self._dispatch_spec(dev, self._advance_key()))
         # Static variant: an all-greedy batch (the benchmark mode) skips
         # sample_dynamic's [B, vocab] sort and all RNG work. At most two
         # compiled variants exist; the mix flips only at slot transitions.
@@ -704,12 +745,23 @@ class InferenceEngine:
                 eos_id=self.tokenizer.eos_id,
             )
             # Feed final state straight back as the next block's inputs;
-            # host mirrors update below for bookkeeping.
+            # host mirrors update in _process_step for bookkeeping.
             dev["last_tokens"] = last_dev
             dev["seq_lens"] = seq_dev
             dev["active"] = act_dev
-            toks = np.asarray(toks_dev)   # [K, B]; blocks until block done
-            emit = np.asarray(emit_dev)   # [K, B] live-mask per sub-step
+        return ("plain", (toks_dev, emit_dev))
+
+    def _process_step(self, block) -> None:
+        """Sync a dispatched block's results and emit/finish on the host.
+        Slots activated between dispatch and process were not in the block:
+        their device emit masks are False, so the loop skips them."""
+        kind, data = block
+        if kind == "spec":
+            self._process_spec(data)
+            return
+        toks_dev, emit_dev = data
+        toks = np.asarray(toks_dev)   # [K, B]; blocks until block done
+        emit = np.asarray(emit_dev)   # [K, B] live-mask per sub-step
 
         emitted = 0
         for i, slot in enumerate(self._slots):
@@ -732,9 +784,8 @@ class InferenceEngine:
                     break
         self.metrics.on_step(emitted)
 
-    def _spec_step(self, dev: dict, key) -> None:
-        """One draft/verify round (spec_decode.py); emits ≤ gamma+1 tokens
-        per slot, truncated on host by EOS / budget caps."""
+    def _dispatch_spec(self, dev: dict, key):
+        """Dispatch one draft/verify round (spec_decode.py)."""
         with jax.profiler.TraceAnnotation("polykey/spec_decode"):
             (emit_dev, n_out_dev, new_last, new_seq, self.paged,
              self.d_paged) = self._jit_spec_decode(
@@ -747,8 +798,14 @@ class InferenceEngine:
             )
             dev["last_tokens"] = new_last
             dev["seq_lens"] = new_seq
-            emit = np.asarray(emit_dev)  # blocks until the round completes
-            n_out = np.asarray(n_out_dev)
+        return emit_dev, n_out_dev
+
+    def _process_spec(self, data) -> None:
+        """Sync a spec round; emits ≤ gamma+1 tokens per slot, truncated on
+        host by EOS / budget caps."""
+        emit_dev, n_out_dev = data
+        emit = np.asarray(emit_dev)  # blocks until the round completes
+        n_out = np.asarray(n_out_dev)
 
         emitted = accepted = proposed = 0
         for i, slot in enumerate(self._slots):
