@@ -101,6 +101,44 @@ def fabricate_params(cfg, dtype, quantize: bool):
     return jax.tree.map(make, tree)
 
 
+def _probe_step_costs(engine, max_new: int) -> dict:
+    """Diagnostic on the already-warm engine: a host↔device roundtrip floor
+    and one SOLO stream decoded start-to-finish (engine otherwise idle, so
+    the window is contiguous decode blocks — no admissions, no refill
+    gaps). Goes into the JSON `details` so a slow bench is attributable
+    (compute vs host/tunnel latency) from the artifact alone."""
+    import jax
+    import numpy as np
+
+    from polykey_tpu.engine.engine import GenRequest
+
+    out: dict = {}
+    # Host→device→host roundtrip floor (tiny transfer + sync).
+    t0 = time.monotonic()
+    for _ in range(5):
+        np.asarray(jax.device_put(np.zeros((1,), np.int32)))
+    out["roundtrip_ms"] = round((time.monotonic() - t0) / 5 * 1000, 2)
+
+    probe = GenRequest(prompt="step cost probe", max_new_tokens=max_new)
+    engine.submit(probe)
+    kind, _ = probe.out.get(timeout=600.0)        # first token → decoding
+    if kind != "token":
+        return out
+    snap0 = engine.metrics.snapshot()
+    t0 = time.monotonic()
+    kind, value = probe.out.get(timeout=600.0)
+    while kind == "token":
+        kind, value = probe.out.get(timeout=600.0)
+    dt = time.monotonic() - t0
+    snap1 = engine.metrics.snapshot()
+    steps = snap1["decode_steps"] - snap0["decode_steps"]
+    if kind == "done" and steps > 0 and dt > 0:
+        out["block_ms"] = round(dt / steps * 1000, 2)
+        out["block_steps"] = engine.config.decode_block_steps
+        out["solo_tok_s"] = round((value.completion_tokens - 1) / dt, 1)
+    return out
+
+
 def bench_engine(
     engine_cfg, params, n_requests: int, prompt_len: int, max_new: int
 ) -> dict:
@@ -171,12 +209,15 @@ def bench_engine(
         p50_ttft = statistics.median(t.ttft_ms for t in timings)
         log(f"{len(timings)} requests, {total_tokens} tokens in "
             f"{elapsed:.2f}s -> {tok_s:.1f} tok/s, p50 TTFT {p50_ttft:.1f} ms")
+        costs = _probe_step_costs(engine, max_new)
+        log(f"step costs: {costs}")
         return {
             "tok_s": round(tok_s, 1),
             "p50_ttft_ms": round(p50_ttft, 1),
             "requests": len(timings),
             "total_tokens": total_tokens,
             "elapsed_s": round(elapsed, 2),
+            "step_costs": costs,
         }
     finally:
         engine.shutdown()
@@ -203,6 +244,8 @@ def main() -> None:
     max_new = int(os.environ.get(
         "POLYKEY_BENCH_NEW_TOKENS", "128" if on_tpu else "16"))
 
+    block = int(os.environ.get("POLYKEY_BENCH_BLOCK", "16" if on_tpu else "4"))
+
     # --- Phase A: engine bench, 1B-class bf16 (tiny on CPU fallback). ---
     model_a = os.environ.get(
         "POLYKEY_BENCH_MODEL", "llama-1b-bench" if on_tpu else "tiny-llama")
@@ -215,9 +258,10 @@ def main() -> None:
         max_seq_len=512 if on_tpu else 128,
         prefill_buckets=(prompt_len,) if on_tpu else (32, 64),
         max_new_tokens_cap=max_new,
+        decode_block_steps=block,
     )
     try:
-        log(f"--- phase A: engine bench, {model_a} ---")
+        log(f"--- phase A: engine bench, {model_a} (block={block}) ---")
         phase_a = bench_engine(
             cfg_a, None, n_req, prompt_len if on_tpu else 24, max_new)
         result["engine_1b"] = {"model": model_a, **phase_a}
@@ -246,6 +290,7 @@ def main() -> None:
                 max_seq_len=512,
                 prefill_buckets=(prompt_len,),
                 max_new_tokens_cap=max_new,
+                decode_block_steps=block,
             )
             phase_b = bench_engine(cfg_b, params8, 32, prompt_len, max_new)
             result["engine_8b_int8"] = phase_b
