@@ -359,6 +359,7 @@ class InferenceEngine:
             jax.random.PRNGKey(seed + 1), self._repl
         )
         self._submit: queue.Queue[GenRequest] = queue.Queue()
+        self._inflight = None  # lookahead: the unprocessed dispatched block
         self._wake = threading.Event()
         self._stop = threading.Event()
         self.dead: Optional[str] = None
@@ -435,13 +436,43 @@ class InferenceEngine:
                 if chunk_slot is not None:
                     self._prefill_one_chunk(chunk_slot)
                     worked = True
+                # Cross-block lookahead: block k+1 is dispatched BEFORE
+                # block k's results are synced, so host processing + D2H
+                # hide behind device compute. Device-side stopping makes
+                # the stale active mask safe (a stream the host finished
+                # was stopped on device by the same EOS/cap condition, so
+                # its lookahead emit lanes are False); cancellations are
+                # the one host-only transition, guarded per-block by the
+                # request-identity snapshot in _process_step. Transitions
+                # (dirty mirrors) drain the in-flight block first so a
+                # re-upload can never rewind live device state.
+                if self._inflight is not None and (
+                    self._dev_dirty or self._inflight[1][0].is_ready()
+                ):
+                    # Drain early when mirrors must catch up (dirty) or the
+                    # block already finished on device (is_ready — the
+                    # batch-drain case, where dispatching ahead of a stale
+                    # ALL-idle mirror would waste a full dead block and
+                    # delay the next admission behind it).
+                    self._process_step(self._inflight)
+                    self._inflight = None
                 block = (
                     self._dispatch_step() if self._active.any() else None
                 )
                 self._resolve_prefills()
-                if block is not None:
-                    self._process_step(block)
+                if self._inflight is not None:
+                    self._process_step(self._inflight)
                     worked = True
+                    self._inflight = None
+                if block is not None:
+                    worked = True
+                    if block[0] == "spec":
+                        # Spec rounds have no device-side EOS stop — a
+                        # stale lookahead round could overrun the gamma
+                        # page slack — so they stay synchronous.
+                        self._process_step(block)
+                    else:
+                        self._inflight = block
                 if worked:
                     self.last_progress = time.monotonic()
                 else:
@@ -721,7 +752,11 @@ class InferenceEngine:
         # collapsed for surviving streams afterwards. Correctness never
         # degrades; throughput recovers as those streams retire.
         if self._spec and bool(np.all(self._top_p[self._active] >= 1.0)):
-            return ("spec", self._dispatch_spec(dev, self._advance_key()))
+            return (
+                "spec",
+                self._dispatch_spec(dev, self._advance_key()),
+                self._snapshot_requests(),
+            )
         # Static variant: an all-greedy batch (the benchmark mode) skips
         # sample_dynamic's [B, vocab] sort and all RNG work. At most two
         # compiled variants exist; the mix flips only at slot transitions.
@@ -749,15 +784,22 @@ class InferenceEngine:
             dev["last_tokens"] = last_dev
             dev["seq_lens"] = seq_dev
             dev["active"] = act_dev
-        return ("plain", (toks_dev, emit_dev))
+        return ("plain", (toks_dev, emit_dev), self._snapshot_requests())
+
+    def _snapshot_requests(self):
+        """Per-slot request identities at dispatch time: with cross-block
+        lookahead a slot can be finished (cancel) and re-admitted while its
+        block is in flight, and the stale lane's tokens must never reach
+        the new occupant."""
+        return [s.request if s is not None else None for s in self._slots]
 
     def _process_step(self, block) -> None:
         """Sync a dispatched block's results and emit/finish on the host.
         Slots activated between dispatch and process were not in the block:
         their device emit masks are False, so the loop skips them."""
-        kind, data = block
+        kind, data, reqs = block
         if kind == "spec":
-            self._process_spec(data)
+            self._process_spec(data, reqs)
             return
         toks_dev, emit_dev = data
         toks = np.asarray(toks_dev)   # [K, B]; blocks until block done
@@ -765,7 +807,7 @@ class InferenceEngine:
 
         emitted = 0
         for i, slot in enumerate(self._slots):
-            if slot is None or not self._active[i]:
+            if slot is None or not self._active[i] or slot.request is not reqs[i]:
                 continue
             if slot.request.cancelled.is_set():
                 self._finish(i, error="cancelled")
@@ -800,7 +842,7 @@ class InferenceEngine:
             dev["seq_lens"] = new_seq
         return emit_dev, n_out_dev
 
-    def _process_spec(self, data) -> None:
+    def _process_spec(self, data, reqs) -> None:
         """Sync a spec round; emits ≤ gamma+1 tokens per slot, truncated on
         host by EOS / budget caps."""
         emit_dev, n_out_dev = data
@@ -809,7 +851,7 @@ class InferenceEngine:
 
         emitted = accepted = proposed = 0
         for i, slot in enumerate(self._slots):
-            if slot is None or not self._active[i]:
+            if slot is None or not self._active[i] or slot.request is not reqs[i]:
                 continue
             if slot.request.cancelled.is_set():
                 self._finish(i, error="cancelled")
@@ -879,6 +921,7 @@ class InferenceEngine:
             pass
 
     def _fail_all(self, message: str) -> None:
+        self._inflight = None  # drop unprocessed lookahead results
         for i, slot in enumerate(self._slots):
             if slot is not None:
                 self._finish(i, error=message)

@@ -154,6 +154,40 @@ def test_decode_block_steps_equivalence():
         assert d1.completion_tokens == d8.completion_tokens
 
 
+def test_stale_block_tokens_never_reach_new_occupant():
+    """Lookahead safety net: a block dispatched while request A held slot 0
+    must deliver nothing once the slot belongs to request B — the
+    per-block request-identity snapshot (engine._snapshot_requests) is the
+    only guard on this path, since B can be active with A's block still
+    unprocessed only through host-side transitions (cancel + re-admit).
+    White-box: the engine loop is stopped and _process_step driven
+    directly with a forged stale block."""
+    import numpy as np
+
+    from polykey_tpu.engine.engine import _Slot
+
+    eng = InferenceEngine(TEST_CONFIG)
+    eng.shutdown()  # stop the loop; we drive internals directly
+
+    req_a = GenRequest(prompt="A")          # the evicted occupant
+    req_b = GenRequest(prompt="B")          # the new occupant
+    slot_b = _Slot(request=req_b, pages=[], position_cap=10)
+    slot_b.generated = 1
+    eng._slots[0] = slot_b
+    eng._active[0] = True
+    eng._seq_lens[0] = 3
+
+    B, K = TEST_CONFIG.max_decode_slots, TEST_CONFIG.decode_block_steps
+    toks = np.full((K, B), 7, dtype=np.int32)
+    emit = np.ones((K, B), dtype=bool)
+    reqs = [req_a] + [None] * (B - 1)       # snapshot from A's dispatch
+    eng._process_step(("plain", (toks, emit), reqs))
+
+    assert req_b.out.empty()                # B got nothing from A's block
+    assert req_a.out.empty()                # A is gone; tokens are dropped
+    assert slot_b.generated == 1            # no bookkeeping drift either
+
+
 def test_cancellation_frees_slot(engine):
     request = GenRequest(prompt="cancel me", max_new_tokens=32, temperature=1.0)
     engine.submit(request)
