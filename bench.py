@@ -158,11 +158,15 @@ def bench_engine(
 
     engine = InferenceEngine(engine_cfg, params=params)
     try:
-        log("warmup (compiles prefill bucket + decode step)...")
+        # Shape compiles happen in __init__ (compile_warmup=True); this
+        # end-to-end warmup covers the host paths (tokenizer, queues).
+        log("warmup (e2e; shapes pre-compiled at engine init)...")
         t0 = time.monotonic()
-        for _ in range(2):
-            r = GenRequest(prompt=prompt(), max_new_tokens=max_new)
+        warm = [GenRequest(prompt=prompt(), max_new_tokens=max_new)
+                for _ in range(2)]
+        for r in warm:
             engine.submit(r)
+        for r in warm:
             while r.out.get(timeout=600.0)[0] == "token":
                 pass
         log(f"warmup done in {time.monotonic() - t0:.1f}s")
@@ -259,6 +263,7 @@ def main() -> None:
         prefill_buckets=(prompt_len,) if on_tpu else (32, 64),
         max_new_tokens_cap=max_new,
         decode_block_steps=block,
+        compile_warmup=True,
     )
     try:
         log(f"--- phase A: engine bench, {model_a} (block={block}) ---")
@@ -291,6 +296,7 @@ def main() -> None:
                 prefill_buckets=(prompt_len,),
                 max_new_tokens_cap=max_new,
                 decode_block_steps=block,
+                compile_warmup=True,
             )
             phase_b = bench_engine(cfg_b, params8, 32, prompt_len, max_new)
             result["engine_8b_int8"] = phase_b

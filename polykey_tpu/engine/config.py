@@ -50,6 +50,13 @@ class EngineConfig:
     max_new_tokens_cap: int = 1024
     default_max_new_tokens: int = 64
 
+    # Pre-compile the greedy prefill group shapes ({1,2,4} × buckets) and
+    # the greedy decode block at engine construction, before the loop
+    # starts — first requests (and benchmark windows) then never pay XLA
+    # compile time. Costs startup latency; sampled variants still compile
+    # lazily.
+    compile_warmup: bool = False
+
     # Decode steps per dispatch: the jitted decode runs `decode_block_steps`
     # steps in one lax.scan call, with device-side EOS/budget stopping, so
     # per-dispatch host overhead (Python + transfer latency — dominant when
@@ -102,6 +109,8 @@ class EngineConfig:
             default_max_new_tokens=_env_int(
                 "POLYKEY_DEFAULT_MAX_NEW_TOKENS", cls.default_max_new_tokens
             ),
+            compile_warmup=os.environ.get("POLYKEY_COMPILE_WARMUP", "").lower()
+            in ("1", "true"),
             decode_block_steps=_env_int(
                 "POLYKEY_DECODE_BLOCK", cls.decode_block_steps
             ),

@@ -96,28 +96,30 @@ def _prefill_fn(
     tokens, start, last_rel, page_table, key, temperature, top_p,
     *, greedy: bool,
 ):
-    """Prefill one window (tokens [1, T]) at absolute positions
-    start..start+T-1 and sample from the hidden state at relative index
-    last_rel. Short prompts run as one window; long prompts run as a chain
-    of fixed-size chunks through this same function (the engine discards
-    the sampled token for all but the final chunk), so one compiled shape
-    serves both paths. Padded tail positions write KV that is either
-    masked (position > any query), overwritten by later decode steps, or
-    lands on the reserved garbage page — never read.
+    """Prefill N windows (tokens [N, T]) at absolute positions
+    start[i]..start[i]+T-1 and sample from each hidden state at relative
+    index last_rel[i]. One compiled shape serves every path: single
+    admissions (N=1), burst admissions batched by bucket (N up to the
+    group cap), and long prompts chunk through it N=1 at a time (the
+    engine discards the sampled token for all but the final chunk).
+    Padded tail positions write KV that is either masked (position > any
+    query), overwritten by later decode steps, or lands on the reserved
+    garbage page — never read; padded GROUP rows point their whole table
+    at the garbage page.
 
-    `greedy` is a static variant selector: the all-greedy request takes a
+    `greedy` is a static variant selector: an all-greedy group takes a
     pure-argmax tail (no full-vocab sort, no RNG use) — at 128k-256k vocab
     the top-p sort is a real per-step cost, and greedy is the north-star
     benchmark mode. The key threads through both variants so the engine
     keeps one device-resident RNG chain.
     """
-    T = tokens.shape[1]
-    positions = start[0] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    N, T = tokens.shape
+    positions = start[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
     hidden, paged = forward_paged(params, cfg, tokens, positions, paged, page_table)
-    last = hidden[0, last_rel[0]][None]                    # [1, H]
-    logits = unembed(params, cfg, last)                    # [1, V]
+    last = hidden[jnp.arange(N), last_rel]                 # [N, H]
+    logits = unembed(params, cfg, last)                    # [N, V]
     token, new_key = _sample_tail(logits, key, temperature, top_p, greedy)
-    return token[0], new_key, paged
+    return token, new_key, paged
 
 
 def _decode_fn(
@@ -172,6 +174,9 @@ def _sample_tail(logits, key, temperature, top_p, greedy: bool):
         return jnp.argmax(logits, axis=-1).astype(jnp.int32), key
     new_key, sub = jax.random.split(key)
     return sample_dynamic(logits, sub, temperature, top_p), new_key
+
+
+_MAX_PREFILL_GROUP = 4   # burst admissions batched per prefill dispatch
 
 
 class EngineDeadError(RuntimeError):
@@ -360,6 +365,9 @@ class InferenceEngine:
         )
         self._submit: queue.Queue[GenRequest] = queue.Queue()
         self._inflight = None  # lookahead: the unprocessed dispatched block
+        self._pending_groups: list = []  # batched prefills awaiting resolve
+        if config.compile_warmup and not self._spec:
+            self._compile_warmup()
         self._wake = threading.Event()
         self._stop = threading.Event()
         self.dead: Optional[str] = None
@@ -503,31 +511,51 @@ class InferenceEngine:
         return None
 
     def _admit(self, limit: Optional[int] = None) -> bool:
+        """Admit waiting requests into free slots. Short prompts are
+        gathered into per-bucket groups and prefilled in ONE batched
+        dispatch per group (burst admissions — e.g. cold start — pay one
+        device call instead of one per request); long prompts register for
+        chunked prefill. Spec engines dispatch per-request (the spec
+        prefill fn is single-row)."""
         admitted = False
         count = 0
-        while limit is None or count < limit:
-            free_slots = [i for i, s in enumerate(self._slots) if s is None]
-            if not free_slots:
-                return admitted
-            try:
-                request = self._submit.get_nowait()
-            except queue.Empty:
-                return admitted
-            if request.cancelled.is_set():
-                continue
-            try:
-                self._start_request(free_slots[0], request)
-                admitted = True
-                count += 1
-            except AllocationError:
-                # Pool exhausted: put it back and let running requests
-                # finish. FIFO fairness over throughput.
-                self._requeue_front(request)
-                return admitted
-            except Exception as e:
-                request.out.put(("error", f"admission failed: {e}"))
-                self.metrics.on_finish(request.timings, failed=True)
-        return admitted
+        groups: dict[int, list] = {}    # bucket → [(slot_idx, slot, ids)]
+        try:
+            while limit is None or count < limit:
+                free_slots = [
+                    i for i, s in enumerate(self._slots) if s is None
+                ]
+                if not free_slots:
+                    return admitted
+                try:
+                    request = self._submit.get_nowait()
+                except queue.Empty:
+                    return admitted
+                if request.cancelled.is_set():
+                    continue
+                try:
+                    prep = self._prepare_request(free_slots[0], request)
+                    admitted = True
+                    count += 1
+                    if prep is not None:
+                        bucket = prep[0]
+                        groups.setdefault(bucket, []).append(prep[1:])
+                        if len(groups[bucket]) >= _MAX_PREFILL_GROUP:
+                            self._dispatch_prefill_group(
+                                bucket, groups.pop(bucket)
+                            )
+                except AllocationError:
+                    # Pool exhausted: put it back and let running requests
+                    # finish. FIFO fairness over throughput.
+                    self._requeue_front(request)
+                    return admitted
+                except Exception as e:
+                    request.out.put(("error", f"admission failed: {e}"))
+                    self.metrics.on_finish(request.timings, failed=True)
+            return admitted
+        finally:
+            for bucket, group in groups.items():
+                self._dispatch_prefill_group(bucket, group)
 
     def _requeue_front(self, request: GenRequest) -> None:
         # queue.Queue has no push-front; rebuild (small queues, rare path).
@@ -540,7 +568,12 @@ class InferenceEngine:
         for item in items:
             self._submit.put(item)
 
-    def _start_request(self, slot_idx: int, request: GenRequest) -> None:
+    def _prepare_request(self, slot_idx: int, request: GenRequest):
+        """Tokenize, budget, allocate pages, and register the slot.
+        Returns (bucket, slot_idx, slot, prompt_ids) for short prompts
+        (the caller batches their prefill dispatches) or None for
+        long prompts (registered for chunked prefill) and spec engines
+        (dispatched here, single-row)."""
         cfg = self.config
         request.timings.prefill_start = time.monotonic()
 
@@ -584,23 +617,111 @@ class InferenceEngine:
             # reserved page 0 instead of over the chunks already prefilled.
             slot.pending = np.asarray(prompt_ids, dtype=np.int32)
             self._slots[slot_idx] = slot
-            return
-
-        try:
-            tokens = np.zeros((1, bucket), dtype=np.int32)
-            tokens[0, :prompt_len] = prompt_ids
-            slot.token_dev = self._run_prefill(
-                tokens, 0, prompt_len - 1, page_table, request
-            )
-        except Exception:
-            # Pages are only owned by a _Slot after registration succeeds;
-            # give them back on any failure in between or they leak forever.
-            self.allocator.release_all(pages)
-            raise
+            return None
 
         # Registered but inactive until _resolve_prefills reads the token —
         # after the next decode block is dispatched, so prefill overlaps it.
         self._slots[slot_idx] = slot
+
+        if self._spec:
+            # Spec prefill is single-row; dispatch now.
+            try:
+                tokens = np.zeros((1, bucket), dtype=np.int32)
+                tokens[0, :prompt_len] = prompt_ids
+                slot.token_dev = self._run_prefill(
+                    tokens, 0, prompt_len - 1, page_table, request
+                )
+            except Exception:
+                # On any dispatch failure the slot must not linger as a
+                # permanently-inactive reservation.
+                self._slots[slot_idx] = None
+                self.allocator.release_all(pages)
+                raise
+            return None
+
+        return bucket, slot_idx, slot, np.asarray(prompt_ids, np.int32)
+
+    def _dispatch_prefill_group(self, bucket: int, group: list) -> None:
+        """One batched prefill dispatch for up to _MAX_PREFILL_GROUP
+        same-bucket admissions, padded to a power of two so the compiled
+        shape set stays small ({1,2,4} × buckets). Padded rows point their
+        page tables at the reserved garbage page and are never resolved."""
+        n = len(group)
+        n_pad = 1 if n == 1 else 2 if n == 2 else 4
+        cfg = self.config
+        tokens = np.zeros((n_pad, bucket), dtype=np.int32)
+        last_rel = np.zeros((n_pad,), dtype=np.int32)
+        tables = np.zeros((n_pad, cfg.pages_per_seq), dtype=np.int32)
+        temp = np.zeros((n_pad,), dtype=np.float32)
+        top_p = np.ones((n_pad,), dtype=np.float32)
+        for r, (slot_idx, slot, ids) in enumerate(group):
+            tokens[r, : len(ids)] = ids
+            last_rel[r] = len(ids) - 1
+            tables[r] = slot.table[0]
+            temp[r] = slot.request.temperature
+            top_p[r] = slot.request.top_p
+        greedy = bool(np.all(temp == 0.0))
+
+        put = partial(jax.device_put, device=self._repl)
+        try:
+            with jax.profiler.TraceAnnotation("polykey/prefill"):
+                toks_dev, self._key_dev, self.paged = self._jit_prefill(
+                    self.params, self.model_cfg, self.paged,
+                    put(tokens), put(np.zeros((n_pad,), np.int32)),
+                    put(last_rel), put(tables), self._key_dev,
+                    put(temp), put(top_p),
+                    greedy=greedy,
+                )
+        except Exception as e:
+            # Contain the failure to this group: every member slot is
+            # already registered, so each must be finished (pages released,
+            # client errored) or they leak and their clients hang forever.
+            for slot_idx, slot, _ in group:
+                if self._slots[slot_idx] is slot:
+                    self._finish(slot_idx, error=f"prefill failed: {e}")
+            return
+        self._pending_groups.append(
+            (toks_dev, [(slot_idx, slot) for slot_idx, slot, _ in group])
+        )
+
+    def _compile_warmup(self) -> None:
+        """Pre-compile the greedy prefill group shapes and the greedy decode
+        block against the reserved garbage page. Runs in __init__ before
+        the engine thread starts, so there is no concurrent owner of the
+        donated pools; first real requests then never pay compile time."""
+        cfg = self.config
+        B = cfg.max_decode_slots
+        put = partial(jax.device_put, device=self._repl)
+        # Possible padded group sizes given the slot count (groups are
+        # bounded by free slots; n=3 pads to 4, so B>=3 can see [4]).
+        pads = [1] + ([2] if B >= 2 else []) + ([4] if B >= 3 else [])
+        for bucket in cfg.prefill_buckets:
+            for n in pads:
+                toks_dev, self._key_dev, self.paged = self._jit_prefill(
+                    self.params, self.model_cfg, self.paged,
+                    put(np.zeros((n, bucket), np.int32)),
+                    put(np.zeros((n,), np.int32)),
+                    put(np.zeros((n,), np.int32)),
+                    put(np.zeros((n, cfg.pages_per_seq), np.int32)),
+                    self._key_dev,
+                    put(np.zeros((n,), np.float32)),
+                    put(np.ones((n,), np.float32)),
+                    greedy=True,
+                )
+        self._upload_slot_state()
+        dev = self._dev
+        outs = self._jit_decode(
+            self.params, self.model_cfg, self.paged,
+            dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
+            dev["active"], dev["caps"], self._key_dev,
+            dev["temperature"], dev["top_p"],
+            greedy=True, steps=self._block_steps,
+            eos_id=self.tokenizer.eos_id,
+        )
+        *_, self._key_dev, self.paged = outs
+        jax.block_until_ready(self.paged)
+        # The dirty flag forces a fresh upload once real slots exist.
+        self._dev_dirty = True
 
     def _advance_key(self):
         """Split the device-resident key chain; returns the subkey (for the
@@ -646,15 +767,31 @@ class InferenceEngine:
             return first_token
 
     def _resolve_prefills(self) -> None:
-        """Read the sampled tokens of dispatched prefills and activate their
-        slots. Called after the decode block is dispatched, so the device
-        works through prefill + block while the host blocks here at most
-        once for work already in flight."""
+        """Read the sampled tokens of dispatched prefills (batched groups
+        and single chunk-final/spec rows) and activate their slots. Called
+        after the decode block is dispatched, so the device works through
+        prefill + block while the host blocks here only for work already
+        in flight."""
+        groups, self._pending_groups = self._pending_groups, []
+        for toks_dev, members in groups:
+            try:
+                toks = np.asarray(toks_dev)
+            except Exception as e:
+                for slot_idx, slot in members:
+                    if self._slots[slot_idx] is slot:
+                        self._finish(slot_idx, error=f"prefill failed: {e}")
+                continue
+            for r, (slot_idx, slot) in enumerate(members):
+                if self._slots[slot_idx] is not slot:
+                    continue    # finished (shutdown/cancel) meanwhile
+                self._activate_slot(
+                    slot_idx, slot, slot.prompt_len, int(toks[r])
+                )
         for i, slot in enumerate(self._slots):
             if slot is None or slot.token_dev is None:
                 continue
             try:
-                token = int(slot.token_dev)
+                token = int(np.asarray(slot.token_dev).reshape(-1)[0])
             except Exception as e:
                 slot.token_dev = None
                 self._finish(i, error=f"prefill failed: {e}")
@@ -922,6 +1059,7 @@ class InferenceEngine:
 
     def _fail_all(self, message: str) -> None:
         self._inflight = None  # drop unprocessed lookahead results
+        self._pending_groups = []  # their slots are failed via _finish below
         for i, slot in enumerate(self._slots):
             if slot is not None:
                 self._finish(i, error=message)

@@ -127,6 +127,26 @@ def test_batched_greedy_matches_solo(engine):
     assert probe_tokens == solo_tokens
 
 
+def test_burst_admission_matches_solo(engine):
+    """A probe admitted inside a same-bucket burst (batched prefill group)
+    must produce the same greedy stream as when admitted alone."""
+    probe_prompt = "burst determinism"
+    solo = GenRequest(prompt=probe_prompt, max_new_tokens=6)
+    engine.submit(solo)
+    solo_tokens, _, _ = _collect(solo)
+
+    burst = [GenRequest(prompt=f"burst noise {i}", max_new_tokens=6)
+             for i in range(3)]
+    probe = GenRequest(prompt=probe_prompt, max_new_tokens=6)
+    for r in burst + [probe]:
+        engine.submit(r)
+    probe_tokens, _, probe_err = _collect(probe)
+    for r in burst:
+        _collect(r)
+    assert probe_err is None
+    assert probe_tokens == solo_tokens
+
+
 def test_decode_block_steps_equivalence():
     """Blocked decode (K steps per dispatch, device-side EOS/budget stop)
     must be a pure batching of the K=1 step loop: identical greedy tokens,
@@ -152,6 +172,32 @@ def test_decode_block_steps_equivalence():
         assert e1 is None and e8 is None
         assert t1 == t8
         assert d1.completion_tokens == d8.completion_tokens
+
+
+def test_compile_warmup_engine_serves_identically():
+    """compile_warmup pre-runs the jitted shapes against the garbage page
+    in __init__; the warmed engine must serve the same greedy streams."""
+    import dataclasses
+
+    ref_eng = InferenceEngine(TEST_CONFIG)
+    try:
+        r = GenRequest(prompt="warmup probe", max_new_tokens=6)
+        ref_eng.submit(r)
+        ref, _, _ = _collect(r)
+    finally:
+        ref_eng.shutdown()
+
+    warm_eng = InferenceEngine(
+        dataclasses.replace(TEST_CONFIG, compile_warmup=True)
+    )
+    try:
+        r = GenRequest(prompt="warmup probe", max_new_tokens=6)
+        warm_eng.submit(r)
+        out, done, error = _collect(r)
+        assert error is None
+        assert out == ref
+    finally:
+        warm_eng.shutdown()
 
 
 def test_stale_block_tokens_never_reach_new_occupant():
