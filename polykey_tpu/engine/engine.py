@@ -333,10 +333,11 @@ class InferenceEngine:
             )
             self._jit_spec_decode = jax.jit(
                 spec_decode_fn,
-                static_argnames=("t_cfg", "d_cfg", "gamma"),
+                static_argnames=("t_cfg", "d_cfg", "gamma", "eos_id"),
                 donate_argnames=("t_paged", "d_paged"),
                 out_shardings=(
                     self._dp_mat, self._dp_vec, self._dp_vec, self._dp_vec,
+                    self._dp_vec, self._repl,
                     self._pool_sharding, self._pool_sharding,
                 ),
             )
@@ -474,13 +475,10 @@ class InferenceEngine:
                     self._inflight = None
                 if block is not None:
                     worked = True
-                    if block[0] == "spec":
-                        # Spec rounds have no device-side EOS stop — a
-                        # stale lookahead round could overrun the gamma
-                        # page slack — so they stay synchronous.
-                        self._process_step(block)
-                    else:
-                        self._inflight = block
+                    # Spec rounds carry the same device-side EOS/cap stop
+                    # as plain blocks (spec_decode_fn new_active), so both
+                    # are safe to hold across the lookahead boundary.
+                    self._inflight = block
                 if worked:
                     self.last_progress = time.monotonic()
                 else:
@@ -966,51 +964,47 @@ class InferenceEngine:
     def _dispatch_spec(self, dev: dict, key):
         """Dispatch one draft/verify round (spec_decode.py)."""
         with jax.profiler.TraceAnnotation("polykey/spec_decode"):
-            (emit_dev, n_out_dev, new_last, new_seq, self.paged,
-             self.d_paged) = self._jit_spec_decode(
+            (emit_dev, n_out_dev, new_last, new_seq, new_active, stats_dev,
+             self.paged, self.d_paged) = self._jit_spec_decode(
                 self.params, self.draft_params,
                 self.model_cfg, self.draft_cfg,
                 self.paged, self.d_paged,
                 dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
-                dev["active"], jax.device_put(key, self._repl),
+                dev["active"], dev["caps"], jax.device_put(key, self._repl),
                 dev["temperature"], gamma=self._gamma,
+                eos_id=self.tokenizer.eos_id,
             )
             dev["last_tokens"] = new_last
             dev["seq_lens"] = new_seq
-        return emit_dev, n_out_dev
+            dev["active"] = new_active
+        return emit_dev, n_out_dev, stats_dev
 
     def _process_spec(self, data, reqs) -> None:
-        """Sync a spec round; emits ≤ gamma+1 tokens per slot, truncated on
-        host by EOS / budget caps."""
-        emit_dev, n_out_dev = data
+        """Sync a spec round; emits the device-truncated n_out tokens per
+        slot. Acceptance stats come FROM the device (spec_decode_fn), which
+        owns truncation and the untruncated n_acc the dial needs."""
+        emit_dev, n_out_dev, stats_dev = data
         emit = np.asarray(emit_dev)  # blocks until the round completes
         n_out = np.asarray(n_out_dev)
+        accepted, proposed = (int(v) for v in np.asarray(stats_dev))
 
-        emitted = accepted = proposed = 0
+        emitted = 0
         for i, slot in enumerate(self._slots):
             if slot is None or not self._active[i] or slot.request is not reqs[i]:
                 continue
             if slot.request.cancelled.is_set():
                 self._finish(i, error="cancelled")
                 continue
-            sent = 0
             for j in range(int(n_out[i])):
                 token = int(emit[i, j])
                 slot.generated += 1
                 self._seq_lens[i] += 1
                 self._last_tokens[i] = token
                 slot.request.out.put(("token", token))
-                sent += 1
+                emitted += 1
                 self._maybe_finish(i, token)
                 if self._slots[i] is None:   # finished mid-window
                     break
-            emitted += sent
-            # ADVICE r1: the dial counts only drafts with a chance to be
-            # emitted — a truncated round (EOS/budget) contributes `sent`
-            # to both sides, so a perfect draft still reads exactly 1.0.
-            truncated = sent < int(n_out[i])
-            accepted += min(int(n_out[i]) - 1, sent)
-            proposed += sent if truncated else self._gamma
         self.metrics.on_step(emitted)
         self.metrics.on_spec(accepted, proposed)
 

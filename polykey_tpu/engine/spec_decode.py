@@ -62,17 +62,24 @@ def spec_prefill_fn(
 def spec_decode_fn(
     t_params, d_params, t_cfg: ModelConfig, d_cfg: ModelConfig,
     t_paged, d_paged,
-    last_tokens, seq_lens, page_tables, active, key, temperature,
-    gamma: int,
+    last_tokens, seq_lens, page_tables, active, caps, key, temperature,
+    gamma: int, eos_id: int,
 ):
     """One draft/verify round for the whole slot batch.
 
     Returns (emit [B, gamma+1], n_out [B], new_last [B], new_seq_lens [B],
-    t_paged, d_paged). Row semantics: `last_tokens` is
+    new_active [B], t_paged, d_paged). Row semantics: `last_tokens` is
     the already-emitted token at position seq_lens-1 whose KV is not yet
     written (the same invariant as the plain decode step); the round emits
     n_out = n_acc+1 tokens per active row. Greedy rows reproduce the
     target's exact greedy chain for any draft model.
+
+    Liveness is tracked ON DEVICE, mirroring the host's _maybe_finish the
+    way the plain block does (engine._decode_fn): n_out truncates at the
+    first EOS and at the position cap, and `new_active` goes False for
+    stopped rows — so a host-finished stream is already stopped here and
+    stale lookahead rounds emit nothing and write only stationary garbage
+    inside the row's own gamma page slack.
     """
     B = last_tokens.shape[0]
     rows = jnp.arange(B, dtype=jnp.int32)
@@ -148,9 +155,36 @@ def spec_decode_fn(
     emit = jnp.concatenate([drafts, jnp.zeros((B, 1), jnp.int32)], axis=1)
     emit = emit.at[rows, n_acc].set(extra)                # [B, gamma+1]
     n_out = (n_acc + 1) * active.astype(jnp.int32)
+
+    # Device-side stopping (mirrors engine._decode_fn / host _maybe_finish):
+    # truncate at the first EOS in the emitted prefix and at the row's
+    # position cap, and retire stopped rows from the next round.
+    cols = jnp.arange(gamma + 1, dtype=jnp.int32)[None, :]
+    is_eos = (emit == eos_id) & (cols < n_out[:, None])
+    has_eos = jnp.any(is_eos, axis=1)
+    first_eos = jnp.argmax(is_eos, axis=1).astype(jnp.int32)
+    n_out = jnp.where(has_eos, first_eos + 1, n_out)
+    n_out = jnp.minimum(n_out, jnp.maximum(caps - seq_lens, 0))
+
     emit = jnp.where(active[:, None], emit, 0)
     new_seq_lens = seq_lens + n_out
     new_last = jnp.where(
-        active, emit[rows, jnp.maximum(n_out - 1, 0)], last_tokens
+        active & (n_out > 0), emit[rows, jnp.maximum(n_out - 1, 0)], last_tokens
     )
-    return emit, n_out, new_last, new_seq_lens, t_paged, d_paged
+    new_active = active & ~has_eos & (new_seq_lens < caps)
+
+    # Acceptance-dial stats, computed HERE because truncation happens here
+    # (the host only sees truncated n_out): per ADVICE r1, a round cut
+    # short by EOS/cap counts only the drafts that had a chance to be
+    # emitted — sent/sent, so a perfect draft reads exactly 1.0 — while a
+    # full round counts n_acc/gamma. Inactive lanes contribute nothing.
+    untrunc = (n_acc + 1) * active.astype(jnp.int32)
+    cut = n_out < untrunc
+    acc_rows = jnp.minimum(jnp.maximum(untrunc - 1, 0), n_out)
+    prop_rows = jnp.where(cut, n_out, gamma) * active.astype(jnp.int32)
+    stats = jnp.stack([jnp.sum(acc_rows), jnp.sum(prop_rows)])
+
+    return (
+        emit, n_out, new_last, new_seq_lens, new_active, stats,
+        t_paged, d_paged,
+    )
