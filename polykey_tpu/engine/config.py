@@ -65,9 +65,12 @@ class EngineConfig:
     # block boundaries. 1 → token-at-a-time (lowest streaming latency).
     decode_block_steps: int = 8
 
-    # Parallelism axes (parallel/mesh.py); 1 → axis unused.
+    # Parallelism axes (parallel/mesh.py); 1 → axis unused. ep shards MoE
+    # expert weights and rides token dispatch over the ep axis (Mixtral —
+    # BASELINE.md measurement config 4); it requires an MoE model.
     tp: int = 1
     dp: int = 1
+    ep: int = 1
 
     # Speculative decoding (engine/spec_decode.py): a draft model name turns
     # it on; gamma = drafts per verify round. Draft must share the target's
@@ -116,6 +119,7 @@ class EngineConfig:
             ),
             tp=_env_int("POLYKEY_TP", cls.tp),
             dp=_env_int("POLYKEY_DP", cls.dp),
+            ep=_env_int("POLYKEY_EP", cls.ep),
             draft_model=os.environ.get("POLYKEY_DRAFT_MODEL") or None,
             draft_checkpoint_path=os.environ.get("POLYKEY_DRAFT_CHECKPOINT")
             or None,

@@ -202,16 +202,17 @@ class InferenceEngine:
         self._dtype = jnp.dtype(config.dtype)
 
         # --- Serving mesh: tp shards heads/hidden (Megatron specs,
-        # parallel/sharding.py), dp shards the decode-slot batch. tp=dp=1
-        # degenerates to a single-device mesh with identical code paths
-        # (specs over size-1 axes are no-ops, so there is no unsharded
-        # special case to keep in sync).
-        n_devices = config.tp * config.dp
+        # parallel/sharding.py), dp shards the decode-slot batch, ep shards
+        # MoE expert weights (token dispatch rides all-to-all over ep —
+        # measurement config 4). tp=dp=ep=1 degenerates to a single-device
+        # mesh with identical code paths (specs over size-1 axes are
+        # no-ops, so there is no unsharded special case to keep in sync).
+        n_devices = config.tp * config.dp * config.ep
         devices = jax.devices()
         if n_devices > len(devices):
             raise ValueError(
-                f"tp={config.tp} x dp={config.dp} needs {n_devices} "
-                f"devices, have {len(devices)}"
+                f"tp={config.tp} x dp={config.dp} x ep={config.ep} needs "
+                f"{n_devices} devices, have {len(devices)}"
             )
         if self.model_cfg.num_kv_heads % config.tp != 0:
             raise ValueError(
@@ -223,8 +224,20 @@ class InferenceEngine:
                 f"dp={config.dp} must divide max_decode_slots="
                 f"{config.max_decode_slots}"
             )
+        if config.ep > 1:
+            if not self.model_cfg.is_moe:
+                raise ValueError(
+                    f"ep={config.ep} requires an MoE model "
+                    f"({self.model_cfg.name} has no experts)"
+                )
+            if self.model_cfg.num_experts % config.ep != 0:
+                raise ValueError(
+                    f"ep={config.ep} must divide num_experts="
+                    f"{self.model_cfg.num_experts}"
+                )
         self.mesh = create_mesh(
-            MeshConfig(dp=config.dp, tp=config.tp), devices=devices[:n_devices]
+            MeshConfig(dp=config.dp, ep=config.ep, tp=config.tp),
+            devices=devices[:n_devices],
         )
         from jax.sharding import NamedSharding, PartitionSpec
         self._pool_sharding = paged_kv_sharding(self.mesh)

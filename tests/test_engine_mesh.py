@@ -112,9 +112,37 @@ def test_tp2_quantized_matches_quantized(reference_outputs):
     ) == ref
 
 
+MOE_CONFIG = dataclasses.replace(BASE_CONFIG, model="tiny-mixtral")
+
+
+@pytest.fixture(scope="module")
+def moe_reference_outputs():
+    return _run_prompts(MOE_CONFIG)
+
+
+@_needs(2)
+def test_ep2_moe_matches_single_device(moe_reference_outputs):
+    """Expert-parallel serving (measurement config 4): expert weights shard
+    over ep (parallel/sharding.py experts rules) and the engine's greedy
+    output must match the unsharded MoE engine exactly."""
+    assert _run_prompts(
+        dataclasses.replace(MOE_CONFIG, ep=2)
+    ) == moe_reference_outputs
+
+
+@_needs(4)
+def test_ep2_tp2_moe_matches_single_device(moe_reference_outputs):
+    assert _run_prompts(
+        dataclasses.replace(MOE_CONFIG, ep=2, tp=2)
+    ) == moe_reference_outputs
+
+
 def test_bad_geometry_rejected():
     with pytest.raises(ValueError):
         InferenceEngine(dataclasses.replace(BASE_CONFIG, dp=3))  # 3 ∤ 4 slots
     with pytest.raises(ValueError):
         # tiny-llama has 2 kv heads; tp=4 can't shard them.
         InferenceEngine(dataclasses.replace(BASE_CONFIG, tp=4))
+    with pytest.raises(ValueError):
+        # ep requires an MoE model.
+        InferenceEngine(dataclasses.replace(BASE_CONFIG, ep=2))
