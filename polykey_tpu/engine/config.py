@@ -67,10 +67,16 @@ class EngineConfig:
 
     # Parallelism axes (parallel/mesh.py); 1 → axis unused. ep shards MoE
     # expert weights and rides token dispatch over the ep axis (Mixtral —
-    # BASELINE.md measurement config 4); it requires an MoE model.
+    # BASELINE.md measurement config 4); it requires an MoE model. sp
+    # shards the PREFILL token axis (sequence-parallel prefill): long
+    # prompts spread their attention/MLP compute over sp chips, with the
+    # KV writes exchanged into the sp-replicated page pools by GSPMD —
+    # the serving-path long-context story (SURVEY §5). Decode is
+    # unaffected (T=1). Buckets and prefill_chunk must divide by sp.
     tp: int = 1
     dp: int = 1
     ep: int = 1
+    sp: int = 1
 
     # Speculative decoding (engine/spec_decode.py): a draft model name turns
     # it on; gamma = drafts per verify round. Draft must share the target's
@@ -120,6 +126,7 @@ class EngineConfig:
             tp=_env_int("POLYKEY_TP", cls.tp),
             dp=_env_int("POLYKEY_DP", cls.dp),
             ep=_env_int("POLYKEY_EP", cls.ep),
+            sp=_env_int("POLYKEY_SP", cls.sp),
             draft_model=os.environ.get("POLYKEY_DRAFT_MODEL") or None,
             draft_checkpoint_path=os.environ.get("POLYKEY_DRAFT_CHECKPOINT")
             or None,
@@ -150,3 +157,14 @@ class EngineConfig:
             raise ValueError("prefill_chunk must be >= 0 (0 → max bucket)")
         if self.decode_block_steps < 1:
             raise ValueError("decode_block_steps must be >= 1")
+        for name in ("tp", "dp", "ep", "sp"):
+            if getattr(self, name) < 1:
+                raise ValueError(f"{name} must be >= 1")
+        if self.sp > 1:
+            chunk = self.prefill_chunk or max(self.prefill_buckets)
+            for b in (*self.prefill_buckets, chunk):
+                if b % self.sp != 0:
+                    raise ValueError(
+                        f"sp={self.sp} must divide every prefill bucket "
+                        f"and the prefill chunk (got {b})"
+                    )

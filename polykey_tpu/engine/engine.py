@@ -207,12 +207,13 @@ class InferenceEngine:
         # measurement config 4). tp=dp=ep=1 degenerates to a single-device
         # mesh with identical code paths (specs over size-1 axes are
         # no-ops, so there is no unsharded special case to keep in sync).
-        n_devices = config.tp * config.dp * config.ep
+        n_devices = config.tp * config.dp * config.ep * config.sp
         devices = jax.devices()
         if n_devices > len(devices):
             raise ValueError(
-                f"tp={config.tp} x dp={config.dp} x ep={config.ep} needs "
-                f"{n_devices} devices, have {len(devices)}"
+                f"tp={config.tp} x dp={config.dp} x ep={config.ep} x "
+                f"sp={config.sp} needs {n_devices} devices, "
+                f"have {len(devices)}"
             )
         if self.model_cfg.num_kv_heads % config.tp != 0:
             raise ValueError(
@@ -236,12 +237,19 @@ class InferenceEngine:
                     f"{self.model_cfg.num_experts}"
                 )
         self.mesh = create_mesh(
-            MeshConfig(dp=config.dp, ep=config.ep, tp=config.tp),
+            MeshConfig(
+                dp=config.dp, sp=config.sp, ep=config.ep, tp=config.tp
+            ),
             devices=devices[:n_devices],
         )
         from jax.sharding import NamedSharding, PartitionSpec
         self._pool_sharding = paged_kv_sharding(self.mesh)
         self._repl = NamedSharding(self.mesh, PartitionSpec())
+        # Sequence-parallel prefill: the window's token axis shards over
+        # sp, spreading prefill compute across chips; the page pools are
+        # sp-replicated, so GSPMD exchanges the KV writes (sp=1 → a no-op
+        # spec, same code path).
+        self._prefill_tok = NamedSharding(self.mesh, PartitionSpec(None, "sp"))
         self._dp_vec = NamedSharding(self.mesh, PartitionSpec("dp"))
         self._dp_mat = NamedSharding(self.mesh, PartitionSpec("dp", None))
         # Pinned output shardings keep the donated pool's layout stable
@@ -678,7 +686,8 @@ class InferenceEngine:
             with jax.profiler.TraceAnnotation("polykey/prefill"):
                 toks_dev, self._key_dev, self.paged = self._jit_prefill(
                     self.params, self.model_cfg, self.paged,
-                    put(tokens), put(np.zeros((n_pad,), np.int32)),
+                    jax.device_put(tokens, self._prefill_tok),
+                    put(np.zeros((n_pad,), np.int32)),
                     put(last_rel), put(tables), self._key_dev,
                     put(temp), put(top_p),
                     greedy=greedy,
@@ -710,7 +719,9 @@ class InferenceEngine:
             for n in pads:
                 toks_dev, self._key_dev, self.paged = self._jit_prefill(
                     self.params, self.model_cfg, self.paged,
-                    put(np.zeros((n, bucket), np.int32)),
+                    jax.device_put(
+                        np.zeros((n, bucket), np.int32), self._prefill_tok
+                    ),
                     put(np.zeros((n,), np.int32)),
                     put(np.zeros((n,), np.int32)),
                     put(np.zeros((n, cfg.pages_per_seq), np.int32)),
@@ -752,7 +763,7 @@ class InferenceEngine:
         prefill never blocks the engine loop on the device."""
         put = partial(jax.device_put, device=self._repl)
         common = (
-            put(tokens),
+            jax.device_put(tokens, self._prefill_tok),
             put(np.asarray([start], dtype=np.int32)),
             put(np.asarray([last_rel], dtype=np.int32)),
             put(np.ascontiguousarray(page_table)),

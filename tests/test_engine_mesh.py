@@ -52,10 +52,10 @@ def _collect(request: GenRequest, timeout=60.0):
     return tokens, done, error
 
 
-def _run_prompts(config: EngineConfig, quantize: bool = False):
-    eng = InferenceEngine(dataclasses.replace(config, quantize=quantize))
+def _run_prompts_for(config: EngineConfig, prompts):
+    eng = InferenceEngine(config)
     try:
-        requests = [GenRequest(prompt=p, max_new_tokens=8) for p in PROMPTS]
+        requests = [GenRequest(prompt=p, max_new_tokens=8) for p in prompts]
         for r in requests:
             eng.submit(r)
         outs = []
@@ -67,6 +67,12 @@ def _run_prompts(config: EngineConfig, quantize: bool = False):
         return outs
     finally:
         eng.shutdown()
+
+
+def _run_prompts(config: EngineConfig, quantize: bool = False):
+    return _run_prompts_for(
+        dataclasses.replace(config, quantize=quantize), PROMPTS
+    )
 
 
 @pytest.fixture(scope="module")
@@ -137,6 +143,32 @@ def test_ep2_tp2_moe_matches_single_device(moe_reference_outputs):
     ) == moe_reference_outputs
 
 
+@_needs(2)
+def test_sp2_matches_single_device(reference_outputs):
+    """Sequence-parallel prefill: the window's token axis shards over sp
+    (compute spread + GSPMD KV exchange into the sp-replicated pools);
+    decode is untouched. Greedy output must match exactly."""
+    assert _run_prompts(
+        dataclasses.replace(BASE_CONFIG, sp=2)
+    ) == reference_outputs
+
+
+@_needs(2)
+def test_sp2_chunked_long_prompt_matches():
+    """Long prompts chunk through the same sp-sharded prefill window."""
+    import numpy as np
+
+    rng = np.random.default_rng(5)
+    prompt = "".join(chr(c) for c in rng.integers(97, 123, 120))
+    cfg = dataclasses.replace(
+        BASE_CONFIG, max_seq_len=256, num_pages=128, prefill_chunk=32
+    )
+    ref = _run_prompts_for(cfg, [prompt])
+    assert _run_prompts_for(
+        dataclasses.replace(cfg, sp=2), [prompt]
+    ) == ref
+
+
 def test_bad_geometry_rejected():
     with pytest.raises(ValueError):
         InferenceEngine(dataclasses.replace(BASE_CONFIG, dp=3))  # 3 ∤ 4 slots
@@ -146,3 +178,10 @@ def test_bad_geometry_rejected():
     with pytest.raises(ValueError):
         # ep requires an MoE model.
         InferenceEngine(dataclasses.replace(BASE_CONFIG, ep=2))
+    with pytest.raises(ValueError):
+        # sp must divide every prefill bucket (buckets are 16, 32).
+        dataclasses.replace(BASE_CONFIG, sp=3).validate()
+    with pytest.raises(ValueError):
+        # Axis values below 1 (e.g. POLYKEY_SP=0 typo) must fail loudly,
+        # not build a zero-device mesh.
+        dataclasses.replace(BASE_CONFIG, sp=0).validate()
