@@ -118,3 +118,40 @@ def test_network_tester_refused():
     with pytest.raises(ConnectionError):
         # Port 1 on localhost is essentially guaranteed closed.
         NetworkTester().test_connection("127.0.0.1:1", timeout=0.5)
+
+
+def test_engine_config_from_env(monkeypatch):
+    """Every POLYKEY_* engine knob must actually reach EngineConfig —
+    a knob that parses to nowhere silently misleads operators."""
+    from polykey_tpu.engine.config import EngineConfig
+
+    env = {
+        "POLYKEY_MODEL": "tiny-mixtral",
+        "POLYKEY_DTYPE": "float32",
+        "POLYKEY_QUANTIZE": "1",
+        "POLYKEY_MAX_DECODE_SLOTS": "8",
+        "POLYKEY_PAGE_SIZE": "32",
+        "POLYKEY_NUM_PAGES": "256",
+        "POLYKEY_MAX_SEQ_LEN": "1024",
+        "POLYKEY_PREFILL_BUCKETS": "64,256",
+        "POLYKEY_PREFILL_CHUNK": "64",
+        "POLYKEY_DECODE_BLOCK": "4",
+        "POLYKEY_COMPILE_WARMUP": "true",
+        "POLYKEY_TP": "2",
+        "POLYKEY_DP": "2",
+        "POLYKEY_EP": "2",
+        "POLYKEY_SP": "2",
+        "POLYKEY_DRAFT_MODEL": "tiny-llama",
+        "POLYKEY_SPEC_GAMMA": "3",
+    }
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    cfg = EngineConfig.from_env()
+    assert cfg.model == "tiny-mixtral"
+    assert cfg.quantize and cfg.compile_warmup
+    assert (cfg.max_decode_slots, cfg.page_size, cfg.num_pages) == (8, 32, 256)
+    assert cfg.prefill_buckets == (64, 256)
+    assert (cfg.prefill_chunk, cfg.decode_block_steps) == (64, 4)
+    assert (cfg.tp, cfg.dp, cfg.ep, cfg.sp) == (2, 2, 2, 2)
+    assert (cfg.draft_model, cfg.spec_gamma) == ("tiny-llama", 3)
+    cfg.validate()
