@@ -27,6 +27,10 @@ def _env_float(name: str, default: float) -> float:
         return default
 
 
+def _env_bool(name: str, extra: tuple[str, ...] = ()) -> bool:
+    return os.environ.get(name, "").lower() in ("1", "true", *extra)
+
+
 @dataclass(frozen=True)
 class EngineConfig:
     model: str = "tiny-llama"
@@ -49,6 +53,14 @@ class EngineConfig:
     prefill_chunk: int = 0               # 0 → max(prefill_buckets)
     max_new_tokens_cap: int = 1024
     default_max_new_tokens: int = 64
+
+    # Automatic prefix caching (engine/prefix_cache.py): requests sharing a
+    # page-aligned prompt prefix reuse its KV pages and prefill only the
+    # suffix. prefix_cache_pages caps the cache's own page references
+    # (LRU); 0 → num_pages // 2. Incompatible with speculative decoding
+    # (the draft pool's pages are not keyed).
+    prefix_cache: bool = False
+    prefix_cache_pages: int = 0
 
     # Pre-compile the greedy prefill group shapes ({1,2,4} × buckets) and
     # the greedy decode block at engine construction, before the loop
@@ -102,8 +114,7 @@ class EngineConfig:
             tokenizer=os.environ.get("POLYKEY_TOKENIZER", cls.tokenizer),
             dtype=os.environ.get("POLYKEY_DTYPE", cls.dtype),
             checkpoint_path=os.environ.get("POLYKEY_CHECKPOINT") or None,
-            quantize=os.environ.get("POLYKEY_QUANTIZE", "").lower()
-            in ("1", "true", "int8"),
+            quantize=_env_bool("POLYKEY_QUANTIZE", extra=("int8",)),
             max_decode_slots=_env_int("POLYKEY_MAX_DECODE_SLOTS", cls.max_decode_slots),
             page_size=_env_int("POLYKEY_PAGE_SIZE", cls.page_size),
             num_pages=_env_int("POLYKEY_NUM_PAGES", cls.num_pages),
@@ -118,8 +129,11 @@ class EngineConfig:
             default_max_new_tokens=_env_int(
                 "POLYKEY_DEFAULT_MAX_NEW_TOKENS", cls.default_max_new_tokens
             ),
-            compile_warmup=os.environ.get("POLYKEY_COMPILE_WARMUP", "").lower()
-            in ("1", "true"),
+            prefix_cache=_env_bool("POLYKEY_PREFIX_CACHE"),
+            prefix_cache_pages=_env_int(
+                "POLYKEY_PREFIX_CACHE_PAGES", cls.prefix_cache_pages
+            ),
+            compile_warmup=_env_bool("POLYKEY_COMPILE_WARMUP"),
             decode_block_steps=_env_int(
                 "POLYKEY_DECODE_BLOCK", cls.decode_block_steps
             ),
@@ -153,6 +167,16 @@ class EngineConfig:
             raise ValueError("need at least one prefill bucket")
         if self.draft_model is not None and self.spec_gamma < 1:
             raise ValueError("spec_gamma must be >= 1")
+        if self.prefix_cache and self.draft_model is not None:
+            raise ValueError(
+                "prefix_cache is incompatible with speculative decoding "
+                "(the draft pool's pages are not prefix-keyed)"
+            )
+        if self.prefix_cache_pages < 0:
+            raise ValueError(
+                "prefix_cache_pages must be >= 0 (0 → num_pages // 2); "
+                "negative would silently disable the LRU cap"
+            )
         if self.prefill_chunk < 0:
             raise ValueError("prefill_chunk must be >= 0 (0 → max bucket)")
         if self.decode_block_steps < 1:

@@ -89,6 +89,7 @@ class _Slot:
     # a device→host sync.
     token_dev: Optional[jax.Array] = None
     prompt_len: int = 0
+    prompt_ids: Optional[np.ndarray] = None  # for prefix-cache insertion
 
 
 def _prefill_fn(
@@ -297,6 +298,14 @@ class InferenceEngine:
             self._pool_sharding,
         )
         self.allocator = BlockAllocator(config.num_pages)
+        self._prefix = None
+        if config.prefix_cache:
+            from .prefix_cache import PrefixCache
+
+            self._prefix = PrefixCache(
+                self.allocator, config.page_size,
+                config.prefix_cache_pages or config.num_pages // 2,
+            )
 
         self._chunk = config.prefill_chunk or max(config.prefill_buckets)
         self._block_steps = config.decode_block_steps
@@ -429,6 +438,8 @@ class InferenceEngine:
                 "queued": self._submit.qsize(),
             }
         )
+        if self._prefix is not None:
+            snap.update(self._prefix.stats())
         return snap
 
     @property
@@ -616,8 +627,27 @@ class InferenceEngine:
         request.timings.prompt_tokens = prompt_len
 
         total_len = prompt_len + max_new
-        num_pages = -(-(total_len + self._gamma) // cfg.page_size)  # ceil
-        pages = self.allocator.alloc(num_pages)     # may raise AllocationError
+        ids = np.asarray(prompt_ids, dtype=np.int32)
+
+        # Prefix cache: reuse pages covering a cached page-aligned prefix
+        # (lookup retains them for this slot); only the suffix prefills.
+        matched: list[int] = []
+        if self._prefix is not None:
+            matched = self._prefix.lookup(ids)
+        need = -(-(total_len + self._gamma) // cfg.page_size) - len(matched)
+        try:
+            try:
+                fresh = self.allocator.alloc(need)
+            except AllocationError:
+                if self._prefix is None:
+                    raise
+                # Allocation pressure: shed cold cache entries and retry.
+                self._prefix.evict_for(need)
+                fresh = self.allocator.alloc(need)
+        except AllocationError:
+            self.allocator.release_all(matched)     # drop lookup's refs
+            raise
+        pages = matched + fresh
 
         page_table = np.zeros((1, cfg.pages_per_seq), dtype=np.int32)
         page_table[0, : len(pages)] = pages
@@ -626,6 +656,21 @@ class InferenceEngine:
 
         slot.table = page_table
         slot.prompt_len = prompt_len
+        slot.prompt_ids = ids
+
+        if matched:
+            # Prefill only the suffix. A bucket-sized suffix rides the
+            # batched bucket path at its own width (a hit must not cost
+            # more than a miss); longer suffixes chunk from the offset.
+            filled = len(matched) * cfg.page_size
+            suffix = ids[filled:]
+            suffix_bucket = self._bucket_for(len(suffix))
+            self._slots[slot_idx] = slot
+            if suffix_bucket is None:
+                slot.pending = ids
+                slot.filled = filled
+                return None
+            return suffix_bucket, slot_idx, slot, suffix, filled
 
         if bucket is None:
             # Long prompt: register the slot in prefilling state; the
@@ -658,7 +703,7 @@ class InferenceEngine:
                 raise
             return None
 
-        return bucket, slot_idx, slot, np.asarray(prompt_ids, np.int32)
+        return bucket, slot_idx, slot, ids, 0
 
     def _dispatch_prefill_group(self, bucket: int, group: list) -> None:
         """One batched prefill dispatch for up to _MAX_PREFILL_GROUP
@@ -669,12 +714,14 @@ class InferenceEngine:
         n_pad = 1 if n == 1 else 2 if n == 2 else 4
         cfg = self.config
         tokens = np.zeros((n_pad, bucket), dtype=np.int32)
+        starts = np.zeros((n_pad,), dtype=np.int32)
         last_rel = np.zeros((n_pad,), dtype=np.int32)
         tables = np.zeros((n_pad, cfg.pages_per_seq), dtype=np.int32)
         temp = np.zeros((n_pad,), dtype=np.float32)
         top_p = np.ones((n_pad,), dtype=np.float32)
-        for r, (slot_idx, slot, ids) in enumerate(group):
+        for r, (slot_idx, slot, ids, start) in enumerate(group):
             tokens[r, : len(ids)] = ids
+            starts[r] = start                   # >0: prefix-cache suffix
             last_rel[r] = len(ids) - 1
             tables[r] = slot.table[0]
             temp[r] = slot.request.temperature
@@ -687,7 +734,7 @@ class InferenceEngine:
                 toks_dev, self._key_dev, self.paged = self._jit_prefill(
                     self.params, self.model_cfg, self.paged,
                     jax.device_put(tokens, self._prefill_tok),
-                    put(np.zeros((n_pad,), np.int32)),
+                    put(starts),
                     put(last_rel), put(tables), self._key_dev,
                     put(temp), put(top_p),
                     greedy=greedy,
@@ -696,12 +743,12 @@ class InferenceEngine:
             # Contain the failure to this group: every member slot is
             # already registered, so each must be finished (pages released,
             # client errored) or they leak and their clients hang forever.
-            for slot_idx, slot, _ in group:
+            for slot_idx, slot, _, _ in group:
                 if self._slots[slot_idx] is slot:
                     self._finish(slot_idx, error=f"prefill failed: {e}")
             return
         self._pending_groups.append(
-            (toks_dev, [(slot_idx, slot) for slot_idx, slot, _ in group])
+            (toks_dev, [(slot_idx, slot) for slot_idx, slot, _, _ in group])
         )
 
     def _compile_warmup(self) -> None:
@@ -834,6 +881,10 @@ class InferenceEngine:
             # _Slot.table).
             self._page_tables[slot_idx] = slot.table[0]
             slot.table = None
+        if self._prefix is not None and slot.prompt_ids is not None:
+            # The prompt's KV is fully written (activation follows the
+            # prefill's device sync) — publish its page-aligned pages.
+            self._prefix.insert(slot.prompt_ids, slot.pages)
         self._seq_lens[slot_idx] = prompt_len + 1  # prompt + sampled token
         self._last_tokens[slot_idx] = first_token
         self._active[slot_idx] = True

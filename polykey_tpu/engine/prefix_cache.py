@@ -1,0 +1,128 @@
+"""Automatic prefix caching: KV pages shared across requests.
+
+Requests that share a prompt prefix (system prompts, few-shot headers,
+multi-turn histories) recompute identical KV today. This cache maps
+page-aligned prompt prefixes to resident pages in the pool, so a new
+request reuses the cached pages and prefills only its unmatched suffix —
+TTFT for an N-token prompt with an M-token cached prefix drops to the
+cost of N-M tokens.
+
+Correctness rests on three facts:
+- KV at a position depends only on the token prefix up to it (causal
+  attention, absolute RoPE), so equal page-aligned prefixes ⇒ equal page
+  contents; the rolling hash keys on the full prefix, not the page alone.
+- Shared pages are read-only for every consumer: a slot's own writes
+  start at its first unmatched position, which is strictly beyond the
+  matched pages (lookup never matches the full prompt — at least one
+  token always prefills), and the engine's garbage-lane writes land on
+  the reserved page 0 or at a slot's own frontier.
+- Lifetime is refcounts (engine/kv_cache.BlockAllocator, the C++
+  native/block_allocator.cc): the cache holds one reference per cached
+  page, each using slot holds its own; eviction (LRU) drops the cache's
+  reference and the page frees when the last slot releases it.
+
+The reference has no analog (stateless mock — SURVEY.md §2); this is the
+standard production-serving feature (vLLM-style automatic prefix
+caching) built on this framework's own page/refcount machinery.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+
+import numpy as np
+
+from .kv_cache import BlockAllocator
+
+
+def _page_keys(ids: np.ndarray, page_size: int, n_pages: int) -> list[bytes]:
+    """Rolling page-granular prefix keys: key_i commits to ALL tokens in
+    pages 0..i, so a page is only ever shared between prompts whose entire
+    prefix up to it matches."""
+    keys = []
+    key = b""
+    for i in range(n_pages):
+        chunk = ids[i * page_size:(i + 1) * page_size].tobytes()
+        key = hashlib.blake2b(key + chunk, digest_size=16).digest()
+        keys.append(key)
+    return keys
+
+
+class PrefixCache:
+    """LRU map of page-aligned prompt-prefix hashes → pool page ids."""
+
+    def __init__(
+        self, allocator: BlockAllocator, page_size: int, capacity_pages: int
+    ):
+        self._alloc = allocator
+        self._page_size = page_size
+        self._capacity = max(0, capacity_pages)
+        self._map: OrderedDict[bytes, int] = OrderedDict()
+        self.hit_tokens = 0
+        self.lookup_tokens = 0
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def lookup(self, ids: np.ndarray) -> list[int]:
+        """Longest cached page-aligned proper prefix of `ids`; RETAINS each
+        matched page on behalf of the caller (the caller owns releasing
+        them like any other slot page). Never matches the whole prompt —
+        at least one token must prefill to produce the sampling hidden."""
+        n_full = max(0, (len(ids) - 1) // self._page_size)
+        pages: list[int] = []
+        for key in _page_keys(ids, self._page_size, n_full):
+            page = self._map.get(key)
+            if page is None:
+                break
+            self._map.move_to_end(key)
+            self._alloc.retain(page)
+            pages.append(page)
+        self.lookup_tokens += len(ids)
+        self.hit_tokens += len(pages) * self._page_size
+        return pages
+
+    def insert(self, ids: np.ndarray, table_pages: list[int]) -> None:
+        """Register a fully-prefilled prompt's page-aligned pages
+        (table_pages[i] holds positions [i·ps, (i+1)·ps)). The cache
+        retains each newly-inserted page; known keys just refresh LRU."""
+        n_full = min(
+            max(0, (len(ids) - 1) // self._page_size), len(table_pages)
+        )
+        for i, key in enumerate(_page_keys(ids, self._page_size, n_full)):
+            if key in self._map:
+                self._map.move_to_end(key)
+                continue
+            if self._capacity and len(self._map) >= self._capacity:
+                self._evict_one()
+            self._alloc.retain(table_pages[i])
+            self._map[key] = table_pages[i]
+
+    def _evict_one(self) -> bool:
+        if not self._map:
+            return False
+        _, page = self._map.popitem(last=False)      # LRU
+        self._alloc.release(page)
+        return True
+
+    def evict_for(self, pages_needed: int) -> int:
+        """Allocation-pressure eviction: drop LRU entries until the free
+        list could satisfy `pages_needed` (or the cache is empty). A
+        released page only frees if no slot still references it, so this
+        loops rather than computing a count."""
+        evicted = 0
+        while self._alloc.num_free < pages_needed and self._evict_one():
+            evicted += 1
+        return evicted
+
+    def clear(self) -> None:
+        while self._evict_one():
+            pass
+
+    def stats(self) -> dict:
+        return {
+            "prefix_cache_pages": len(self._map),
+            "prefix_hit_tokens": self.hit_tokens,
+            "prefix_lookup_tokens": self.lookup_tokens,
+        }
