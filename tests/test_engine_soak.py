@@ -8,6 +8,7 @@ initial value (no leaked pages through any of the admit / chunked-prefill /
 finish / cancel / requeue paths).
 """
 
+import dataclasses
 import queue
 import threading
 import time
@@ -38,8 +39,14 @@ N_CLIENTS = 40
 CANCEL_EVERY = 5
 
 
-def test_soak_no_leaks_no_stuck_slots():
-    eng = InferenceEngine(SOAK_CONFIG)
+@pytest.mark.parametrize("prefix_cache", [False, True])
+def test_soak_no_leaks_no_stuck_slots(prefix_cache):
+    # The cached variant soaks the refcount lifecycle too: "x"*n prompts
+    # share prefixes heavily, the tight pool forces allocation-pressure
+    # eviction, and cancellations churn slot-held references.
+    eng = InferenceEngine(dataclasses.replace(
+        SOAK_CONFIG, prefix_cache=prefix_cache, prefix_cache_pages=8
+    ))
     rng = np.random.default_rng(11)
     initial_free = eng.allocator.num_free
     results = {"done": 0, "error": 0, "cancelled": 0, "lost": 0}
@@ -111,8 +118,9 @@ def test_soak_no_leaks_no_stuck_slots():
         assert not eng.busy
         assert all(s is None for s in eng._slots)
 
-        # Every page came back.
-        assert eng.allocator.num_free == initial_free
+        # Every page is either back or held (accounted) by the cache.
+        held = len(eng._prefix) if eng._prefix is not None else 0
+        assert eng.allocator.num_free == initial_free - held
 
         snap = eng.metrics.snapshot()
         assert snap["requests_admitted"] == N_CLIENTS
