@@ -57,8 +57,9 @@ class EngineConfig:
     # Automatic prefix caching (engine/prefix_cache.py): requests sharing a
     # page-aligned prompt prefix reuse its KV pages and prefill only the
     # suffix. prefix_cache_pages caps the cache's own page references
-    # (LRU); 0 → num_pages // 2. Incompatible with speculative decoding
-    # (the draft pool's pages are not keyed).
+    # (LRU); 0 → num_pages // 2. Composes with speculative decoding: the
+    # draft pool shares page indices and spec prefill writes BOTH pools
+    # for every window, so a cached page carries both models' prefix KV.
     prefix_cache: bool = False
     prefix_cache_pages: int = 0
 
@@ -167,11 +168,6 @@ class EngineConfig:
             raise ValueError("need at least one prefill bucket")
         if self.draft_model is not None and self.spec_gamma < 1:
             raise ValueError("spec_gamma must be >= 1")
-        if self.prefix_cache and self.draft_model is not None:
-            raise ValueError(
-                "prefix_cache is incompatible with speculative decoding "
-                "(the draft pool's pages are not prefix-keyed)"
-            )
         if self.prefix_cache_pages < 0:
             raise ValueError(
                 "prefix_cache_pages must be >= 0 (0 → num_pages // 2); "

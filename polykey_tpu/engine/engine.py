@@ -662,6 +662,9 @@ class InferenceEngine:
             # Prefill only the suffix. A bucket-sized suffix rides the
             # batched bucket path at its own width (a hit must not cost
             # more than a miss); longer suffixes chunk from the offset.
+            # Spec engines take the single-row spec prefill (both pools)
+            # at the suffix bucket — cached pages already hold BOTH
+            # models' prefix KV (spec prefill writes target + draft).
             filled = len(matched) * cfg.page_size
             suffix = ids[filled:]
             suffix_bucket = self._bucket_for(len(suffix))
@@ -669,6 +672,11 @@ class InferenceEngine:
             if suffix_bucket is None:
                 slot.pending = ids
                 slot.filled = filled
+                return None
+            if self._spec:
+                self._dispatch_spec_prefill(
+                    slot_idx, slot, suffix, filled, suffix_bucket
+                )
                 return None
             return suffix_bucket, slot_idx, slot, suffix, filled
 
@@ -688,22 +696,30 @@ class InferenceEngine:
         self._slots[slot_idx] = slot
 
         if self._spec:
-            # Spec prefill is single-row; dispatch now.
-            try:
-                tokens = np.zeros((1, bucket), dtype=np.int32)
-                tokens[0, :prompt_len] = prompt_ids
-                slot.token_dev = self._run_prefill(
-                    tokens, 0, prompt_len - 1, page_table, request
-                )
-            except Exception:
-                # On any dispatch failure the slot must not linger as a
-                # permanently-inactive reservation.
-                self._slots[slot_idx] = None
-                self.allocator.release_all(pages)
-                raise
+            self._dispatch_spec_prefill(slot_idx, slot, ids, 0, bucket)
             return None
 
         return bucket, slot_idx, slot, ids, 0
+
+    def _dispatch_spec_prefill(
+        self, slot_idx: int, slot: _Slot, window_ids: np.ndarray,
+        start: int, bucket: int,
+    ) -> None:
+        """Single-row spec prefill dispatch (both pools) for the window
+        `window_ids` at absolute offset `start` — the whole prompt for
+        cache misses, the suffix for prefix-cache hits."""
+        try:
+            tokens = np.zeros((1, bucket), dtype=np.int32)
+            tokens[0, : len(window_ids)] = window_ids
+            slot.token_dev = self._run_prefill(
+                tokens, start, len(window_ids) - 1, slot.table, slot.request
+            )
+        except Exception:
+            # On any dispatch failure the slot must not linger as a
+            # permanently-inactive reservation.
+            self._slots[slot_idx] = None
+            self.allocator.release_all(slot.pages)
+            raise
 
     def _dispatch_prefill_group(self, bucket: int, group: list) -> None:
         """One batched prefill dispatch for up to _MAX_PREFILL_GROUP

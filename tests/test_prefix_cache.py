@@ -12,7 +12,6 @@ import queue
 import time
 
 import numpy as np
-import pytest
 
 from polykey_tpu.engine.config import EngineConfig
 from polykey_tpu.engine.engine import GenRequest, InferenceEngine
@@ -176,6 +175,35 @@ def test_eviction_under_pool_pressure_serves_everything():
     assert all(len(t) >= 1 for t in outs)
 
 
-def test_spec_and_prefix_cache_rejected():
-    with pytest.raises(ValueError, match="incompatible"):
-        dataclasses.replace(CFG, draft_model="tiny-llama").validate()
+def test_spec_engine_with_prefix_cache_matches_uncached():
+    """Spec + prefix cache compose: spec prefill writes BOTH pools for
+    every window, so cached pages carry target and draft prefix KV; a
+    cached spec engine must reproduce the uncached spec engine's greedy
+    streams (which themselves equal the plain engine's — test_engine_spec)."""
+    spec_cfg = dataclasses.replace(
+        CFG, draft_model="tiny-llama", spec_gamma=3, prefix_cache=False
+    )
+    header = "spec shared header for cache composition. "
+    prompts = [header + t for t in ("one", "two", "three and longer")]
+    ref, _ = _serve(spec_cfg, prompts)
+    out, stats = _serve(
+        dataclasses.replace(spec_cfg, prefix_cache=True), prompts
+    )
+    assert out == ref
+    assert stats["prefix_hit_tokens"] > 0
+
+
+def test_spec_prefix_hit_long_suffix_chunks():
+    """A cache hit whose suffix exceeds the largest bucket chunk-prefills
+    from the offset through the spec path."""
+    spec_cfg = dataclasses.replace(
+        CFG, draft_model="tiny-llama", spec_gamma=3, prefix_cache=True,
+        max_seq_len=256, num_pages=256,
+    )
+    header = "h" * 24
+    prompts = [header + "first tail", header + "x" * 60]
+    ref, _ = _serve(
+        dataclasses.replace(spec_cfg, prefix_cache=False), prompts
+    )
+    out, _ = _serve(spec_cfg, prompts)
+    assert out == ref
