@@ -274,6 +274,53 @@ def main() -> None:
         log(f"phase A failed: {e}")
         result["engine_1b"] = {"model": model_a, "error": str(e)}
 
+    # --- Phase A2: prefix-cache TTFT — requests sharing a long prefix
+    # prefill only their suffix; p50 TTFT of the cached requests is the
+    # feature's measurable win. ---
+    try:
+        log("--- phase A2: prefix-cache TTFT ---")
+        import dataclasses as _dc
+
+        from polykey_tpu.engine.engine import GenRequest, InferenceEngine
+
+        import numpy as _np
+
+        # A small bucket matters: warm requests prefill only their short
+        # suffix, and bucketing it to the full prompt width would erase
+        # the very win this phase measures.
+        cfg_a2 = _dc.replace(
+            cfg_a, prefix_cache=True,
+            prefill_buckets=tuple(sorted({32, *cfg_a.prefill_buckets})),
+        )
+        _r = _np.random.default_rng(13)
+        header = "".join(chr(c) for c in _r.integers(97, 123, prompt_len - 8))
+        engine2 = InferenceEngine(cfg_a2)
+        try:
+            ttfts = []
+            for i in range(9):
+                r = GenRequest(
+                    prompt=header + f" tail{i}", max_new_tokens=16
+                )
+                engine2.submit(r)
+                kind, value = r.out.get(timeout=600.0)
+                while kind == "token":
+                    kind, value = r.out.get(timeout=600.0)
+                if kind != "done":
+                    raise RuntimeError(f"request failed: {value}")
+                ttfts.append(r.timings.ttft_ms)
+            result["prefix_cache"] = {
+                "cold_ttft_ms": round(ttfts[0], 1),
+                "p50_warm_ttft_ms": round(statistics.median(ttfts[1:]), 1),
+                **{k: v for k, v in engine2.stats().items()
+                   if k.startswith("prefix_")},
+            }
+            log(f"prefix cache: {result['prefix_cache']}")
+        finally:
+            engine2.shutdown()
+    except Exception as e:
+        log(f"phase A2 failed: {e}")
+        result["prefix_cache"] = {"error": str(e)}
+
     # --- Phase B: 8B-int8 — the config the 2,000 tok/s target names. ---
     phase_b = None
     if on_tpu and os.environ.get("POLYKEY_BENCH_SKIP_8B", "") != "1":
