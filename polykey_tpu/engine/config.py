@@ -86,10 +86,16 @@ class EngineConfig:
     # KV writes exchanged into the sp-replicated page pools by GSPMD —
     # the serving-path long-context story (SURVEY §5). Decode is
     # unaffected (T=1). Buckets and prefill_chunk must divide by sp.
+    # pp shards the stacked-layer axis (memory distribution: a model
+    # larger than one chip's HBM serves across pp stages; decode
+    # activations hop stages via compiler-inserted transfers — capacity,
+    # not throughput; the GPipe schedule in parallel/pipeline.py is the
+    # training-side formulation).
     tp: int = 1
     dp: int = 1
     ep: int = 1
     sp: int = 1
+    pp: int = 1
 
     # Speculative decoding (engine/spec_decode.py): a draft model name turns
     # it on; gamma = drafts per verify round. Draft must share the target's
@@ -142,6 +148,7 @@ class EngineConfig:
             dp=_env_int("POLYKEY_DP", cls.dp),
             ep=_env_int("POLYKEY_EP", cls.ep),
             sp=_env_int("POLYKEY_SP", cls.sp),
+            pp=_env_int("POLYKEY_PP", cls.pp),
             draft_model=os.environ.get("POLYKEY_DRAFT_MODEL") or None,
             draft_checkpoint_path=os.environ.get("POLYKEY_DRAFT_CHECKPOINT")
             or None,
@@ -177,7 +184,7 @@ class EngineConfig:
             raise ValueError("prefill_chunk must be >= 0 (0 → max bucket)")
         if self.decode_block_steps < 1:
             raise ValueError("decode_block_steps must be >= 1")
-        for name in ("tp", "dp", "ep", "sp"):
+        for name in ("tp", "dp", "ep", "sp", "pp"):
             if getattr(self, name) < 1:
                 raise ValueError(f"{name} must be >= 1")
         if self.sp > 1:

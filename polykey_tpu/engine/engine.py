@@ -208,13 +208,15 @@ class InferenceEngine:
         # measurement config 4). tp=dp=ep=1 degenerates to a single-device
         # mesh with identical code paths (specs over size-1 axes are
         # no-ops, so there is no unsharded special case to keep in sync).
-        n_devices = config.tp * config.dp * config.ep * config.sp
+        n_devices = (
+            config.tp * config.dp * config.ep * config.sp * config.pp
+        )
         devices = jax.devices()
         if n_devices > len(devices):
             raise ValueError(
                 f"tp={config.tp} x dp={config.dp} x ep={config.ep} x "
-                f"sp={config.sp} needs {n_devices} devices, "
-                f"have {len(devices)}"
+                f"sp={config.sp} x pp={config.pp} needs {n_devices} "
+                f"devices, have {len(devices)}"
             )
         if self.model_cfg.num_kv_heads % config.tp != 0:
             raise ValueError(
@@ -237,9 +239,15 @@ class InferenceEngine:
                     f"ep={config.ep} must divide num_experts="
                     f"{self.model_cfg.num_experts}"
                 )
+        if self.model_cfg.num_layers % config.pp != 0:
+            raise ValueError(
+                f"pp={config.pp} must divide num_layers="
+                f"{self.model_cfg.num_layers}"
+            )
         self.mesh = create_mesh(
             MeshConfig(
-                dp=config.dp, sp=config.sp, ep=config.ep, tp=config.tp
+                dp=config.dp, pp=config.pp, sp=config.sp, ep=config.ep,
+                tp=config.tp,
             ),
             devices=devices[:n_devices],
         )
@@ -327,6 +335,12 @@ class InferenceEngine:
                 raise ValueError(
                     f"tp={config.tp} must divide draft num_kv_heads="
                     f"{self.draft_cfg.num_kv_heads}"
+                )
+            if self.draft_cfg.num_layers % config.pp != 0:
+                raise ValueError(
+                    f"pp={config.pp} must divide draft num_layers="
+                    f"{self.draft_cfg.num_layers} (the draft's params and "
+                    f"page pool shard the same pp axis)"
                 )
             if config.draft_checkpoint_path:
                 from ..models.loader import load_checkpoint

@@ -144,6 +144,16 @@ def test_ep2_tp2_moe_matches_single_device(moe_reference_outputs):
 
 
 @_needs(2)
+def test_pp2_matches_single_device(reference_outputs):
+    """Layer-sharded serving: params and both page pools shard their
+    stacked-layer axis over pp (capacity for models beyond one chip's
+    HBM); greedy output must match exactly."""
+    assert _run_prompts(
+        dataclasses.replace(BASE_CONFIG, pp=2)
+    ) == reference_outputs
+
+
+@_needs(2)
 def test_sp2_matches_single_device(reference_outputs):
     """Sequence-parallel prefill: the window's token axis shards over sp
     (compute spread + GSPMD KV exchange into the sp-replicated pools);
