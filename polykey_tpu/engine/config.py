@@ -9,7 +9,7 @@ axes. Env vars use the same POLYKEY_* prefix.
 from __future__ import annotations
 
 import os
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
 

@@ -30,8 +30,6 @@ as absent); the design follows the north star + PAPERS.md patterns.
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 

@@ -1,6 +1,5 @@
 """Hybrid DCN-mesh + bootstrap tests (simulated slices on the CPU mesh)."""
 
-import os
 
 import jax
 import jax.numpy as jnp
