@@ -78,6 +78,15 @@ class EngineConfig:
     # block boundaries. 1 → token-at-a-time (lowest streaming latency).
     decode_block_steps: int = 8
 
+    # In-flight decode blocks (pipeline depth): the engine keeps up to
+    # `lookahead_blocks` dispatched-but-unprocessed blocks on the device
+    # queue, so host-side processing and D2H latency hide behind device
+    # compute. Device-side stopping + per-block request snapshots make
+    # stale blocks safe (engine.py _run); the cost is up to
+    # lookahead_blocks x decode_block_steps wasted device steps when a
+    # stream finishes. 1 → classic dispatch-then-process.
+    lookahead_blocks: int = 2
+
     # Parallelism axes (parallel/mesh.py); 1 → axis unused. ep shards MoE
     # expert weights and rides token dispatch over the ep axis (Mixtral —
     # BASELINE.md measurement config 4); it requires an MoE model. sp
@@ -144,6 +153,9 @@ class EngineConfig:
             decode_block_steps=_env_int(
                 "POLYKEY_DECODE_BLOCK", cls.decode_block_steps
             ),
+            lookahead_blocks=_env_int(
+                "POLYKEY_LOOKAHEAD", cls.lookahead_blocks
+            ),
             tp=_env_int("POLYKEY_TP", cls.tp),
             dp=_env_int("POLYKEY_DP", cls.dp),
             ep=_env_int("POLYKEY_EP", cls.ep),
@@ -184,6 +196,8 @@ class EngineConfig:
             raise ValueError("prefill_chunk must be >= 0 (0 → max bucket)")
         if self.decode_block_steps < 1:
             raise ValueError("decode_block_steps must be >= 1")
+        if self.lookahead_blocks < 1:
+            raise ValueError("lookahead_blocks must be >= 1")
         for name in ("tp", "dp", "ep", "sp", "pp"):
             if getattr(self, name) < 1:
                 raise ValueError(f"{name} must be >= 1")

@@ -84,10 +84,13 @@ class _Slot:
     # position-0 KV between prefill chunks.
     table: Optional[np.ndarray] = None
     # Async prefill: the dispatched-but-unread sampled token (a device
-    # scalar) and the prompt length, resolved by _resolve_prefills AFTER the
-    # next decode block is dispatched — admission never blocks the loop on
-    # a device→host sync.
+    # array, slot's row at `token_row`) — the lane was already activated
+    # on device by the merge dispatch; this handle exists only so the host
+    # can emit the first token to the client once the async D2H copy
+    # lands (_resolve_prefills). The host never blocks the loop on it.
     token_dev: Optional[jax.Array] = None
+    token_row: int = 0
+    merged: bool = False       # device lane activated (merge dispatched)
     prompt_len: int = 0
     prompt_ids: Optional[np.ndarray] = None  # for prefix-cache insertion
 
@@ -141,7 +144,9 @@ def _decode_fn(
     Blocking the decode this way amortizes per-dispatch host overhead
     (Python + transfer latency; dominant when the chip sits behind a
     network tunnel) over `steps` tokens. The host uploads nothing per block
-    and downloads only the [steps, B] tokens + masks.
+    and downloads ONE packed [steps, B] int32 array (token id where the
+    sub-step emitted for that lane, -1 where it did not) — a single D2H
+    transfer per block instead of separate token/mask reads.
 
     `greedy` (static) selects the argmax-only tail when every active slot
     is greedy, skipping sample_dynamic's [B, vocab] sort entirely.
@@ -158,13 +163,60 @@ def _decode_fn(
         tokens = jnp.where(act, tokens, 0)
         new_seq = seq + act.astype(jnp.int32)
         cont = act & (tokens != eos_id) & (new_seq < caps)
-        return (tokens, new_seq, cont, new_key, paged), (tokens, act)
+        packed = jnp.where(act, tokens, -1)
+        return (tokens, new_seq, cont, new_key, paged), packed
 
     carry = (last_tokens, seq_lens, active, key, paged)
-    (last, seq, act, key, paged), (toks, emit) = jax.lax.scan(
+    (last, seq, act, key, paged), packed = jax.lax.scan(
         one, carry, None, length=steps
     )
-    return toks, emit, last, seq, act, key, paged
+    return packed, last, seq, act, key, paged
+
+
+def _merge_lane_fn(
+    last_tokens, seq_lens, page_tables, active, caps, temperature, top_p,
+    tokens_vec, row, slot, seq_len, cap, temp, tp, table_row,
+    *, eos_id: int,
+):
+    """Activate ONE decode lane entirely on device: splice the prefill's
+    sampled token (still a device array — no host sync) and the slot's
+    geometry into the device-resident decode state. Dispatched right after
+    the prefill that produced `tokens_vec`, so the lane joins the next
+    decode block without the host ever waiting on the device — the
+    mechanism that lets admissions ride the lookahead pipeline instead of
+    flushing it.
+
+    The lane is born live only if its first token isn't EOS and the
+    position budget allows generation (the same conditions the host's
+    _maybe_finish applies when it later emits the first token)."""
+    token = tokens_vec.reshape(-1)[row]   # [N] groups or scalar (spec)
+    live = (token != eos_id) & (seq_len < cap)
+    return (
+        last_tokens.at[slot].set(token),
+        seq_lens.at[slot].set(seq_len),
+        page_tables.at[slot].set(table_row),
+        active.at[slot].set(live),
+        caps.at[slot].set(cap),
+        temperature.at[slot].set(temp),
+        top_p.at[slot].set(tp),
+    )
+
+
+def _retire_lane_fn(last_tokens, seq_lens, page_tables, active, caps, slot):
+    """Deactivate ONE lane on device and point its page table at the
+    reserved garbage page. Dispatched when the host retires a slot
+    (EOS/cap/cancel): the lane's pages go back to the allocator, so later
+    blocks must stop writing through the stale table — in-flight blocks
+    dispatched before this merge still carry it, which is safe because
+    their writes are ordered (pool chaining) before any reuse of the pages
+    and masked by absolute position until overwritten."""
+    return (
+        last_tokens.at[slot].set(0),
+        seq_lens.at[slot].set(0),
+        page_tables.at[slot].set(jnp.zeros_like(page_tables[0])),
+        active.at[slot].set(False),
+        caps.at[slot].set(0),
+    )
 
 
 def _sample_tail(logits, key, temperature, top_p, greedy: bool):
@@ -273,9 +325,24 @@ class InferenceEngine:
             _decode_fn, static_argnames=("cfg", "greedy", "steps", "eos_id"),
             donate_argnames=("paged",),
             out_shardings=(
-                self._dp_steps, self._dp_steps, self._dp_vec, self._dp_vec,
+                self._dp_steps, self._dp_vec, self._dp_vec,
                 self._dp_vec, self._repl, self._pool_sharding,
             ),
+        )
+        # Lane merges: tiny functional updates of the device-resident decode
+        # state, chained between blocks so slot transitions never flush the
+        # lookahead pipeline (out shardings must match the decode inputs so
+        # the chain keeps stable layouts).
+        lane_out = (
+            self._dp_vec, self._dp_vec, self._dp_mat, self._dp_vec,
+            self._dp_vec, self._dp_vec, self._dp_vec,
+        )
+        self._jit_merge = jax.jit(
+            _merge_lane_fn, static_argnames=("eos_id",),
+            out_shardings=lane_out,
+        )
+        self._jit_retire = jax.jit(
+            _retire_lane_fn, out_shardings=lane_out[:5],
         )
 
         if params is None:
@@ -381,7 +448,7 @@ class InferenceEngine:
                 donate_argnames=("t_paged", "d_paged"),
                 out_shardings=(
                     self._dp_mat, self._dp_vec, self._dp_vec, self._dp_vec,
-                    self._dp_vec, self._repl,
+                    self._repl,
                     self._pool_sharding, self._pool_sharding,
                 ),
             )
@@ -409,8 +476,12 @@ class InferenceEngine:
             jax.random.PRNGKey(seed + 1), self._repl
         )
         self._submit: queue.Queue[GenRequest] = queue.Queue()
-        self._inflight = None  # lookahead: the unprocessed dispatched block
-        self._pending_groups: list = []  # batched prefills awaiting resolve
+        # Lookahead pipeline: dispatched-but-unprocessed decode blocks,
+        # oldest first. Kept at ≤ lookahead_blocks deep while dispatching.
+        from collections import deque
+
+        self._inflight_q: deque = deque()
+        self._depth = config.lookahead_blocks
         if config.compile_warmup and not self._spec:
             self._compile_warmup()
         self._wake = threading.Event()
@@ -481,53 +552,43 @@ class InferenceEngine:
                 # step so running streams stall for ≤ one prefill bucket;
                 # long prompts advance one chunk per iteration for the same
                 # reason (chunked prefill — never more than one chunk of
-                # stall between decode steps). Prefills are DISPATCHED here
-                # and resolved only after the decode block is also in
-                # flight, so the host never sits in a device sync while the
-                # device has undispatched work.
+                # stall between decode steps). Admissions activate their
+                # lanes via on-device merges (no sync, no pipeline flush);
+                # the host only reads first tokens once their async copies
+                # land.
                 limit = 1 if self._active.any() else None
                 worked = self._admit(limit)
                 chunk_slot = self._chunk_pending_slot()
                 if chunk_slot is not None:
                     self._prefill_one_chunk(chunk_slot)
                     worked = True
-                # Cross-block lookahead: block k+1 is dispatched BEFORE
-                # block k's results are synced, so host processing + D2H
-                # hide behind device compute. Device-side stopping makes
-                # the stale active mask safe (a stream the host finished
-                # was stopped on device by the same EOS/cap condition, so
-                # its lookahead emit lanes are False); cancellations are
-                # the one host-only transition, guarded per-block by the
-                # request-identity snapshot in _process_step. Transitions
-                # (dirty mirrors) drain the in-flight block first so a
-                # re-upload can never rewind live device state.
-                if self._inflight is not None and (
-                    self._dev_dirty or self._inflight[1][0].is_ready()
-                ):
-                    # Drain early when mirrors must catch up (dirty) or the
-                    # block already finished on device (is_ready — the
-                    # batch-drain case, where dispatching ahead of a stale
-                    # ALL-idle mirror would waste a full dead block and
-                    # delay the next admission behind it).
-                    self._process_step(self._inflight)
-                    self._inflight = None
-                block = (
-                    self._dispatch_step() if self._active.any() else None
-                )
+                if self._dev_dirty and self._inflight_q:
+                    # Rare full transition (init/recovery): a mirror upload
+                    # may never rewind live device state, so the whole
+                    # pipeline drains first.
+                    self._drain_inflight()
+                # Lookahead pipeline: keep up to `_depth` blocks in flight.
+                # Device-side stopping makes stale blocks safe (a stream the
+                # host finished was stopped on device by the same EOS/cap
+                # condition, so its lookahead emit lanes read -1);
+                # cancellations are the one host-only transition, guarded
+                # per-block by the request-identity snapshot in
+                # _process_step. Spec rounds carry the same device-side
+                # stop, so both block kinds pipeline alike.
+                dispatched = False
+                if self._active.any():
+                    self._inflight_q.append(self._dispatch_step())
+                    dispatched = True
+                    worked = True
                 self._resolve_prefills()
-                if self._inflight is not None:
-                    self._process_step(self._inflight)
+                target = self._depth if dispatched else 0
+                while len(self._inflight_q) > target:
+                    self._process_step(self._inflight_q.popleft())
                     worked = True
-                    self._inflight = None
-                if block is not None:
-                    worked = True
-                    # Spec rounds carry the same device-side EOS/cap stop
-                    # as plain blocks (spec_decode_fn new_active), so both
-                    # are safe to hold across the lookahead boundary.
-                    self._inflight = block
                 if worked:
                     self.last_progress = time.monotonic()
                 else:
+                    self._resolve_prefills(block=True)
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
                     # Idle time is not a stall: only the engine thread itself
@@ -725,9 +786,10 @@ class InferenceEngine:
         try:
             tokens = np.zeros((1, bucket), dtype=np.int32)
             tokens[0, : len(window_ids)] = window_ids
-            slot.token_dev = self._run_prefill(
+            token_dev = self._run_prefill(
                 tokens, start, len(window_ids) - 1, slot.table, slot.request
             )
+            self._merge_slot(slot_idx, slot, token_dev, 0)
         except Exception:
             # On any dispatch failure the slot must not linger as a
             # permanently-inactive reservation.
@@ -777,9 +839,8 @@ class InferenceEngine:
                 if self._slots[slot_idx] is slot:
                     self._finish(slot_idx, error=f"prefill failed: {e}")
             return
-        self._pending_groups.append(
-            (toks_dev, [(slot_idx, slot) for slot_idx, slot, _, _ in group])
-        )
+        for r, (slot_idx, slot, _, _) in enumerate(group):
+            self._merge_slot(slot_idx, slot, toks_dev, r)
 
     def _compile_warmup(self) -> None:
         """Pre-compile the greedy prefill group shapes and the greedy decode
@@ -818,6 +879,21 @@ class InferenceEngine:
             eos_id=self.tokenizer.eos_id,
         )
         *_, self._key_dev, self.paged = outs
+        # Lane merge/retire variants (tiny, but first-admission compile
+        # latency would land on first-request TTFT): one per group width.
+        zrow = np.zeros((cfg.pages_per_seq,), np.int32)
+        for n in pads:
+            self._jit_merge(
+                dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
+                dev["active"], dev["caps"], dev["temperature"], dev["top_p"],
+                np.zeros((n,), np.int32), np.int32(0), np.int32(0),
+                np.int32(1), np.int32(2), np.float32(0.0), np.float32(1.0),
+                zrow, eos_id=self.tokenizer.eos_id,
+            )
+        self._jit_retire(
+            dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
+            dev["active"], dev["caps"], np.int32(0),
+        )
         jax.block_until_ready(self.paged)
         # The dirty flag forces a fresh upload once real slots exist.
         self._dev_dirty = True
@@ -865,67 +941,95 @@ class InferenceEngine:
                 )
             return first_token
 
-    def _resolve_prefills(self) -> None:
-        """Read the sampled tokens of dispatched prefills (batched groups
-        and single chunk-final/spec rows) and activate their slots. Called
-        after the decode block is dispatched, so the device works through
-        prefill + block while the host blocks here only for work already
-        in flight."""
-        groups, self._pending_groups = self._pending_groups, []
-        for toks_dev, members in groups:
-            try:
-                toks = np.asarray(toks_dev)
-            except Exception as e:
-                for slot_idx, slot in members:
-                    if self._slots[slot_idx] is slot:
-                        self._finish(slot_idx, error=f"prefill failed: {e}")
-                continue
-            for r, (slot_idx, slot) in enumerate(members):
-                if self._slots[slot_idx] is not slot:
-                    continue    # finished (shutdown/cancel) meanwhile
-                self._activate_slot(
-                    slot_idx, slot, slot.prompt_len, int(toks[r])
-                )
-        for i, slot in enumerate(self._slots):
-            if slot is None or slot.token_dev is None:
-                continue
-            try:
-                token = int(np.asarray(slot.token_dev).reshape(-1)[0])
-            except Exception as e:
-                slot.token_dev = None
-                self._finish(i, error=f"prefill failed: {e}")
-                continue
-            slot.token_dev = None
-            self._activate_slot(i, slot, slot.prompt_len, token)
-
-    def _activate_slot(
-        self, slot_idx: int, slot: _Slot, prompt_len: int, first_token: int
+    def _merge_slot(
+        self, slot_idx: int, slot: _Slot, toks_dev: jax.Array, row: int
     ) -> None:
-        """Move a fully-prefilled slot into the decode batch."""
+        """Activate a prefilled slot's decode lane ON DEVICE: the merge
+        dispatch splices the sampled token (still a device array) and the
+        slot's geometry into the device-resident state, so the lane joins
+        the next decode block with zero host↔device syncs and no pipeline
+        flush. The host keeps a handle to the token purely for client
+        delivery (_resolve_prefills)."""
         request = slot.request
-        slot.generated = 1
+        if self._dev_dirty:
+            # Cold start / post-recovery: fold mirrors in before merging.
+            self._drain_inflight()
+            self._upload_slot_state()
+        dev = self._dev
+        try:
+            (
+                dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
+                dev["active"], dev["caps"], dev["temperature"], dev["top_p"],
+            ) = self._jit_merge(
+                dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
+                dev["active"], dev["caps"], dev["temperature"], dev["top_p"],
+                toks_dev, np.int32(row), np.int32(slot_idx),
+                np.int32(slot.prompt_len + 1), np.int32(slot.position_cap),
+                np.float32(request.temperature), np.float32(request.top_p),
+                slot.table[0],
+                eos_id=self.tokenizer.eos_id,
+            )
+        except Exception as e:
+            self._finish(slot_idx, error=f"activation failed: {e}")
+            return
+        try:
+            toks_dev.copy_to_host_async()
+        except Exception:
+            pass  # harmless: np.asarray at resolve time starts the copy
+        slot.merged = True
         slot.pending = None
-        if slot.table is not None:
-            # The table enters the device mirrors only now that the lane is
-            # active (inactive lanes write through their mirror table — see
-            # _Slot.table).
-            self._page_tables[slot_idx] = slot.table[0]
-            slot.table = None
-        if self._prefix is not None and slot.prompt_ids is not None:
-            # The prompt's KV is fully written (activation follows the
-            # prefill's device sync) — publish its page-aligned pages.
-            self._prefix.insert(slot.prompt_ids, slot.pages)
-        self._seq_lens[slot_idx] = prompt_len + 1  # prompt + sampled token
-        self._last_tokens[slot_idx] = first_token
+        slot.token_dev = toks_dev
+        slot.token_row = row
+        # Host mirrors (flush-upload source of truth; _last_tokens follows
+        # at resolve time, and any flush first drains + resolves).
+        self._page_tables[slot_idx] = slot.table[0]
+        slot.table = None
+        self._seq_lens[slot_idx] = slot.prompt_len + 1
         self._active[slot_idx] = True
         self._caps[slot_idx] = slot.position_cap
         self._temperature[slot_idx] = request.temperature
         self._top_p[slot_idx] = request.top_p
-        self._dev_dirty = True
 
+    def _resolve_prefills(self, block: bool = False) -> None:
+        """Deliver first tokens whose async D2H copies have landed (all of
+        them when `block=True`). Activation already happened at merge time;
+        this is purely client-facing delivery + host bookkeeping."""
+        for i, slot in enumerate(self._slots):
+            if slot is None or slot.token_dev is None:
+                continue
+            if block or slot.token_dev.is_ready():
+                self._resolve_slot(i, slot)
+
+    def _resolve_slot(self, slot_idx: int, slot: _Slot) -> None:
+        try:
+            token = int(np.asarray(slot.token_dev).reshape(-1)[slot.token_row])
+        except Exception as e:
+            slot.token_dev = None
+            self._finish(slot_idx, error=f"prefill failed: {e}")
+            return
+        slot.token_dev = None
+        slot.generated = 1
+        request = slot.request
+        if self._prefix is not None and slot.prompt_ids is not None:
+            # Publish the prompt's page-aligned pages only now: the token
+            # read above proves the prefill computation succeeded, so the
+            # cached pages hold real KV (an async prefill failure above
+            # would otherwise poison the cache with unwritten pages). Any
+            # consumer's own prefill dispatches after this point, so
+            # device-order still guarantees the pages are written first.
+            self._prefix.insert(slot.prompt_ids, slot.pages)
+        self._last_tokens[slot_idx] = token
         request.timings.first_token = time.monotonic()
-        request.out.put(("token", first_token))
-        self._maybe_finish(slot_idx, first_token)
+        request.out.put(("token", token))
+        self._maybe_finish(slot_idx, token)
+
+    def _drain_inflight(self) -> None:
+        """Process every in-flight block and deliver every pending first
+        token — the full pipeline flush that must precede any mirror
+        upload (rare: cold start and failure recovery)."""
+        while self._inflight_q:
+            self._process_step(self._inflight_q.popleft())
+        self._resolve_prefills(block=True)
 
     def _chunk_pending_slot(self) -> Optional[int]:
         for i, s in enumerate(self._slots):
@@ -956,11 +1060,11 @@ class InferenceEngine:
             self._finish(slot_idx, error=f"prefill failed: {e}")
             return
         if final:
-            # Leave chunking state; _resolve_prefills reads the token and
-            # activates after the next decode block is dispatched. Non-final
-            # chunks never sync at all — the device token is discarded.
-            slot.pending = None
-            slot.token_dev = token_dev
+            # The final chunk's sampled token activates the lane (on-device
+            # merge; the host delivers it to the client once its async copy
+            # lands). Non-final chunks never sync at all — the device token
+            # is discarded.
+            self._merge_slot(slot_idx, slot, token_dev, 0)
         else:
             slot.filled += take
 
@@ -982,6 +1086,11 @@ class InferenceEngine:
         process the engine resolves pending prefills, overlapping their
         device time with the block's."""
         if self._dev_dirty:
+            # Rare (init / retire-failure recovery): mirrors must be
+            # complete before they become the device state — deliver any
+            # pending first tokens so _last_tokens is exact (the loop has
+            # already drained in-flight blocks).
+            self._resolve_prefills(block=True)
             self._upload_slot_state()
         dev = self._dev
         # top_p truncation breaks the rejection-sampling identity, so a
@@ -1002,7 +1111,7 @@ class InferenceEngine:
         # compiled variants exist; the mix flips only at slot transitions.
         greedy = bool(np.all(self._temperature[self._active] == 0.0))
         with jax.profiler.TraceAnnotation("polykey/decode"):
-            (toks_dev, emit_dev, last_dev, seq_dev, act_dev, self._key_dev,
+            (packed_dev, last_dev, seq_dev, act_dev, self._key_dev,
              self.paged) = self._jit_decode(
                 self.params,
                 self.model_cfg,
@@ -1024,7 +1133,14 @@ class InferenceEngine:
             dev["last_tokens"] = last_dev
             dev["seq_lens"] = seq_dev
             dev["active"] = act_dev
-        return ("plain", (toks_dev, emit_dev), self._snapshot_requests())
+        try:
+            # Ship the block's packed tokens host-ward as soon as the
+            # device finishes them; by processing time (lookahead_blocks
+            # later) the read is then local.
+            packed_dev.copy_to_host_async()
+        except Exception:
+            pass
+        return ("plain", packed_dev, self._snapshot_requests())
 
     def _snapshot_requests(self):
         """Per-slot request identities at dispatch time: with cross-block
@@ -1036,14 +1152,20 @@ class InferenceEngine:
     def _process_step(self, block) -> None:
         """Sync a dispatched block's results and emit/finish on the host.
         Slots activated between dispatch and process were not in the block:
-        their device emit masks are False, so the loop skips them."""
+        their device lanes were inactive, so their columns read -1."""
         kind, data, reqs = block
+        if not any(
+            s is not None and s.request is reqs[i]
+            for i, s in enumerate(self._slots)
+        ):
+            # Dead block: every dispatch-time occupant is gone (batch
+            # drained / all cancelled). Nothing to emit — skip the sync
+            # entirely so the drain costs no host↔device roundtrip.
+            return
         if kind == "spec":
             self._process_spec(data, reqs)
             return
-        toks_dev, emit_dev = data
-        toks = np.asarray(toks_dev)   # [K, B]; blocks until block done
-        emit = np.asarray(emit_dev)   # [K, B] live-mask per sub-step
+        packed = np.asarray(data)     # [K, B]; blocks until block done
 
         emitted = 0
         for i, slot in enumerate(self._slots):
@@ -1052,10 +1174,16 @@ class InferenceEngine:
             if slot.request.cancelled.is_set():
                 self._finish(i, error="cancelled")
                 continue
+            if slot.token_dev is not None:
+                # First token precedes block tokens in the client stream
+                # (its copy landed with the prefill, before this block).
+                self._resolve_slot(i, slot)
+                if self._slots[i] is not slot:
+                    continue
             for k in range(self._block_steps):
-                if not emit[k, i]:
+                token = int(packed[k, i])
+                if token < 0:
                     break
-                token = int(toks[k, i])
                 slot.generated += 1
                 self._seq_lens[i] += 1
                 self._last_tokens[i] = token
@@ -1069,7 +1197,7 @@ class InferenceEngine:
     def _dispatch_spec(self, dev: dict, key):
         """Dispatch one draft/verify round (spec_decode.py)."""
         with jax.profiler.TraceAnnotation("polykey/spec_decode"):
-            (emit_dev, n_out_dev, new_last, new_seq, new_active, stats_dev,
+            (packed_dev, new_last, new_seq, new_active, stats_dev,
              self.paged, self.d_paged) = self._jit_spec_decode(
                 self.params, self.draft_params,
                 self.model_cfg, self.draft_cfg,
@@ -1082,15 +1210,20 @@ class InferenceEngine:
             dev["last_tokens"] = new_last
             dev["seq_lens"] = new_seq
             dev["active"] = new_active
-        return emit_dev, n_out_dev, stats_dev
+        try:
+            packed_dev.copy_to_host_async()
+            stats_dev.copy_to_host_async()
+        except Exception:
+            pass
+        return packed_dev, stats_dev
 
     def _process_spec(self, data, reqs) -> None:
-        """Sync a spec round; emits the device-truncated n_out tokens per
-        slot. Acceptance stats come FROM the device (spec_decode_fn), which
-        owns truncation and the untruncated n_acc the dial needs."""
-        emit_dev, n_out_dev, stats_dev = data
-        emit = np.asarray(emit_dev)  # blocks until the round completes
-        n_out = np.asarray(n_out_dev)
+        """Sync a spec round; emits each row's packed prefix (-1 padded —
+        device-truncated). Acceptance stats come FROM the device
+        (spec_decode_fn), which owns truncation and the untruncated n_acc
+        the dial needs."""
+        packed_dev, stats_dev = data
+        packed = np.asarray(packed_dev)  # [B, gamma+1]; blocks until done
         accepted, proposed = (int(v) for v in np.asarray(stats_dev))
 
         emitted = 0
@@ -1100,8 +1233,14 @@ class InferenceEngine:
             if slot.request.cancelled.is_set():
                 self._finish(i, error="cancelled")
                 continue
-            for j in range(int(n_out[i])):
-                token = int(emit[i, j])
+            if slot.token_dev is not None:
+                self._resolve_slot(i, slot)
+                if self._slots[i] is not slot:
+                    continue
+            for j in range(packed.shape[1]):
+                token = int(packed[i, j])
+                if token < 0:
+                    break
                 slot.generated += 1
                 self._seq_lens[i] += 1
                 self._last_tokens[i] = token
@@ -1140,7 +1279,22 @@ class InferenceEngine:
         self._seq_lens[slot_idx] = 0
         self._last_tokens[slot_idx] = 0
         self._page_tables[slot_idx] = 0
-        self._dev_dirty = True
+        if slot.merged and self.dead is None and not self._stop.is_set():
+            # Retire the device lane (stop stale-table writes) without
+            # flushing the pipeline — a tiny chained dispatch, the mirror
+            # of _merge_slot. EOS/cap retirements already stopped on
+            # device; this also covers cancellations and failures.
+            dev = self._dev
+            try:
+                (
+                    dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
+                    dev["active"], dev["caps"],
+                ) = self._jit_retire(
+                    dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
+                    dev["active"], dev["caps"], np.int32(slot_idx),
+                )
+            except Exception:
+                self._dev_dirty = True   # fall back to a full re-upload
         if error is not None:
             request.out.put(("error", error))
             self.metrics.on_finish(request.timings, failed=True)
@@ -1157,8 +1311,7 @@ class InferenceEngine:
             pass
 
     def _fail_all(self, message: str) -> None:
-        self._inflight = None  # drop unprocessed lookahead results
-        self._pending_groups = []  # their slots are failed via _finish below
+        self._inflight_q.clear()  # drop unprocessed lookahead results
         for i, slot in enumerate(self._slots):
             if slot is not None:
                 self._finish(i, error=message)

@@ -67,8 +67,10 @@ def spec_decode_fn(
 ):
     """One draft/verify round for the whole slot batch.
 
-    Returns (emit [B, gamma+1], n_out [B], new_last [B], new_seq_lens [B],
-    new_active [B], t_paged, d_paged). Row semantics: `last_tokens` is
+    Returns (emit [B, gamma+1] packed — token id within each row's emitted
+    prefix, -1 beyond it, so ONE D2H transfer carries tokens and counts —
+    plus new_last [B], new_seq_lens [B], new_active [B], stats, t_paged,
+    d_paged). Row semantics: `last_tokens` is
     the already-emitted token at position seq_lens-1 whose KV is not yet
     written (the same invariant as the plain decode step); the round emits
     n_out = n_acc+1 tokens per active row. Greedy rows reproduce the
@@ -172,6 +174,7 @@ def spec_decode_fn(
         active & (n_out > 0), emit[rows, jnp.maximum(n_out - 1, 0)], last_tokens
     )
     new_active = active & ~has_eos & (new_seq_lens < caps)
+    packed = jnp.where(cols < n_out[:, None], emit, -1)   # [B, gamma+1]
 
     # Acceptance-dial stats, computed HERE because truncation happens here
     # (the host only sees truncated n_out): per ADVICE r1, a round cut
@@ -185,6 +188,6 @@ def spec_decode_fn(
     stats = jnp.stack([jnp.sum(acc_rows), jnp.sum(prop_rows)])
 
     return (
-        emit, n_out, new_last, new_seq_lens, new_active, stats,
+        packed, new_last, new_seq_lens, new_active, stats,
         t_paged, d_paged,
     )

@@ -1,0 +1,122 @@
+"""Microbenchmark the host<->device dispatch/transfer primitives.
+
+The serving engine's loop design depends on which operations pay the
+host<->device roundtrip (dominant when the chip sits behind a network
+tunnel): dispatch of a jitted call, device_put, np.asarray sync,
+is_ready polling, and async host copies. This prints a timing table so
+the engine's pipelining knobs (decode block size, lookahead depth) can
+be set from evidence.
+
+Run standalone (needs the TPU free): python scripts/probe_tunnel.py
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def t(label, fn, n=10, warmup=2):
+    for _ in range(warmup):
+        fn()
+    t0 = time.monotonic()
+    for _ in range(n):
+        fn()
+    dt = (time.monotonic() - t0) / n * 1000
+    print(f"{label:45s} {dt:8.2f} ms")
+    return dt
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"device: {dev.device_kind} ({dev.platform})")
+
+    @jax.jit
+    def step(x):
+        return x * 1.0001 + 1.0
+
+    x = jax.device_put(jnp.zeros((256, 256), jnp.float32))
+    step(x).block_until_ready()
+
+    # 1. dispatch WITHOUT sync (drop result, no read)
+    results = []
+
+    def dispatch_only():
+        results.append(step(x))
+
+    t("dispatch (no sync)", dispatch_only, n=20)
+    jax.block_until_ready(results)
+    results.clear()
+
+    # 2. dispatch + full sync
+    t("dispatch + block_until_ready", lambda: step(x).block_until_ready(), n=10)
+
+    # 3. device_put small
+    small = np.zeros((16,), np.int32)
+    t("device_put [16] (no sync)", lambda: jax.device_put(small), n=20)
+
+    # 4. device_put + sync
+    t("device_put [16] + sync",
+      lambda: jax.device_put(small).block_until_ready(), n=10)
+
+    # 5. np.asarray of an already-ready result
+    y = step(x)
+    y.block_until_ready()
+    t("np.asarray (ready result, 256KB)", lambda: np.asarray(y), n=10)
+
+    ys = jnp.zeros((16,), jnp.int32)
+    ys.block_until_ready()
+    t("np.asarray (ready result, [16])", lambda: np.asarray(ys), n=10)
+
+    # 6. is_ready on a ready result
+    t("is_ready (ready result)", lambda: y.is_ready(), n=20)
+
+    # 7. copy_to_host_async then read
+    def async_then_read():
+        r = step(x)
+        r.copy_to_host_async()
+        return np.asarray(r)
+
+    t("dispatch + copy_to_host_async + read", async_then_read, n=10)
+
+    # 8. chained dispatch depth: N chained steps, one sync at the end
+    for depth in (1, 2, 4, 8, 16):
+        def chained():
+            r = x
+            for _ in range(depth):
+                r = step(r)
+            return np.asarray(r[0, 0])
+
+        t(f"chain depth {depth:2d} + 1 sync", chained, n=5)
+
+    # 9. two separate np.asarray reads vs one packed read
+    a, b = step(x), step(x)
+    jax.block_until_ready((a, b))
+    t("two np.asarray reads (ready)", lambda: (np.asarray(a), np.asarray(b)),
+      n=10)
+
+    # 10. donation chain (mimics the engine's paged-pool chaining)
+    @jax.jit
+    def dstep(p, s):
+        return p + 1.0, s + 1
+
+    p = jax.device_put(jnp.zeros((1024, 1024), jnp.float32))
+    s = jax.device_put(jnp.zeros((16,), jnp.int32))
+    dstep_d = jax.jit(lambda p, s: (p + 1.0, s + 1), donate_argnums=(0,))
+    p, s2 = dstep_d(p, s)
+    jax.block_until_ready((p, s2))
+
+    def donated_chain():
+        nonlocal p
+        for _ in range(4):
+            p, out = dstep_d(p, s)
+        return np.asarray(out)
+
+    t("donated chain x4 + sync small out", donated_chain, n=5)
+
+
+if __name__ == "__main__":
+    main()

@@ -224,14 +224,42 @@ def test_stale_block_tokens_never_reach_new_occupant():
     eng._seq_lens[0] = 3
 
     B, K = TEST_CONFIG.max_decode_slots, TEST_CONFIG.decode_block_steps
-    toks = np.full((K, B), 7, dtype=np.int32)
-    emit = np.ones((K, B), dtype=bool)
+    packed = np.full((K, B), 7, dtype=np.int32)   # every lane "emitted"
     reqs = [req_a] + [None] * (B - 1)       # snapshot from A's dispatch
-    eng._process_step(("plain", (toks, emit), reqs))
+    eng._process_step(("plain", packed, reqs))
 
     assert req_b.out.empty()                # B got nothing from A's block
     assert req_a.out.empty()                # A is gone; tokens are dropped
     assert slot_b.generated == 1            # no bookkeeping drift either
+
+
+def test_lookahead_depth_greedy_equality():
+    """The lookahead pipeline is a scheduling change only: greedy output at
+    depth 4 (and at a block size that straddles request boundaries) must
+    equal depth-1 token-at-a-time output, across overlapping admissions."""
+    import dataclasses
+
+    prompts = [f"pipeline prompt {i}" for i in range(6)]
+
+    def run(depth, block):
+        cfg = dataclasses.replace(
+            TEST_CONFIG, lookahead_blocks=depth, decode_block_steps=block
+        )
+        eng = InferenceEngine(cfg)
+        try:
+            reqs = [GenRequest(prompt=p, max_new_tokens=7) for p in prompts]
+            for r in reqs:
+                eng.submit(r)
+            outs = []
+            for r in reqs:
+                tokens, done, error = _collect(r)
+                assert error is None and done is not None
+                outs.append(tokens)
+            return outs
+        finally:
+            eng.shutdown()
+
+    assert run(4, 3) == run(1, 1)
 
 
 def test_cancellation_frees_slot(engine):
