@@ -5,14 +5,24 @@ window in HBM every decode step: `k_pages[page_tables]` reads the pages AND
 writes a [B, P·page_size, Hk, D] copy, so the cache crosses HBM twice. This
 kernel reads each valid page exactly once: one grid program per sequence,
 a double-buffered DMA loop streams that sequence's pages HBM → VMEM while
-the previous page's block attention accumulates into online-softmax state
+the previous block's attention accumulates into online-softmax state
 (running max m, denominator l, fp32 accumulator) — the same recurrence as
-ops/flash_attention.py, one page per block.
+ops/flash_attention.py.
+
+Pages stream in GROUPS of `pages_per_block` (G): each buffer slot holds G
+pages, whose DMAs are all in flight together, so per-page DMA latency
+(~µs for a 32 KB page — the dominant cost of a one-page-at-a-time loop)
+amortizes G× and the per-group attention block is [G·page_size] wide —
+MXU-shaped work instead of page_size-sliver matmuls. G consecutive page
+table entries cover contiguous positions, so the group's mask is one iota.
 
 Invalid page-table tails (the reserved garbage page 0) are never DMA'd:
-the loop bound is ceil((position+1)/page_size), data-dependent per sequence,
-and Gemma-2 sliding-window layers also skip pages wholly below
-position - window.
+the loop bound is ceil((position+1)/page_size), data-dependent per
+sequence, and Gemma-2 sliding-window layers also skip pages wholly below
+position - window. Buffer regions for pages outside [lo, hi) hold stale
+VMEM; their logits are masked, and V is zeroed on those rows so masked
+weights never multiply uninitialized data (0·NaN would poison the
+accumulator).
 
 Covers GQA, logit soft-capping, and dynamic sliding windows; falls back to
 the gather implementation off-TPU (`use_kernel` dispatch in
@@ -44,9 +54,9 @@ def _kernel(
     # output
     out_ref,       # [1, Hq, D]
     # scratch
-    k_buf,         # [2, ps, Hk·D] VMEM
+    k_buf,         # [2, G, ps, Hk·D] VMEM
     v_buf,
-    k_sems,        # DMA semaphores (2,)
+    k_sems,        # DMA semaphores (2, G)
     v_sems,
     *,
     scale: float,
@@ -54,58 +64,87 @@ def _kernel(
     page_size: int,
     num_tables: int,   # P — static max pages per sequence
     groups: int,       # Hq // Hk
+    pages_per_block: int,   # G — pages per buffer slot (DMAs in flight)
 ):
     b = pl.program_id(0)
     q_pos = pos_ref[b]
     window = win_ref[0]
+    G = pages_per_block
+    n_blocks = (num_tables + G - 1) // G           # static
 
-    # Pages [lo, hi) hold positions visible to this query.
+    # Pages [lo, hi) hold positions visible to this query; blocks
+    # [blo, bhi) are the G-page groups overlapping that range.
     hi = jax.lax.div(q_pos, page_size) + 1
     lo = jnp.where(
         window > 0,
         jnp.maximum(jax.lax.div(q_pos - window + 1, page_size), 0),
         0,
     )
+    blo = jax.lax.div(lo, G)
+    bhi = jax.lax.div(hi + G - 1, G)
 
-    def page_dma(p, slot, pages_ref, buf, sems):
+    def page_dma(p, slot, j, pages_ref, buf, sems):
         return pltpu.make_async_copy(
-            pages_ref.at[pt_ref[b, p]], buf.at[slot], sems.at[slot]
+            pages_ref.at[pt_ref[b, p]], buf.at[slot, j], sems.at[slot, j]
         )
 
-    def start(p, slot):
-        page_dma(p, slot, k_pages_ref, k_buf, k_sems).start()
-        page_dma(p, slot, v_pages_ref, v_buf, v_sems).start()
+    def start_block(blk, slot):
+        # All G page DMAs of the group go out together (latency overlaps);
+        # pages outside [lo, hi) are skipped — their rows are masked below.
+        for j in range(G):
+            p = blk * G + j
 
-    def wait(p, slot):
-        page_dma(p, slot, k_pages_ref, k_buf, k_sems).wait()
-        page_dma(p, slot, v_pages_ref, v_buf, v_sems).wait()
+            @pl.when((p >= lo) & (p < hi))
+            def _go(p=p, j=j):
+                page_dma(p, slot, j, k_pages_ref, k_buf, k_sems).start()
+                page_dma(p, slot, j, v_pages_ref, v_buf, v_sems).start()
 
-    @pl.when(lo < hi)
+    def wait_block(blk, slot):
+        for j in range(G):
+            p = blk * G + j
+
+            @pl.when((p >= lo) & (p < hi))
+            def _wait(p=p, j=j):
+                page_dma(p, slot, j, k_pages_ref, k_buf, k_sems).wait()
+                page_dma(p, slot, j, v_pages_ref, v_buf, v_sems).wait()
+
+    @pl.when(blo < bhi)
     def _first():
-        start(lo, lo % 2)
+        start_block(blo, blo % 2)
 
     Hq, D = q_ref.shape[1], q_ref.shape[2]
+    W = G * page_size                               # group window width
     q = q_ref[0].astype(jnp.float32) * scale                  # [Hq, D]
 
-    def body(p, carry):
+    def body(blk, carry):
         m, l, acc = carry
 
         def run(carry):
             m, l, acc = carry
-            slot = p % 2
+            slot = blk % 2
 
-            @pl.when(p + 1 < hi)
+            @pl.when(blk + 1 < bhi)
             def _next():
-                start(p + 1, (p + 1) % 2)
+                start_block(blk + 1, (blk + 1) % 2)
 
-            wait(p, slot)
-            # Buffers hold [ps, Hk*D] (heads folded into lanes so the DMA
-            # slice stays 128-aligned for any head_dim); per-head slices are
-            # taken in-register.
-            k = k_buf[slot]                                   # [ps, Hk*D]
-            v = v_buf[slot]
+            wait_block(blk, slot)
+            # Buffers hold [G, ps, Hk*D] (heads folded into lanes so the
+            # DMA slice stays 128-aligned for any head_dim); the G pages
+            # cover contiguous positions, so they flatten to one [W, Hk*D]
+            # block with a single iota mask.
+            k = k_buf[slot].reshape(W, -1)
+            v = v_buf[slot].reshape(W, -1)
             D = q.shape[1]
             num_kv = k.shape[1] // D
+
+            kv_pos1 = blk * W + jax.lax.broadcasted_iota(
+                jnp.int32, (W, 1), dimension=0
+            )                                                 # [W, 1]
+            valid1 = (kv_pos1 >= lo * page_size) & (kv_pos1 < hi * page_size)
+            # Rows of pages that were never DMA'd hold stale VMEM; zero V
+            # there so masked-out weights cannot multiply NaN garbage.
+            v = jnp.where(valid1, v.astype(jnp.float32), 0.0)
+
             # Mosaic lowers only plain 2D matmuls — unroll over kv heads
             # (q head h ↔ kv head h//groups, heads grouped contiguously).
             s = jnp.concatenate(
@@ -119,27 +158,28 @@ def _kernel(
                     for h in range(num_kv)
                 ],
                 axis=0,
-            )                                                 # [Hq, ps]
+            )                                                 # [Hq, W]
             if logit_softcap is not None:
                 s = logit_softcap * jnp.tanh(s / logit_softcap)
 
-            kv_pos = p * page_size + jax.lax.broadcasted_iota(
-                jnp.int32, (Hq, page_size), dimension=1
+            kv_pos = blk * W + jax.lax.broadcasted_iota(
+                jnp.int32, (Hq, W), dimension=1
             )
             mask = kv_pos <= q_pos
             mask &= (window <= 0) | (kv_pos > q_pos - window)
+            mask &= valid1.reshape(1, W)
             s = jnp.where(mask, s, _NEG_INF)
 
             m_cur = jnp.max(s, axis=1, keepdims=True)         # [Hq, 1]
             m_new = jnp.maximum(m, m_cur)
-            pexp = jnp.where(mask, jnp.exp(s - m_new), 0.0)   # [Hq, ps]
+            pexp = jnp.where(mask, jnp.exp(s - m_new), 0.0)   # [Hq, W]
             corr = jnp.exp(m - m_new)
             l_new = corr * l + jnp.sum(pexp, axis=1, keepdims=True)
             pv = jnp.concatenate(
                 [
                     jax.lax.dot_general(
-                        pexp[h * groups:(h + 1) * groups],    # [g, ps]
-                        v[:, h * D:(h + 1) * D].astype(jnp.float32),
+                        pexp[h * groups:(h + 1) * groups],    # [g, W]
+                        v[:, h * D:(h + 1) * D],
                         dimension_numbers=(((1,), (0,)), ((), ())),
                         preferred_element_type=jnp.float32,
                     )
@@ -151,20 +191,20 @@ def _kernel(
             return m_new, l_new, acc_new
 
         return jax.lax.cond(
-            (p >= lo) & (p < hi), run, lambda c: c, carry
+            (blk >= blo) & (blk < bhi), run, lambda c: c, carry
         )
 
     m0 = jnp.full((Hq, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((Hq, 1), jnp.float32)
     acc0 = jnp.zeros((Hq, D), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, num_tables, body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
 
     out_ref[0] = (acc / jnp.maximum(l, 1e-9)).astype(out_ref.dtype)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("scale", "logit_softcap", "interpret"),
+    static_argnames=("scale", "logit_softcap", "interpret", "pages_per_block"),
 )
 def _decode_call(
     q: jax.Array,             # [B, Hq, D]
@@ -177,10 +217,16 @@ def _decode_call(
     scale: float,
     logit_softcap: Optional[float],
     interpret: bool,
+    pages_per_block: int = 0,   # 0 → auto
 ) -> jax.Array:
     B, Hq, D = q.shape
     N, ps, Hk, _ = k_pages.shape
     P = page_tables.shape[1]
+    if pages_per_block <= 0:
+        # Target ~128 positions per block (one MXU tile of rows) with all
+        # of a block's page DMAs in flight together; bounded by the table.
+        pages_per_block = max(1, min(P, 128 // ps if ps <= 128 else 1))
+    G = min(pages_per_block, P)
     # Fold heads into the lane dimension: [N, ps, Hk·D] keeps every DMA
     # slice 128-aligned regardless of head_dim (a contiguous reshape).
     k_pages = k_pages.reshape(N, ps, Hk * D)
@@ -193,6 +239,7 @@ def _decode_call(
         page_size=ps,
         num_tables=P,
         groups=Hq // Hk,
+        pages_per_block=G,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
@@ -204,10 +251,10 @@ def _decode_call(
         ],
         out_specs=pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((2, ps, Hk * D), k_pages.dtype),
-            pltpu.VMEM((2, ps, Hk * D), k_pages.dtype),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.VMEM((2, G, ps, Hk * D), k_pages.dtype),
+            pltpu.VMEM((2, G, ps, Hk * D), k_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, G)),
+            pltpu.SemaphoreType.DMA((2, G)),
         ],
     )
     return pl.pallas_call(
@@ -246,6 +293,7 @@ def paged_attention_decode(
     window: Optional[jax.Array] = None,
     interpret: bool = False,
     force_kernel: bool = False,
+    pages_per_block: int = 0,   # 0 → auto (~128 positions per block)
 ) -> jax.Array:
     """Decode-step paged attention; returns [B, 1, Hq, D].
 
@@ -271,5 +319,6 @@ def paged_attention_decode(
         q[:, 0], k_pages, v_pages, page_tables,
         q_positions[:, 0].astype(jnp.int32), win,
         scale=scale, logit_softcap=logit_softcap, interpret=interpret,
+        pages_per_block=pages_per_block,
     )
     return out[:, None]

@@ -99,10 +99,6 @@ def main():
       n=10)
 
     # 10. donation chain (mimics the engine's paged-pool chaining)
-    @jax.jit
-    def dstep(p, s):
-        return p + 1.0, s + 1
-
     p = jax.device_put(jnp.zeros((1024, 1024), jnp.float32))
     s = jax.device_put(jnp.zeros((16,), jnp.int32))
     dstep_d = jax.jit(lambda p, s: (p + 1.0, s + 1), donate_argnums=(0,))

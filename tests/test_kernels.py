@@ -105,6 +105,24 @@ def test_paged_decode_kernel_matches_gather(softcap, win):
     assert float(jnp.max(jnp.abs(ref - out))) < TOL
 
 
+@pytest.mark.parametrize("g", [1, 2, 3])
+@pytest.mark.parametrize("win", [None, 24])
+def test_paged_decode_kernel_multi_group(g, win):
+    """Force small page groups so the group loop runs multiple blocks,
+    including a partial last group (P=8 with G=3) and a window whose lo
+    lands mid-group (non-DMA'd rows inside a live group must be masked)."""
+    q, kp, vp, pt, pos = _paged_case(
+        4, 8, 2, 64, 16, 8, [[5], [37], [63], [100]]
+    )
+    w = None if win is None else jnp.int32(win)
+    ref = paged_attention(q, kp, vp, pt, pos, scale=0.125, window=w)
+    out = paged_attention_decode(
+        q, kp, vp, pt, pos, scale=0.125, window=w,
+        interpret=True, pages_per_block=g,
+    )
+    assert float(jnp.max(jnp.abs(ref - out))) < TOL
+
+
 def test_paged_decode_kernel_no_gqa_single_page():
     q, kp, vp, pt, pos = _paged_case(1, 2, 2, 32, 16, 4, [[5]])
     ref = paged_attention(q, kp, vp, pt, pos, scale=0.125)
