@@ -106,6 +106,13 @@ class EngineConfig:
     sp: int = 1
     pp: int = 1
 
+    # Sampled-path top-p prefilter width: >0 restricts each row to its
+    # top-K logits via lax.top_k (no full [B, vocab] sort — the expensive
+    # op at 128k-256k vocab) and applies top-p within them; equivalent to
+    # composing top-k=K with top-p, exact whenever the top-p support fits
+    # in K. 0 → exact full-vocab sort. Greedy batches never sort either way.
+    top_p_candidates: int = 0
+
     # Speculative decoding (engine/spec_decode.py): a draft model name turns
     # it on; gamma = drafts per verify round. Draft must share the target's
     # vocab. top_p<1 requests fall back to the plain decode step.
@@ -161,6 +168,9 @@ class EngineConfig:
             ep=_env_int("POLYKEY_EP", cls.ep),
             sp=_env_int("POLYKEY_SP", cls.sp),
             pp=_env_int("POLYKEY_PP", cls.pp),
+            top_p_candidates=_env_int(
+                "POLYKEY_TOP_P_CANDIDATES", cls.top_p_candidates
+            ),
             draft_model=os.environ.get("POLYKEY_DRAFT_MODEL") or None,
             draft_checkpoint_path=os.environ.get("POLYKEY_DRAFT_CHECKPOINT")
             or None,
@@ -198,6 +208,8 @@ class EngineConfig:
             raise ValueError("decode_block_steps must be >= 1")
         if self.lookahead_blocks < 1:
             raise ValueError("lookahead_blocks must be >= 1")
+        if self.top_p_candidates < 0:
+            raise ValueError("top_p_candidates must be >= 0 (0 → exact)")
         for name in ("tp", "dp", "ep", "sp", "pp"):
             if getattr(self, name) < 1:
                 raise ValueError(f"{name} must be >= 1")

@@ -98,7 +98,7 @@ class _Slot:
 def _prefill_fn(
     params, cfg: ModelConfig, paged: PagedKV,
     tokens, start, last_rel, page_table, key, temperature, top_p,
-    *, greedy: bool,
+    *, greedy: bool, candidates: int = 0,
 ):
     """Prefill N windows (tokens [N, T]) at absolute positions
     start[i]..start[i]+T-1 and sample from each hidden state at relative
@@ -122,14 +122,16 @@ def _prefill_fn(
     hidden, paged = forward_paged(params, cfg, tokens, positions, paged, page_table)
     last = hidden[jnp.arange(N), last_rel]                 # [N, H]
     logits = unembed(params, cfg, last)                    # [N, V]
-    token, new_key = _sample_tail(logits, key, temperature, top_p, greedy)
+    token, new_key = _sample_tail(
+        logits, key, temperature, top_p, greedy, candidates
+    )
     return token, new_key, paged
 
 
 def _decode_fn(
     params, cfg: ModelConfig, paged: PagedKV,
     last_tokens, seq_lens, page_tables, active, caps, key, temperature, top_p,
-    *, greedy: bool, steps: int, eos_id: int,
+    *, greedy: bool, steps: int, eos_id: int, candidates: int = 0,
 ):
     """`steps` decode steps for the whole slot batch in ONE dispatch.
 
@@ -159,7 +161,9 @@ def _decode_fn(
             params, cfg, last[:, None], positions, paged, page_tables
         )
         logits = unembed(params, cfg, hidden[:, 0])        # [B, V]
-        tokens, new_key = _sample_tail(logits, key, temperature, top_p, greedy)
+        tokens, new_key = _sample_tail(
+            logits, key, temperature, top_p, greedy, candidates
+        )
         tokens = jnp.where(act, tokens, 0)
         new_seq = seq + act.astype(jnp.int32)
         cont = act & (tokens != eos_id) & (new_seq < caps)
@@ -219,14 +223,16 @@ def _retire_lane_fn(last_tokens, seq_lens, page_tables, active, caps, slot):
     )
 
 
-def _sample_tail(logits, key, temperature, top_p, greedy: bool):
+def _sample_tail(logits, key, temperature, top_p, greedy: bool,
+                 candidates: int = 0):
     """Shared sampling tail for prefill and decode: greedy takes pure
     argmax and leaves the key chain untouched; otherwise split + per-row
-    dynamic sampling."""
+    dynamic sampling (optionally top-k-prefiltered, engine config
+    `top_p_candidates` — skips the [B, vocab] sort)."""
     if greedy:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32), key
     new_key, sub = jax.random.split(key)
-    return sample_dynamic(logits, sub, temperature, top_p), new_key
+    return sample_dynamic(logits, sub, temperature, top_p, candidates), new_key
 
 
 _MAX_PREFILL_GROUP = 4   # burst admissions batched per prefill dispatch
@@ -317,13 +323,14 @@ class InferenceEngine:
         # Pinned output shardings keep the donated pool's layout stable
         # across steps (donation requires matching input/output shardings).
         self._jit_prefill = jax.jit(
-            _prefill_fn, static_argnames=("cfg", "greedy"),
+            _prefill_fn, static_argnames=("cfg", "greedy", "candidates"),
             donate_argnames=("paged",),
             out_shardings=(self._repl, self._repl, self._pool_sharding),
         )
         self._dp_steps = NamedSharding(self.mesh, PartitionSpec(None, "dp"))
         self._jit_decode = jax.jit(
-            _decode_fn, static_argnames=("cfg", "greedy", "steps", "eos_id"),
+            _decode_fn,
+            static_argnames=("cfg", "greedy", "steps", "eos_id", "candidates"),
             donate_argnames=("paged",),
             out_shardings=(
                 self._dp_steps, self._dp_vec, self._dp_vec,
@@ -835,6 +842,7 @@ class InferenceEngine:
                     put(last_rel), put(tables), self._key_dev,
                     put(temp), put(top_p),
                     greedy=greedy,
+                    candidates=self.config.top_p_candidates,
                 )
         except Exception as e:
             # Contain the failure to this group: every member slot is
@@ -947,6 +955,7 @@ class InferenceEngine:
                     self.params, self.model_cfg, self.paged,
                     *common, self._key_dev, *sampling,
                     greedy=request.temperature == 0.0,
+                    candidates=self.config.top_p_candidates,
                 )
             return first_token
 
@@ -1136,6 +1145,7 @@ class InferenceEngine:
                 greedy=greedy,
                 steps=self._block_steps,
                 eos_id=self.tokenizer.eos_id,
+                candidates=self.config.top_p_candidates,
             )
             # Feed final state straight back as the next block's inputs;
             # host mirrors update in _process_step for bookkeeping.

@@ -63,6 +63,7 @@ def sample_dynamic(
     key: jax.Array,
     temperature: jax.Array,       # [B] — 0 → greedy for that row
     top_p: jax.Array,             # [B] — 1.0 → disabled for that row
+    candidates: int = 0,          # static: 0 → exact (full-vocab sort)
 ) -> jax.Array:
     """Per-row sampling with *data-dependent* temperature/top-p.
 
@@ -70,10 +71,44 @@ def sample_dynamic(
     sampling settings in one jitted call, so the settings arrive as arrays
     rather than static config. Greedy rows are selected with jnp.where (no
     control flow → no recompilation as the batch mix changes).
-    """
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
+    `candidates` > 0 prefilters each row to its top-`candidates` logits
+    with lax.top_k (already descending — no separate [B, vocab] sort, the
+    expensive op at 128k-256k vocab) and applies top-p within them:
+    equivalent to composing top-k=candidates with top-p. Candidate
+    probabilities are normalized by the FULL-vocab logsumexp (a sort-free
+    reduction), so the keep rule matches the exact path token-for-token;
+    the result is exact whenever the top-p support fits in the candidate
+    set. Rows with top_p >= 1 asked for no truncation and bypass the
+    prefilter entirely (untruncated categorical needs no sort either).
+    Pass candidates=0 for the exact full-vocab path.
+    """
     temp = jnp.maximum(temperature, 1e-6)[:, None]
+
+    if candidates and candidates < logits.shape[-1]:
+        scaled_full = logits / temp                       # [B, V]
+        lse = jax.scipy.special.logsumexp(
+            scaled_full, axis=-1, keepdims=True
+        )
+        vals, idx = jax.lax.top_k(scaled_full, candidates)  # desc [B, C]
+        greedy = idx[:, 0].astype(jnp.int32)
+        probs = jnp.exp(vals - lse)       # true full-vocab probabilities
+        keep = jnp.cumsum(probs, axis=-1) - probs < top_p[:, None]
+        keep = keep.at[:, 0].set(True)
+        masked = jnp.where(keep, vals, -jnp.inf)
+        k_pre, k_full = jax.random.split(key)
+        local = jax.random.categorical(k_pre, masked, axis=-1)
+        truncated = jnp.take_along_axis(
+            idx, local[:, None], axis=-1
+        )[:, 0].astype(jnp.int32)
+        # top_p >= 1: unrestricted sampling over the whole vocabulary.
+        full = jax.random.categorical(
+            k_full, scaled_full, axis=-1
+        ).astype(jnp.int32)
+        sampled = jnp.where(top_p >= 1.0, full, truncated)
+        return jnp.where(temperature == 0.0, greedy, sampled)
+
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = logits / temp
 
     # Per-row top-p on the scaled logits (sort + cumulative mass threshold).

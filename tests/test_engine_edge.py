@@ -24,6 +24,81 @@ def test_top_p_zero_degrades_to_greedy():
     assert int(out[0]) == 1
 
 
+def test_top_p_candidate_prefilter_matches_exact():
+    """The lax.top_k prefilter path must match the exact full-vocab
+    sampler in distribution when the candidate set covers the top-p
+    support. Draws differ for the same key (categorical draws
+    vocab-shaped vs candidate-shaped Gumbel noise), so the check is:
+    greedy rows identical, degenerate p collapses to argmax, every
+    prefiltered sample lands inside the exact keep-set, and empirical
+    frequencies over many keys agree."""
+    import numpy as np
+
+    logits = jax.random.normal(jax.random.PRNGKey(4), (4, 64)) * 3.0
+    temps = jnp.array([0.0, 1.0, 0.8, 1.2], jnp.float32)
+    top_ps = jnp.array([1.0, 0.6, 0.9, 0.01], jnp.float32)
+
+    # Exact keep-set per row (same math as _top_p_keep_mask, in numpy).
+    ln = np.asarray(logits, np.float64) / np.maximum(np.asarray(temps), 1e-6)[:, None]
+    order = np.argsort(-ln, axis=-1)
+    keep_sets = []
+    for b in range(ln.shape[0]):
+        probs = np.exp(ln[b, order[b]] - ln[b, order[b]].max())
+        probs /= probs.sum()
+        cum = np.cumsum(probs)
+        n_keep = max(1, int(np.sum(cum - probs < float(top_ps[b]))))
+        keep_sets.append(set(order[b, :n_keep].tolist()))
+
+    keys = jax.random.split(jax.random.PRNGKey(7), 384)
+    exact = jax.vmap(lambda k: sample_dynamic(logits, k, temps, top_ps))(keys)
+    pre = jax.vmap(
+        lambda k: sample_dynamic(logits, k, temps, top_ps, candidates=32)
+    )(keys)
+    exact, pre = np.asarray(exact), np.asarray(pre)
+
+    assert (exact[:, 0] == pre[:, 0]).all()          # greedy row
+    assert (pre[:, 3] == exact[:, 3]).all()          # p=0.01 → argmax row
+    # Row 1 has top_p < 1 and a candidate set covering its support; rows
+    # with top_p >= 1 bypass the prefilter (untruncated full-vocab draw),
+    # so their support is the whole vocabulary by construction.
+    for b in (1, 2, 3):
+        assert set(pre[:, b].tolist()) <= keep_sets[b]
+        assert set(exact[:, b].tolist()) <= keep_sets[b]
+        # Empirical distributions over the shared support agree loosely.
+        for tok in keep_sets[b]:
+            fe = float((exact[:, b] == tok).mean())
+            fp = float((pre[:, b] == tok).mean())
+            assert abs(fe - fp) < 0.12, (b, tok, fe, fp)
+
+
+def test_top_p_candidate_boundary_token_normalization():
+    """The prefilter's keep rule must use FULL-vocab probabilities (review
+    finding: candidate-local renormalization shrinks the keep set). Head
+    probs [0.3, 0.3, 0.28, 0.07], tail 0.05 across the rest, top_p=0.9:
+    token 3's full-vocab cum-minus-own is 0.88 < 0.9 → exact keeps it.
+    Candidate-local renormalization over the top-16 (mass ≈ 0.952) would
+    compute 0.88/0.952 ≈ 0.924 ≥ 0.9 and drop it. So the check is sharp:
+    token 3 must be reachable through the prefiltered path, and both
+    paths must emit the same support over 512 draws."""
+    import numpy as np
+
+    V, C = 256, 16
+    head = np.log(np.array([0.3, 0.3, 0.28, 0.07]))
+    tail = np.log(np.full(V - 4, 0.05 / (V - 4)))
+    logits = jnp.asarray(np.concatenate([head, tail])[None, :], jnp.float32)
+    temps = jnp.array([1.0], jnp.float32)
+    top_ps = jnp.array([0.9], jnp.float32)
+
+    keys = jax.random.split(jax.random.PRNGKey(11), 512)
+    exact = np.asarray(jax.vmap(
+        lambda k: sample_dynamic(logits, k, temps, top_ps))(keys))[:, 0]
+    pre = np.asarray(jax.vmap(
+        lambda k: sample_dynamic(logits, k, temps, top_ps, candidates=C)
+    )(keys))[:, 0]
+    assert set(exact.tolist()) == {0, 1, 2, 3}, sorted(set(exact.tolist()))
+    assert set(pre.tolist()) == {0, 1, 2, 3}, sorted(set(pre.tolist()))
+
+
 def test_shutdown_fails_inflight_requests():
     config = EngineConfig(
         model="tiny-llama", tokenizer="byte", dtype="float32",
