@@ -98,7 +98,7 @@ class _Slot:
 def _prefill_fn(
     params, cfg: ModelConfig, paged: PagedKV,
     tokens, start, last_rel, page_table, key, temperature, top_p,
-    *, greedy: bool, candidates: int = 0,
+    *, greedy: bool, candidates: int = 0, mesh=None,
 ):
     """Prefill N windows (tokens [N, T]) at absolute positions
     start[i]..start[i]+T-1 and sample from each hidden state at relative
@@ -119,7 +119,9 @@ def _prefill_fn(
     """
     N, T = tokens.shape
     positions = start[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
-    hidden, paged = forward_paged(params, cfg, tokens, positions, paged, page_table)
+    hidden, paged = forward_paged(
+        params, cfg, tokens, positions, paged, page_table, mesh=mesh
+    )
     last = hidden[jnp.arange(N), last_rel]                 # [N, H]
     logits = unembed(params, cfg, last)                    # [N, V]
     token, new_key = _sample_tail(
@@ -131,7 +133,7 @@ def _prefill_fn(
 def _decode_fn(
     params, cfg: ModelConfig, paged: PagedKV,
     last_tokens, seq_lens, page_tables, active, caps, key, temperature, top_p,
-    *, greedy: bool, steps: int, eos_id: int, candidates: int = 0,
+    *, greedy: bool, steps: int, eos_id: int, candidates: int = 0, mesh=None,
 ):
     """`steps` decode steps for the whole slot batch in ONE dispatch.
 
@@ -158,7 +160,8 @@ def _decode_fn(
         last, seq, act, key, paged = carry
         positions = jnp.maximum(seq - 1, 0)[:, None]       # [B, 1]
         hidden, paged = forward_paged(
-            params, cfg, last[:, None], positions, paged, page_tables
+            params, cfg, last[:, None], positions, paged, page_tables,
+            mesh=mesh,
         )
         logits = unembed(params, cfg, hidden[:, 0])        # [B, V]
         tokens, new_key = _sample_tail(
@@ -323,14 +326,17 @@ class InferenceEngine:
         # Pinned output shardings keep the donated pool's layout stable
         # across steps (donation requires matching input/output shardings).
         self._jit_prefill = jax.jit(
-            _prefill_fn, static_argnames=("cfg", "greedy", "candidates"),
+            _prefill_fn,
+            static_argnames=("cfg", "greedy", "candidates", "mesh"),
             donate_argnames=("paged",),
             out_shardings=(self._repl, self._repl, self._pool_sharding),
         )
         self._dp_steps = NamedSharding(self.mesh, PartitionSpec(None, "dp"))
         self._jit_decode = jax.jit(
             _decode_fn,
-            static_argnames=("cfg", "greedy", "steps", "eos_id", "candidates"),
+            static_argnames=(
+                "cfg", "greedy", "steps", "eos_id", "candidates", "mesh",
+            ),
             donate_argnames=("paged",),
             out_shardings=(
                 self._dp_steps, self._dp_vec, self._dp_vec,
@@ -448,7 +454,7 @@ class InferenceEngine:
             )
             self._jit_spec_prefill = jax.jit(
                 spec_prefill_fn,
-                static_argnames=("t_cfg", "d_cfg"),
+                static_argnames=("t_cfg", "d_cfg", "mesh"),
                 donate_argnames=("t_paged", "d_paged"),
                 out_shardings=(
                     self._repl, self._pool_sharding, self._pool_sharding,
@@ -456,7 +462,7 @@ class InferenceEngine:
             )
             self._jit_spec_decode = jax.jit(
                 spec_decode_fn,
-                static_argnames=("t_cfg", "d_cfg", "gamma", "eos_id"),
+                static_argnames=("t_cfg", "d_cfg", "gamma", "eos_id", "mesh"),
                 donate_argnames=("t_paged", "d_paged"),
                 out_shardings=(
                     self._dp_mat, self._dp_vec, self._dp_vec, self._dp_vec,
@@ -843,6 +849,7 @@ class InferenceEngine:
                     put(temp), put(top_p),
                     greedy=greedy,
                     candidates=self.config.top_p_candidates,
+                    mesh=self.mesh,
                 )
         except Exception as e:
             # Contain the failure to this group: every member slot is
@@ -883,6 +890,8 @@ class InferenceEngine:
                     put(np.zeros((n,), np.float32)),
                     put(np.ones((n,), np.float32)),
                     greedy=True,
+                    candidates=self.config.top_p_candidates,
+                    mesh=self.mesh,
                 )
                 if bucket == cfg.prefill_buckets[0]:
                     # Warm the lane merge with the prefill's OWN device
@@ -905,6 +914,7 @@ class InferenceEngine:
             dev["temperature"], dev["top_p"],
             greedy=True, steps=self._block_steps,
             eos_id=self.tokenizer.eos_id,
+            candidates=self.config.top_p_candidates, mesh=self.mesh,
         )
         *_, self._key_dev, self.paged = outs
         self._jit_retire(
@@ -949,6 +959,7 @@ class InferenceEngine:
                     self.model_cfg, self.draft_cfg,
                     self.paged, self.d_paged,
                     *common, self._advance_key(), *sampling,
+                    mesh=self.mesh,
                 )
             else:
                 first_token, self._key_dev, self.paged = self._jit_prefill(
@@ -956,6 +967,7 @@ class InferenceEngine:
                     *common, self._key_dev, *sampling,
                     greedy=request.temperature == 0.0,
                     candidates=self.config.top_p_candidates,
+                    mesh=self.mesh,
                 )
             return first_token
 
@@ -1146,6 +1158,7 @@ class InferenceEngine:
                 steps=self._block_steps,
                 eos_id=self.tokenizer.eos_id,
                 candidates=self.config.top_p_candidates,
+                mesh=self.mesh,
             )
             # Feed final state straight back as the next block's inputs;
             # host mirrors update in _process_step for bookkeeping.
@@ -1227,7 +1240,7 @@ class InferenceEngine:
                 dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
                 dev["active"], dev["caps"], jax.device_put(key, self._repl),
                 dev["temperature"], gamma=self._gamma,
-                eos_id=self.tokenizer.eos_id,
+                eos_id=self.tokenizer.eos_id, mesh=self.mesh,
             )
             dev["last_tokens"] = new_last
             dev["seq_lens"] = new_seq

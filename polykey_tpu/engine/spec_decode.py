@@ -35,6 +35,7 @@ def spec_prefill_fn(
     t_params, d_params, t_cfg: ModelConfig, d_cfg: ModelConfig,
     t_paged, d_paged,
     tokens, start, last_rel, page_table, key, temperature, top_p,
+    mesh=None,
 ):
     """Prefill BOTH caches for one window; first token from the TARGET.
 
@@ -48,10 +49,10 @@ def spec_prefill_fn(
     T = tokens.shape[1]
     positions = start[0] + jnp.arange(T, dtype=jnp.int32)[None, :]
     hidden, t_paged = forward_paged(
-        t_params, t_cfg, tokens, positions, t_paged, page_table
+        t_params, t_cfg, tokens, positions, t_paged, page_table, mesh=mesh
     )
     _, d_paged = forward_paged(
-        d_params, d_cfg, tokens, positions, d_paged, page_table
+        d_params, d_cfg, tokens, positions, d_paged, page_table, mesh=mesh
     )
     last = hidden[0, last_rel[0]][None]
     logits = unembed(t_params, t_cfg, last)
@@ -63,7 +64,7 @@ def spec_decode_fn(
     t_params, d_params, t_cfg: ModelConfig, d_cfg: ModelConfig,
     t_paged, d_paged,
     last_tokens, seq_lens, page_tables, active, caps, key, temperature,
-    gamma: int, eos_id: int,
+    gamma: int, eos_id: int, mesh=None,
 ):
     """One draft/verify round for the whole slot batch.
 
@@ -93,7 +94,8 @@ def spec_decode_fn(
     def draft_step(carry, k):
         d_paged, tok, p = carry
         hidden, d_paged = forward_paged(
-            d_params, d_cfg, tok[:, None], p[:, None], d_paged, page_tables
+            d_params, d_cfg, tok[:, None], p[:, None], d_paged, page_tables,
+            mesh=mesh,
         )
         logits = unembed(d_params, d_cfg, hidden[:, 0])   # [B, V]
         dist = jax.nn.softmax(logits / temp[:, None], axis=-1)
@@ -117,14 +119,14 @@ def spec_decode_fn(
     window = jnp.concatenate([last_tokens[:, None], drafts], axis=1)
     w_pos = pos[:, None] + jnp.arange(gamma + 1, dtype=jnp.int32)[None, :]
     t_hidden, t_paged = forward_paged(
-        t_params, t_cfg, window, w_pos, t_paged, page_tables
+        t_params, t_cfg, window, w_pos, t_paged, page_tables, mesh=mesh
     )
     t_logits = unembed(t_params, t_cfg, t_hidden)         # [B, gamma+1, V]
     # Draft-cache sync over the same window: the scan wrote pos..pos+γ-1
     # only, so on full acceptance slot pos+γ would be a permanent hole
     # (models/speculative.py:164-169 rationale, ported to pages).
     _, d_paged = forward_paged(
-        d_params, d_cfg, window, w_pos, d_paged, page_tables
+        d_params, d_cfg, window, w_pos, d_paged, page_tables, mesh=mesh
     )
 
     # --- Acceptance: exact-match for greedy rows, rejection sampling else

@@ -289,6 +289,7 @@ def forward_paged(
     positions: jax.Array,            # [B, T] absolute positions
     paged,                           # engine.kv_cache.PagedKV
     page_tables: jax.Array,          # [B, P] int32
+    mesh=None,                       # serving mesh → shard_map the kernels
 ):
     """Forward pass over the paged KV cache (serving path).
 
@@ -296,6 +297,10 @@ def forward_paged(
     pools and is addressed through per-sequence page tables — the layout the
     continuous-batching engine composes decode batches from. Used both for
     prefill (T = prompt bucket) and batched decode (T = 1).
+
+    `mesh` (static at the engine's jit boundary) lets the Pallas kernels
+    run under shard_map when tp/dp/sp extents exceed 1 — GSPMD cannot
+    partition an opaque pallas_call; the jnp paths need no help.
     """
     from ..ops.paged_attention import paged_attention, paged_write
     from ..ops.paged_attention_kernel import paged_attention_decode
@@ -313,6 +318,7 @@ def forward_paged(
             scale=cfg.q_scale,
             logit_softcap=cfg.attn_logit_softcap,
             window=_layer_window(cfg, layer_idx),
+            mesh=mesh,
         )
         return ctx, kc, vc
 

@@ -241,9 +241,18 @@ def flash_attention(
     block_k: int = 1024,
     interpret: bool = False,
     force_kernel: bool = False,
+    mesh=None,                # serving mesh → shard_map the kernel
 ) -> jax.Array:
     """Blockwise attention; same contract as the reference `attention` but
-    masking is derived from positions in-kernel. Returns [B, T, Hq, D]."""
+    masking is derived from positions in-kernel. Returns [B, T, Hq, D].
+
+    With a mesh whose sp/tp extents exceed 1 the kernel runs under
+    shard_map: the query/time axis shards over sp (each shard computes
+    its query block against the FULL key window — masks come from the
+    global positions, so blockwise attention is embarrassingly parallel
+    over T), heads over tp. GSPMD cannot partition an opaque pallas_call
+    and would otherwise all-gather the sharded operands.
+    """
     B, T, Hq, D = q.shape
     S = k.shape[1]
 
@@ -256,6 +265,60 @@ def flash_attention(
         return attention(
             q, k, v, mask, scale=scale, logit_softcap=logit_softcap
         )
+
+    sp = mesh.shape.get("sp", 1) if mesh is not None else 1
+    tp = mesh.shape.get("tp", 1) if mesh is not None else 1
+    if (sp > 1 or tp > 1) and mesh.shape.get("pp", 1) > 1:
+        # Per-layer activations are stage-local under pp, not replicated —
+        # the shard_map specs below would be wrong (and check_vma=False
+        # would hide it). The masked reference path is GSPMD-partitionable
+        # as-is, so pp>1 meshes take it.
+        mask = make_attention_mask(q_positions, S)
+        if window is not None:
+            kv_pos = jnp.arange(S, dtype=jnp.int32)[None, None, :]
+            w = jnp.asarray(window, jnp.int32)
+            mask &= (w <= 0) | (kv_pos > q_positions[:, :, None] - w)
+        return attention(
+            q, k, v, mask, scale=scale, logit_softcap=logit_softcap
+        )
+    if sp > 1 or tp > 1:
+        if T % sp or Hq % tp or k.shape[2] % tp:
+            # Never fall through to an unwrapped pallas_call on sharded
+            # operands — GSPMD would all-gather them (or fail to compile)
+            # with no pointer at the real cause.
+            raise ValueError(
+                f"flash kernel on mesh: T={T} %% sp={sp}, Hq={Hq} / "
+                f"Hk={k.shape[2]} %% tp={tp} must divide evenly"
+            )
+        from jax.sharding import PartitionSpec as P
+
+        def inner(q, k, v, qpos, w):
+            # window passes as an explicit operand (it can be a traced
+            # per-layer scalar — shard_map must not close over tracers);
+            # the kernel treats w <= 0 as global attention.
+            return flash_attention(
+                q, k, v, qpos,
+                scale=scale, logit_softcap=logit_softcap, window=w,
+                block_q=block_q, block_k=block_k, interpret=interpret,
+                force_kernel=True,  # dispatch decided here, global shapes
+            )
+
+        w = (jnp.zeros((1,), jnp.int32) if window is None
+             else jnp.asarray(window, jnp.int32).reshape(1))
+        sm = jax.shard_map(
+            inner,
+            mesh=mesh,
+            in_specs=(
+                P(None, "sp", "tp", None),    # q
+                P(None, None, "tp", None),    # k (full window per shard)
+                P(None, None, "tp", None),    # v
+                P(None, "sp"),                # q_positions
+                P(None),                      # window
+            ),
+            out_specs=P(None, "sp", "tp", None),
+            check_vma=False,
+        )
+        return sm(q, k, v, q_positions, w)
 
     # Shrink blocks toward small shapes, staying on 128-multiples (the
     # wrapper pads T/S up to one block in that case). Benchmarked on v5e:

@@ -48,6 +48,7 @@ def paged_attention(
     scale: float,
     logit_softcap: Optional[float] = None,
     window: Optional[jax.Array] = None,
+    mesh=None,
 ) -> jax.Array:
     """Attention over paged KV; returns [B, T, Hq, D].
 
@@ -63,7 +64,7 @@ def paged_attention(
     k, v = paged_gather_kv(k_pages, v_pages, page_tables)
     return flash_attention(
         q, k, v, q_positions,
-        scale=scale, logit_softcap=logit_softcap, window=window,
+        scale=scale, logit_softcap=logit_softcap, window=window, mesh=mesh,
     )
 
 

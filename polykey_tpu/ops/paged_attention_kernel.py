@@ -294,10 +294,20 @@ def paged_attention_decode(
     interpret: bool = False,
     force_kernel: bool = False,
     pages_per_block: int = 0,   # 0 → auto (~128 positions per block)
+    mesh=None,                  # serving mesh → shard_map the kernel
 ) -> jax.Array:
     """Decode-step paged attention; returns [B, 1, Hq, D].
 
     Same contract as ops/paged_attention.paged_attention restricted to T=1.
+
+    With a mesh whose dp/tp extents exceed 1, the kernel runs under
+    shard_map: batch (and page tables/positions) shard over dp, heads
+    over tp — the engine's layout (parallel/sharding.py: pools
+    P(None, None, 'tp', None), decode batch over dp). GSPMD cannot
+    partition an opaque pallas_call, so without this it would all-gather
+    the head-sharded pools. Attention is embarrassingly parallel over
+    batch and (GQA-aligned) heads, so each shard runs the same kernel on
+    its slice; unmentioned axes (sp/ep) hold replicated operands.
     """
     B = q.shape[0]
     Hk, D = k_pages.shape[2], k_pages.shape[3]
@@ -315,10 +325,57 @@ def paged_attention_decode(
     else:
         win = jnp.asarray(window, jnp.int32).reshape(1)
 
-    out = _decode_call(
-        q[:, 0], k_pages, v_pages, page_tables,
-        q_positions[:, 0].astype(jnp.int32), win,
+    inner = functools.partial(
+        _decode_call,
         scale=scale, logit_softcap=logit_softcap, interpret=interpret,
         pages_per_block=pages_per_block,
     )
+    dp = mesh.shape.get("dp", 1) if mesh is not None else 1
+    tp = mesh.shape.get("tp", 1) if mesh is not None else 1
+    if (dp > 1 or tp > 1) and mesh.shape.get("pp", 1) > 1:
+        # Under pp the per-layer pool slice is stage-local, not replicated
+        # across pp — the shard_map specs below would be wrong. The gather
+        # path is GSPMD-partitionable as-is, so pp>1 meshes take it.
+        from .paged_attention import paged_attention
+
+        return paged_attention(
+            q, k_pages, v_pages, page_tables, q_positions,
+            scale=scale, logit_softcap=logit_softcap, window=window,
+        )
+    if dp > 1 or tp > 1:
+        if B % dp or Hk % tp or q.shape[2] % tp:
+            # Never fall through to an unwrapped pallas_call on sharded
+            # operands — GSPMD would all-gather the head-sharded pools
+            # every layer/step (or fail Mosaic compilation) with no
+            # pointer at the real cause. The engine validates these up
+            # front; direct callers get the explicit error.
+            raise ValueError(
+                f"paged decode kernel on mesh: B={B} %% dp={dp}, "
+                f"Hk={Hk} / Hq={q.shape[2]} %% tp={tp} must divide evenly"
+            )
+        from jax.sharding import PartitionSpec as P
+
+        sm = jax.shard_map(
+            inner,
+            mesh=mesh,
+            in_specs=(
+                P("dp", "tp", None),          # q [B, Hq, D]
+                P(None, None, "tp", None),    # k_pages
+                P(None, None, "tp", None),    # v_pages
+                P("dp", None),                # page_tables
+                P("dp"),                      # positions
+                P(None),                      # window
+            ),
+            out_specs=P("dp", "tp", None),
+            check_vma=False,
+        )
+        out = sm(
+            q[:, 0], k_pages, v_pages, page_tables,
+            q_positions[:, 0].astype(jnp.int32), win,
+        )
+    else:
+        out = inner(
+            q[:, 0], k_pages, v_pages, page_tables,
+            q_positions[:, 0].astype(jnp.int32), win,
+        )
     return out[:, None]
