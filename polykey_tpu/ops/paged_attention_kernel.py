@@ -47,12 +47,16 @@ def _kernel(
     pt_ref,        # [B, P] int32 page tables
     pos_ref,       # [B] int32 decode position per sequence
     win_ref,       # [1] int32 sliding window (<=0 → global)
+    rng_ref,       # [2] int32 page sub-range [rlo, rhi) — CP shard's slice
     # inputs
     q_ref,         # [1, Hq, D] VMEM block
     k_pages_ref,   # [N, ps, Hk·D] HBM (heads folded into lanes; manual DMA)
     v_pages_ref,   # [N, ps, Hk·D] HBM
-    # output
-    out_ref,       # [1, Hq, D]
+    # outputs (unnormalized online-softmax state — the wrapper normalizes,
+    # or merges across CP shards first: acc/l scale by exp(m - m_global))
+    acc_ref,       # [1, Hq, D] f32
+    m_ref,         # [1, Hq, MINOR] f32 (running max, lane-broadcast)
+    l_ref,         # [1, Hq, MINOR] f32 (denominator)
     # scratch
     k_buf,         # [2, G, ps, Hk·D] VMEM
     v_buf,
@@ -72,14 +76,17 @@ def _kernel(
     G = pages_per_block
     n_blocks = (num_tables + G - 1) // G           # static
 
-    # Pages [lo, hi) hold positions visible to this query; blocks
-    # [blo, bhi) are the G-page groups overlapping that range.
-    hi = jax.lax.div(q_pos, page_size) + 1
+    # Pages [lo, hi) hold positions visible to this query, intersected
+    # with this shard's page sub-range (context-parallel decode: each sp
+    # shard covers a contiguous page range; [0, P) when unsharded).
+    # Blocks [blo, bhi) are the G-page groups overlapping that range.
+    hi = jnp.minimum(jax.lax.div(q_pos, page_size) + 1, rng_ref[1])
     lo = jnp.where(
         window > 0,
         jnp.maximum(jax.lax.div(q_pos - window + 1, page_size), 0),
         0,
     )
+    lo = jnp.maximum(lo, rng_ref[0])
     blo = jax.lax.div(lo, G)
     bhi = jax.lax.div(hi + G - 1, G)
 
@@ -108,7 +115,7 @@ def _kernel(
                 page_dma(p, slot, j, k_pages_ref, k_buf, k_sems).wait()
                 page_dma(p, slot, j, v_pages_ref, v_buf, v_sems).wait()
 
-    @pl.when(blo < bhi)
+    @pl.when((lo < hi) & (blo < bhi))
     def _first():
         start_block(blo, blo % 2)
 
@@ -191,7 +198,7 @@ def _kernel(
             return m_new, l_new, acc_new
 
         return jax.lax.cond(
-            (blk >= blo) & (blk < bhi), run, lambda c: c, carry
+            (lo < hi) & (blk >= blo) & (blk < bhi), run, lambda c: c, carry
         )
 
     m0 = jnp.full((Hq, 1), _NEG_INF, jnp.float32)
@@ -199,7 +206,13 @@ def _kernel(
     acc0 = jnp.zeros((Hq, D), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
 
-    out_ref[0] = (acc / jnp.maximum(l, 1e-9)).astype(out_ref.dtype)
+    acc_ref[0] = acc
+    minor = m_ref.shape[2]
+    m_ref[0] = jnp.broadcast_to(m, (Hq, minor))
+    l_ref[0] = jnp.broadcast_to(l, (Hq, minor))
+
+
+_STAT_MINOR = 128   # lane width for the m/l stat outputs (tile-aligned)
 
 
 @functools.partial(
@@ -213,12 +226,17 @@ def _decode_call(
     page_tables: jax.Array,   # [B, P] int32
     positions: jax.Array,     # [B] int32
     window: jax.Array,        # [1] int32
+    page_range: jax.Array,    # [2] int32 — page sub-range [rlo, rhi)
     *,
     scale: float,
     logit_softcap: Optional[float],
     interpret: bool,
     pages_per_block: int = 0,   # 0 → auto
-) -> jax.Array:
+):
+    """Returns UNNORMALIZED online-softmax state (acc [B,Hq,D] f32,
+    m [B,Hq,1], l [B,Hq,1]) over the pages in `page_range` — the caller
+    normalizes, or first merges partial states across context-parallel
+    shards (acc/l scale by exp(m - m_global))."""
     B, Hq, D = q.shape
     N, ps, Hk, _ = k_pages.shape
     P = page_tables.shape[1]
@@ -241,15 +259,20 @@ def _decode_call(
         groups=Hq // Hk,
         pages_per_block=G,
     )
+    stat_spec = pl.BlockSpec((1, Hq, _STAT_MINOR), lambda b, *_: (b, 0, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=(B,),
         in_specs=[
             pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
-        out_specs=pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
+        out_specs=[
+            pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
+            stat_spec,
+            stat_spec,
+        ],
         scratch_shapes=[
             pltpu.VMEM((2, G, ps, Hk * D), k_pages.dtype),
             pltpu.VMEM((2, G, ps, Hk * D), k_pages.dtype),
@@ -257,10 +280,14 @@ def _decode_call(
             pltpu.SemaphoreType.DMA((2, G)),
         ],
     )
-    return pl.pallas_call(
+    acc, m, l = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Hq, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, Hq, _STAT_MINOR), jnp.float32),
+            jax.ShapeDtypeStruct((B, Hq, _STAT_MINOR), jnp.float32),
+        ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
@@ -269,10 +296,12 @@ def _decode_call(
         page_tables.astype(jnp.int32),
         positions.astype(jnp.int32),
         window,
+        page_range.astype(jnp.int32),
         q,
         k_pages,
         v_pages,
     )
+    return acc, m[..., :1], l[..., :1]
 
 
 def use_paged_kernel(num_kv_heads: int, head_dim: int) -> bool:
@@ -300,14 +329,18 @@ def paged_attention_decode(
 
     Same contract as ops/paged_attention.paged_attention restricted to T=1.
 
-    With a mesh whose dp/tp extents exceed 1, the kernel runs under
+    With a mesh whose dp/tp/sp extents exceed 1, the kernel runs under
     shard_map: batch (and page tables/positions) shard over dp, heads
     over tp — the engine's layout (parallel/sharding.py: pools
     P(None, None, 'tp', None), decode batch over dp). GSPMD cannot
     partition an opaque pallas_call, so without this it would all-gather
     the head-sharded pools. Attention is embarrassingly parallel over
     batch and (GQA-aligned) heads, so each shard runs the same kernel on
-    its slice; unmentioned axes (sp/ep) hold replicated operands.
+    its slice. sp > 1 context-parallelizes the page axis: each sp shard
+    covers a contiguous page sub-range of every sequence (pools are
+    sp-replicated — this shards the attention READS) and the partial
+    online-softmax states merge via pmax/psum over sp. ep stays an
+    unmentioned axis with replicated operands.
     """
     B = q.shape[0]
     Hk, D = k_pages.shape[2], k_pages.shape[3]
@@ -330,9 +363,15 @@ def paged_attention_decode(
         scale=scale, logit_softcap=logit_softcap, interpret=interpret,
         pages_per_block=pages_per_block,
     )
+    P_tables = page_tables.shape[1]
+
+    def _normalize(acc, l, dtype):
+        return (acc / jnp.maximum(l, 1e-9)).astype(dtype)
+
     dp = mesh.shape.get("dp", 1) if mesh is not None else 1
     tp = mesh.shape.get("tp", 1) if mesh is not None else 1
-    if (dp > 1 or tp > 1) and mesh.shape.get("pp", 1) > 1:
+    sp = mesh.shape.get("sp", 1) if mesh is not None else 1
+    if (dp > 1 or tp > 1 or sp > 1) and mesh.shape.get("pp", 1) > 1:
         # Under pp the per-layer pool slice is stage-local, not replicated
         # across pp — the shard_map specs below would be wrong. The gather
         # path is GSPMD-partitionable as-is, so pp>1 meshes take it.
@@ -342,7 +381,7 @@ def paged_attention_decode(
             q, k_pages, v_pages, page_tables, q_positions,
             scale=scale, logit_softcap=logit_softcap, window=window,
         )
-    if dp > 1 or tp > 1:
+    if dp > 1 or tp > 1 or sp > 1:
         if B % dp or Hk % tp or q.shape[2] % tp:
             # Never fall through to an unwrapped pallas_call on sharded
             # operands — GSPMD would all-gather the head-sharded pools
@@ -355,8 +394,31 @@ def paged_attention_decode(
             )
         from jax.sharding import PartitionSpec as P
 
+        def inner_sm(q2, kp2, vp2, pt2, pos2, win2):
+            # Context-parallel decode: each sp shard covers a contiguous
+            # page sub-range of every sequence (pools are sp-replicated,
+            # so this shards the attention READS — the long-context
+            # bandwidth bound — sp-fold), then partial online-softmax
+            # states merge with a max/psum pair. sp=1 degenerates to the
+            # full range and no collectives.
+            if sp > 1:
+                s = jax.lax.axis_index("sp")
+                chunk = -(-P_tables // sp)
+                rlo = (s * chunk).astype(jnp.int32)
+                rhi = jnp.minimum(P_tables, rlo + chunk).astype(jnp.int32)
+                rng = jnp.stack([rlo, rhi])
+            else:
+                rng = jnp.array([0, P_tables], jnp.int32)
+            acc, m, l = inner(q2, kp2, vp2, pt2, pos2, win2, rng)
+            if sp > 1:
+                m_g = jax.lax.pmax(m, "sp")
+                corr = jnp.exp(m - m_g)
+                l = jax.lax.psum(l * corr, "sp")
+                acc = jax.lax.psum(acc * corr, "sp")
+            return _normalize(acc, l, q2.dtype)
+
         sm = jax.shard_map(
-            inner,
+            inner_sm,
             mesh=mesh,
             in_specs=(
                 P("dp", "tp", None),          # q [B, Hq, D]
@@ -374,8 +436,10 @@ def paged_attention_decode(
             q_positions[:, 0].astype(jnp.int32), win,
         )
     else:
-        out = inner(
+        acc, _, l = inner(
             q[:, 0], k_pages, v_pages, page_tables,
             q_positions[:, 0].astype(jnp.int32), win,
+            jnp.array([0, P_tables], jnp.int32),
         )
+        out = _normalize(acc, l, q.dtype)
     return out[:, None]

@@ -163,6 +163,42 @@ def test_paged_decode_kernel_shard_mapped_on_mesh():
     assert float(jnp.max(jnp.abs(ref - out))) < TOL
 
 
+@pytest.mark.parametrize("softcap,win", [(None, None), (30.0, 24)])
+def test_paged_decode_kernel_context_parallel(softcap, win):
+    """Context-parallel decode (sp=2): each shard covers half the page
+    range and partial online-softmax states merge via pmax/psum. Rows
+    include a short sequence whose pages fall entirely in shard 0 (the
+    empty-shard guard must contribute zero, not NaN) and long sequences
+    spanning both shards."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from polykey_tpu.parallel.mesh import MeshConfig, create_mesh
+
+    if jax.device_count() < 4:
+        pytest.skip("needs 4 devices")
+    mesh = create_mesh(MeshConfig(sp=2, tp=2), devices=jax.devices()[:4])
+
+    q, kp, vp, pt, pos = _paged_case(
+        4, 8, 2, 64, 16, 8, [[5], [37], [99], [127]]
+    )
+    w = None if win is None else jnp.int32(win)
+    ref = paged_attention(
+        q, kp, vp, pt, pos, scale=0.125, logit_softcap=softcap, window=w
+    )
+
+    rep = NamedSharding(mesh, P())
+    out = paged_attention_decode(
+        jax.device_put(q, NamedSharding(mesh, P(None, None, "tp", None))),
+        jax.device_put(kp, NamedSharding(mesh, P(None, None, "tp", None))),
+        jax.device_put(vp, NamedSharding(mesh, P(None, None, "tp", None))),
+        jax.device_put(pt, rep), jax.device_put(pos, rep),
+        scale=0.125, logit_softcap=softcap, window=w,
+        interpret=True, mesh=mesh,
+    )
+    assert bool(jnp.isfinite(out).all())
+    assert float(jnp.max(jnp.abs(ref - out))) < TOL
+
+
 def test_flash_kernel_shard_mapped_on_mesh():
     """Flash prefill under shard_map on an sp=2 x tp=2 mesh: each shard's
     query block attends the full key window with global positions, so the
