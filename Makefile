@@ -7,7 +7,8 @@ CXX ?= g++
 CXXFLAGS ?= -O2 -std=c++17 -Wall -Wextra
 BUILD_DIR := build
 
-.PHONY: help run run-client test test-models native protos clean bench dryrun
+.PHONY: help run run-client test test-models native protos clean bench dryrun \
+	kernel-check tunnel-probe
 
 help: ## Show available targets
 	@grep -E '^[a-zA-Z_-]+:.*?## .*$$' $(MAKEFILE_LIST) | \
@@ -43,6 +44,12 @@ protos: ## Regenerate protobuf stubs from protos/
 
 bench: ## Run the benchmark harness (prints one JSON line)
 	$(PYTHON) bench.py
+
+kernel-check: ## Compile + compare the Pallas kernels on real TPU
+	$(PYTHON) scripts/tpu_kernel_check.py
+
+tunnel-probe: ## Measure host<->device dispatch/transfer primitive costs
+	$(PYTHON) scripts/probe_tunnel.py
 
 dryrun: ## Compile-check the multi-chip sharded step on a virtual mesh
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

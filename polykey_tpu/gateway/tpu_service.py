@@ -23,7 +23,7 @@ import queue
 from typing import Iterator, Optional
 
 from ..engine.config import EngineConfig
-from ..engine.engine import EngineDeadError, GenRequest, InferenceEngine
+from ..engine.engine import GenRequest, InferenceEngine
 from ..engine.tokenizer import ByteTokenizer
 from ..engine.watchdog import Watchdog
 from ..proto import common_v2_pb2 as cmn

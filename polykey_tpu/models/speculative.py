@@ -35,7 +35,7 @@ import jax.numpy as jnp
 
 from ..engine.sampling import SamplingParams
 from .config import ModelConfig
-from .transformer import KVCache, forward, init_cache, unembed
+from .transformer import forward, init_cache, unembed
 
 
 def _token_probs(logits: jax.Array, temperature: float) -> jax.Array:

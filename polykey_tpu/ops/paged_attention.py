@@ -19,8 +19,6 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from .attention import attention
-
 
 def paged_gather_kv(
     k_pages: jax.Array,       # [num_pages, page_size, Hk, D]
