@@ -110,7 +110,10 @@ class EngineConfig:
     # top-K logits via lax.top_k (no full [B, vocab] sort — the expensive
     # op at 128k-256k vocab) and applies top-p within them; equivalent to
     # composing top-k=K with top-p, exact whenever the top-p support fits
-    # in K. 0 → exact full-vocab sort. Greedy batches never sort either way.
+    # in K. 0 → exact full-vocab sort. Greedy batches never sort either
+    # way. Also enables top_p<1 requests on the SPECULATIVE path
+    # (truncated rejection sampling — spec_decode._truncated_dist); with
+    # 0, spec engines route top_p<1 batches through the plain step.
     top_p_candidates: int = 0
 
     # Speculative decoding (engine/spec_decode.py): a draft model name turns

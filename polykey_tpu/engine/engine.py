@@ -454,7 +454,7 @@ class InferenceEngine:
             )
             self._jit_spec_prefill = jax.jit(
                 spec_prefill_fn,
-                static_argnames=("t_cfg", "d_cfg", "mesh"),
+                static_argnames=("t_cfg", "d_cfg", "candidates", "mesh"),
                 donate_argnames=("t_paged", "d_paged"),
                 out_shardings=(
                     self._repl, self._pool_sharding, self._pool_sharding,
@@ -462,7 +462,9 @@ class InferenceEngine:
             )
             self._jit_spec_decode = jax.jit(
                 spec_decode_fn,
-                static_argnames=("t_cfg", "d_cfg", "gamma", "eos_id", "mesh"),
+                static_argnames=(
+                    "t_cfg", "d_cfg", "gamma", "eos_id", "candidates", "mesh",
+                ),
                 donate_argnames=("t_paged", "d_paged"),
                 out_shardings=(
                     self._dp_mat, self._dp_vec, self._dp_vec, self._dp_vec,
@@ -959,6 +961,7 @@ class InferenceEngine:
                     self.model_cfg, self.draft_cfg,
                     self.paged, self.d_paged,
                     *common, self._advance_key(), *sampling,
+                    candidates=self.config.top_p_candidates,
                     mesh=self.mesh,
                 )
             else:
@@ -1123,17 +1126,26 @@ class InferenceEngine:
             self._resolve_prefills(block=True)
             self._upload_slot_state()
         dev = self._dev
-        # top_p truncation breaks the rejection-sampling identity, so a
-        # batch containing any top_p<1 row takes the plain step. Note the
-        # blast radius is batch-wide, not per-request: speculation is off
+        # top_p composes with speculation via truncated rejection sampling
+        # (spec_decode._truncated_dist), which needs the top-k prefilter
+        # (top_p_candidates > 0) to avoid full-vocab sorts. Without the
+        # prefilter, a batch containing any top_p<1 row takes the plain
+        # step; note that blast radius is batch-wide — speculation is off
         # for every slot while such a row is active, and the plain steps
-        # leave draft-cache holes for all rows, so acceptance stays
-        # collapsed for surviving streams afterwards. Correctness never
-        # degrades; throughput recovers as those streams retire.
-        if self._spec and bool(np.all(self._top_p[self._active] >= 1.0)):
+        # leave draft-cache holes, so acceptance stays collapsed for
+        # surviving streams afterwards. Correctness never degrades.
+        all_untruncated = bool(np.all(self._top_p[self._active] >= 1.0))
+        if self._spec and (
+            self.config.top_p_candidates > 0 or all_untruncated
+        ):
+            spec_candidates = (
+                0 if all_untruncated else self.config.top_p_candidates
+            )
             return (
                 "spec",
-                self._dispatch_spec(dev, self._advance_key()),
+                self._dispatch_spec(
+                    dev, self._advance_key(), spec_candidates
+                ),
                 self._snapshot_requests(),
             )
         # Static variant: an all-greedy batch (the benchmark mode) skips
@@ -1229,8 +1241,10 @@ class InferenceEngine:
                     break
         self.metrics.on_step(emitted)
 
-    def _dispatch_spec(self, dev: dict, key):
-        """Dispatch one draft/verify round (spec_decode.py)."""
+    def _dispatch_spec(self, dev: dict, key, candidates: int = 0):
+        """Dispatch one draft/verify round (spec_decode.py). `candidates`
+        is 0 when every active row has top_p >= 1 — the round then skips
+        all truncation work (plain softmax dists)."""
         with jax.profiler.TraceAnnotation("polykey/spec_decode"):
             (packed_dev, new_last, new_seq, new_active, stats_dev,
              self.paged, self.d_paged) = self._jit_spec_decode(
@@ -1239,8 +1253,9 @@ class InferenceEngine:
                 self.paged, self.d_paged,
                 dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
                 dev["active"], dev["caps"], jax.device_put(key, self._repl),
-                dev["temperature"], gamma=self._gamma,
-                eos_id=self.tokenizer.eos_id, mesh=self.mesh,
+                dev["temperature"], dev["top_p"], gamma=self._gamma,
+                eos_id=self.tokenizer.eos_id,
+                candidates=candidates, mesh=self.mesh,
             )
             dev["last_tokens"] = new_last
             dev["seq_lens"] = new_seq

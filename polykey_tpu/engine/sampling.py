@@ -38,15 +38,56 @@ def _top_p_keep_mask(sorted_logits: jax.Array, p: jax.Array) -> jax.Array:
     cumulative mass >= p, and always at least the top-1 entry (so p <= 0
     degrades to greedy support instead of masking everything)."""
     probs = jax.nn.softmax(sorted_logits, axis=-1)
-    cumulative = jnp.cumsum(probs, axis=-1)
-    keep = cumulative - probs < p
-    first = (
-        jax.lax.broadcasted_iota(
-            jnp.int32, sorted_logits.shape, sorted_logits.ndim - 1
+    return _prefix_keep_mask(probs, p)
+
+
+def _prefix_keep_mask(desc_probs: jax.Array, p) -> jax.Array:
+    """THE top-p keep rule, shared by every path (exact sort, top-k
+    prefilter, and the speculative truncated distributions — they must
+    agree token-for-token): over descending-ordered probabilities, keep
+    each entry whose exclusive cumulative mass is < p, always keeping
+    the first."""
+    keep = jnp.cumsum(desc_probs, axis=-1) - desc_probs < p
+    return keep.at[..., 0].set(True)
+
+
+def truncated_dist(
+    logits: jax.Array,        # [..., V]
+    temp: jax.Array,          # [...] (>0; callers handle greedy rows)
+    top_p: jax.Array,         # [...]
+    candidates: int,          # static top-k prefilter width; 0 → exact
+) -> jax.Array:
+    """Per-row top-p-truncated, renormalized sampling distribution
+    [..., V] — exactly the distribution sample_dynamic draws from for the
+    same (candidates, top_p): the top-k-prefiltered rule when
+    0 < candidates < V (keep rule on FULL-vocab probabilities via
+    logsumexp, no sort), the exact full-vocab sort otherwise. Rows with
+    top_p >= 1 get the untruncated softmax. The speculative draft/verify
+    pair (engine/spec_decode.py) samples and accepts against this."""
+    V = logits.shape[-1]
+    scaled = logits / temp[..., None]
+    probs = jax.nn.softmax(scaled, axis=-1)
+    if candidates and candidates < V:
+        vals, idx = jax.lax.top_k(scaled, candidates)      # desc [..., C]
+        lse = jax.scipy.special.logsumexp(scaled, axis=-1, keepdims=True)
+        p_c = jnp.exp(vals - lse)             # true full-vocab probabilities
+        kept = jnp.where(_prefix_keep_mask(p_c, top_p[..., None]), p_c, 0.0)
+        trunc = jnp.put_along_axis(
+            jnp.zeros_like(probs), idx, kept, axis=-1, inplace=False
         )
-        == 0
+    else:
+        # Exact full-vocab truncation (candidates disabled OR wider than
+        # the vocabulary — never silently skip the requested nucleus).
+        sorted_scaled = jnp.sort(scaled, axis=-1)[..., ::-1]
+        keep = _top_p_keep_mask(sorted_scaled, top_p[..., None])
+        threshold = jnp.min(
+            jnp.where(keep, sorted_scaled, jnp.inf), axis=-1, keepdims=True
+        )
+        trunc = jnp.where(scaled >= threshold, probs, 0.0)
+    trunc = trunc / jnp.maximum(
+        jnp.sum(trunc, axis=-1, keepdims=True), 1e-20
     )
-    return keep | first
+    return jnp.where(top_p[..., None] >= 1.0, probs, trunc)
 
 
 def _apply_top_p(logits: jax.Array, p: float) -> jax.Array:
@@ -93,8 +134,7 @@ def sample_dynamic(
         vals, idx = jax.lax.top_k(scaled_full, candidates)  # desc [B, C]
         greedy = idx[:, 0].astype(jnp.int32)
         probs = jnp.exp(vals - lse)       # true full-vocab probabilities
-        keep = jnp.cumsum(probs, axis=-1) - probs < top_p[:, None]
-        keep = keep.at[:, 0].set(True)
+        keep = _prefix_keep_mask(probs, top_p[:, None])
         masked = jnp.where(keep, vals, -jnp.inf)
         k_pre, k_full = jax.random.split(key)
         local = jax.random.categorical(k_pre, masked, axis=-1)

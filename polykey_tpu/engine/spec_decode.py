@@ -13,11 +13,17 @@ positions, which covers every stale slot (positions advance by ≤ gamma+1
 per round). The engine allocates `gamma` extra positions of page slack per
 request so the final window's overdraft lands in owned pages, never page 0.
 
-Per-row sampling settings are data (temperature [B]): greedy rows accept by
-exact argmax match; sampled rows use Leviathan-style rejection sampling.
-top_p is NOT supported on this path — truncation breaks the residual
-identity — so the engine routes any step whose batch contains a top_p < 1
-row through the plain decode step instead (engine.py `_step`).
+Per-row sampling settings are data (temperature [B], top_p [B]): greedy
+rows accept by exact argmax match; sampled rows use Leviathan-style
+rejection sampling. top_p composes with speculation by truncating BOTH
+distributions: the draft samples from its top-p-truncated dist q' and the
+verify accepts against the top-p-truncated target p' — the rejection
+identity (accept min(1, p'/q'), residual (p'-q')+) holds for any pair of
+distributions, and p' is exactly what the plain sampled path draws from,
+so outputs stay target-exact. Truncation uses the same top-k prefilter as
+sampling.py (`candidates`; full-vocab probabilities via logsumexp, no
+sort); candidates=0 disables the top-p path, and the engine then routes
+top_p<1 batches through the plain decode step instead.
 
 Both functions are pure; the engine jits them with its mesh out_shardings.
 """
@@ -29,13 +35,14 @@ import jax.numpy as jnp
 
 from ..models.config import ModelConfig
 from ..models.transformer import forward_paged, unembed
+from .sampling import truncated_dist
 
 
 def spec_prefill_fn(
     t_params, d_params, t_cfg: ModelConfig, d_cfg: ModelConfig,
     t_paged, d_paged,
     tokens, start, last_rel, page_table, key, temperature, top_p,
-    mesh=None,
+    candidates: int = 0, mesh=None,
 ):
     """Prefill BOTH caches for one window; first token from the TARGET.
 
@@ -56,7 +63,7 @@ def spec_prefill_fn(
     )
     last = hidden[0, last_rel[0]][None]
     logits = unembed(t_params, t_cfg, last)
-    token = sample_dynamic(logits, key, temperature, top_p)
+    token = sample_dynamic(logits, key, temperature, top_p, candidates)
     return token[0], t_paged, d_paged
 
 
@@ -64,7 +71,7 @@ def spec_decode_fn(
     t_params, d_params, t_cfg: ModelConfig, d_cfg: ModelConfig,
     t_paged, d_paged,
     last_tokens, seq_lens, page_tables, active, caps, key, temperature,
-    gamma: int, eos_id: int, mesh=None,
+    top_p, gamma: int, eos_id: int, candidates: int = 0, mesh=None,
 ):
     """One draft/verify round for the whole slot batch.
 
@@ -89,6 +96,9 @@ def spec_decode_fn(
     pos = jnp.maximum(seq_lens - 1, 0)
     greedy_row = temperature == 0.0                       # [B]
     temp = jnp.maximum(temperature, 1e-6)                 # [B]
+    # Greedy rows must see untruncated dists (their acceptance is argmax
+    # equality; truncation is irrelevant and top_p may be any value).
+    eff_top_p = jnp.where(greedy_row, 1.0, top_p)         # [B]
 
     # --- Draft gamma tokens autoregressively (bandwidth-light model). -----
     def draft_step(carry, k):
@@ -98,9 +108,13 @@ def spec_decode_fn(
             mesh=mesh,
         )
         logits = unembed(d_params, d_cfg, hidden[:, 0])   # [B, V]
-        dist = jax.nn.softmax(logits / temp[:, None], axis=-1)
+        dist = (
+            truncated_dist(logits, temp, eff_top_p, candidates)
+            if candidates
+            else jax.nn.softmax(logits / temp[:, None], axis=-1)
+        )
         sampled = jax.random.categorical(
-            k, logits / temp[:, None], axis=-1
+            k, jnp.log(jnp.maximum(dist, 1e-20)), axis=-1
         ).astype(jnp.int32)
         nxt = jnp.where(
             greedy_row, jnp.argmax(logits, axis=-1).astype(jnp.int32), sampled
@@ -137,7 +151,15 @@ def spec_decode_fn(
     t_choice = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # [B, γ+1]
     match = drafts == t_choice[:, :gamma]
 
-    t_probs = jax.nn.softmax(t_logits / temp[:, None, None], axis=-1)
+    if candidates:
+        t_probs = truncated_dist(
+            t_logits,
+            jnp.broadcast_to(temp[:, None], t_logits.shape[:2]),
+            jnp.broadcast_to(eff_top_p[:, None], t_logits.shape[:2]),
+            candidates,
+        )
+    else:
+        t_probs = jax.nn.softmax(t_logits / temp[:, None, None], axis=-1)
     key, ka = jax.random.split(key)
     u = jax.random.uniform(ka, (B, gamma))
     accept_sampled = rejection_accept(t_probs, d_dists, drafts, u)

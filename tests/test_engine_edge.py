@@ -99,6 +99,31 @@ def test_top_p_candidate_boundary_token_normalization():
     assert set(pre.tolist()) == {0, 1, 2, 3}, sorted(set(pre.tolist()))
 
 
+def test_truncated_dist_wide_candidates_still_truncates():
+    """candidates >= vocab must take the exact full-vocab truncation, not
+    silently skip the requested nucleus (review finding): the result must
+    equal the candidates=0 exact path, and tokens outside the top-p keep
+    set must carry zero mass."""
+    import numpy as np
+
+    from polykey_tpu.engine.sampling import truncated_dist
+
+    logits = jax.random.normal(jax.random.PRNGKey(9), (3, 32)) * 3.0
+    temp = jnp.array([1.0, 0.8, 1.2], jnp.float32)
+    top_p = jnp.array([0.6, 0.9, 1.0], jnp.float32)
+
+    exact = truncated_dist(logits, temp, top_p, 0)
+    wide = truncated_dist(logits, temp, top_p, 64)     # > vocab
+    narrow = truncated_dist(logits, temp, top_p, 32)   # == vocab
+    assert np.allclose(np.asarray(exact), np.asarray(wide), atol=1e-6)
+    assert np.allclose(np.asarray(exact), np.asarray(narrow), atol=1e-6)
+    # Row 0 (p=0.6) must have strictly truncated support; row 2 (p=1.0)
+    # must be the plain softmax.
+    assert int((np.asarray(exact)[0] > 0).sum()) < 32
+    sm = np.asarray(jax.nn.softmax(logits[2] / temp[2]))
+    assert np.allclose(np.asarray(exact)[2], sm, atol=1e-6)
+
+
 def test_shutdown_fails_inflight_requests():
     config = EngineConfig(
         model="tiny-llama", tokenizer="byte", dtype="float32",

@@ -110,14 +110,79 @@ def test_spec_sampled_completes():
 
 
 def test_spec_top_p_falls_back_to_plain():
-    # top_p<1 rows must take the plain step (identity would break); the
-    # request still completes and matches the plain engine's sampled path
-    # seed-for-seed is not guaranteed, so assert completion only.
+    # Without the top-k prefilter (top_p_candidates=0) top_p<1 rows take
+    # the plain step (full-vocab truncation inside the spec round would
+    # need per-step sorts); the request still completes. Matching the
+    # plain engine's sampled path seed-for-seed is not guaranteed, so
+    # assert completion only.
     outs, snap = _run_prompts(SPEC_CONFIG, temperature=0.8, top_p=0.9)
     assert all(len(t) >= 1 for t in outs)
     assert snap["requests_failed"] == 0
     # Every decode step had a top_p<1 batch → zero speculative rounds.
     assert "drafts_proposed" not in snap
+
+
+def test_spec_top_p_speculates_with_prefilter():
+    """With top_p_candidates set, top_p<1 batches stay on the speculative
+    path (truncated rejection sampling, spec_decode._truncated_dist) —
+    the batch-wide plain-step fallback and its acceptance collapse are
+    gone. Mixed greedy + sampled batches round through spec too."""
+    cfg = dataclasses.replace(SPEC_CONFIG, top_p_candidates=32)
+    outs, snap = _run_prompts(cfg, temperature=0.8, top_p=0.9)
+    assert all(len(t) >= 1 for t in outs)
+    assert snap["requests_failed"] == 0
+    assert snap.get("drafts_proposed", 0) > 0
+
+    # Mixed batch: one greedy + sampled rows concurrently.
+    eng = InferenceEngine(cfg)
+    try:
+        reqs = [
+            GenRequest(prompt="greedy row", max_new_tokens=6),
+            GenRequest(prompt="sampled row", max_new_tokens=6,
+                       temperature=0.9, top_p=0.8),
+        ]
+        for r in reqs:
+            eng.submit(r)
+        for r in reqs:
+            tokens, done, error = _collect(r)
+            assert error is None and done is not None and tokens
+        assert eng.metrics.snapshot().get("drafts_proposed", 0) > 0
+    finally:
+        eng.shutdown()
+
+
+def test_spec_top_p_truncated_acceptance_is_exact():
+    """Sharp identity check: with draft == target, the truncated
+    acceptance ratio p'/q' is exactly 1 for every draft, so a top_p<1
+    sampled stream must accept ALL drafts (acceptance 1.0) — any
+    asymmetry between the draft-side and verify-side truncation would
+    show up as rejections."""
+    import jax
+    import jax.numpy as jnp
+
+    from polykey_tpu.models.config import get_config
+    from polykey_tpu.models.transformer import init_params
+
+    cfg = dataclasses.replace(
+        SPEC_CONFIG, top_p_candidates=32, max_decode_slots=2
+    )
+    params = init_params(
+        jax.random.PRNGKey(5), get_config("tiny-llama"), jnp.float32
+    )
+    eng = InferenceEngine(cfg, params=params, draft_params=params)
+    try:
+        reqs = [GenRequest(prompt=f"identical {i}", max_new_tokens=12,
+                           temperature=1.0, top_p=0.7) for i in range(2)]
+        for r in reqs:
+            eng.submit(r)
+        for r in reqs:
+            tokens, done, error = _collect(r)
+            assert error is None and done is not None
+        snap = eng.metrics.snapshot()
+        assert snap["drafts_proposed"] > 0
+        assert snap["spec_acceptance"] == 1.0, snap
+    finally:
+        eng.shutdown()
 
 
 def test_spec_long_generation_budget_cap():
