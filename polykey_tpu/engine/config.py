@@ -112,13 +112,15 @@ class EngineConfig:
     # composing top-k=K with top-p, exact whenever the top-p support fits
     # in K. 0 → exact full-vocab sort. Greedy batches never sort either
     # way. Also enables top_p<1 requests on the SPECULATIVE path
-    # (truncated rejection sampling — spec_decode._truncated_dist); with
+    # (truncated rejection sampling — sampling.truncated_dist); with
     # 0, spec engines route top_p<1 batches through the plain step.
     top_p_candidates: int = 0
 
     # Speculative decoding (engine/spec_decode.py): a draft model name turns
     # it on; gamma = drafts per verify round. Draft must share the target's
-    # vocab. top_p<1 requests fall back to the plain decode step.
+    # vocab. top_p<1 requests ride the spec path when top_p_candidates > 0
+    # (truncated rejection sampling); otherwise they fall back to the
+    # plain decode step.
     draft_model: Optional[str] = None
     draft_checkpoint_path: Optional[str] = None  # None → random init
     spec_gamma: int = 4

@@ -1127,14 +1127,19 @@ class InferenceEngine:
             self._upload_slot_state()
         dev = self._dev
         # top_p composes with speculation via truncated rejection sampling
-        # (spec_decode._truncated_dist), which needs the top-k prefilter
+        # (sampling.truncated_dist), which needs the top-k prefilter
         # (top_p_candidates > 0) to avoid full-vocab sorts. Without the
         # prefilter, a batch containing any top_p<1 row takes the plain
         # step; note that blast radius is batch-wide — speculation is off
         # for every slot while such a row is active, and the plain steps
         # leave draft-cache holes, so acceptance stays collapsed for
         # surviving streams afterwards. Correctness never degrades.
-        all_untruncated = bool(np.all(self._top_p[self._active] >= 1.0))
+        # Greedy rows neutralize top_p inside the round (eff_top_p), so
+        # only SAMPLED rows with top_p<1 require the truncated variant.
+        act = self._active
+        all_untruncated = bool(np.all(
+            (self._top_p[act] >= 1.0) | (self._temperature[act] == 0.0)
+        ))
         if self._spec and (
             self.config.top_p_candidates > 0 or all_untruncated
         ):

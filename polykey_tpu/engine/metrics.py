@@ -41,6 +41,8 @@ class RequestTimings:
 class EngineMetrics:
     """Thread-safe counters; cheap enough to update from the step loop."""
 
+    _TTFT_WINDOW = 512   # recent-TTFT ring for percentile gauges
+
     def __init__(self):
         self._lock = threading.Lock()
         self.requests_admitted = 0
@@ -50,6 +52,8 @@ class EngineMetrics:
         self.decode_steps = 0
         self.ttft_ms_sum = 0.0
         self.ttft_ms_count = 0
+        self._ttft_ring: list[float] = []
+        self._ttft_ring_pos = 0
         self.drafts_accepted = 0
         self.drafts_proposed = 0
         self._window_start = time.monotonic()
@@ -88,6 +92,13 @@ class EngineMetrics:
             if timings.ttft_ms > 0:
                 self.ttft_ms_sum += timings.ttft_ms
                 self.ttft_ms_count += 1
+                if len(self._ttft_ring) < self._TTFT_WINDOW:
+                    self._ttft_ring.append(timings.ttft_ms)
+                else:
+                    self._ttft_ring[self._ttft_ring_pos] = timings.ttft_ms
+                self._ttft_ring_pos = (
+                    self._ttft_ring_pos + 1
+                ) % self._TTFT_WINDOW
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -105,6 +116,16 @@ class EngineMetrics:
                 "tokens_per_sec": round(self.tokens_per_sec, 2),
                 "mean_ttft_ms": round(mean_ttft, 2),
             }
+            if self._ttft_ring:
+                # p50/p95 over the recent window — TTFT is half the
+                # north-star metric and its tail, not its mean, is what
+                # operators chase.
+                ordered = sorted(self._ttft_ring)
+                n = len(ordered)
+                snap["p50_ttft_ms"] = round(ordered[n // 2], 2)
+                snap["p95_ttft_ms"] = round(
+                    ordered[min(n - 1, (n * 95) // 100)], 2
+                )
             if self.drafts_proposed:
                 snap["drafts_accepted"] = self.drafts_accepted
                 snap["drafts_proposed"] = self.drafts_proposed

@@ -78,11 +78,7 @@ def truncated_dist(
     else:
         # Exact full-vocab truncation (candidates disabled OR wider than
         # the vocabulary — never silently skip the requested nucleus).
-        sorted_scaled = jnp.sort(scaled, axis=-1)[..., ::-1]
-        keep = _top_p_keep_mask(sorted_scaled, top_p[..., None])
-        threshold = jnp.min(
-            jnp.where(keep, sorted_scaled, jnp.inf), axis=-1, keepdims=True
-        )
+        threshold = _top_p_threshold(scaled, top_p[..., None])
         trunc = jnp.where(scaled >= threshold, probs, 0.0)
     trunc = trunc / jnp.maximum(
         jnp.sum(trunc, axis=-1, keepdims=True), 1e-20
@@ -90,12 +86,20 @@ def truncated_dist(
     return jnp.where(top_p[..., None] >= 1.0, probs, trunc)
 
 
-def _apply_top_p(logits: jax.Array, p: float) -> jax.Array:
-    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
-    keep = _top_p_keep_mask(sorted_logits, jnp.float32(p))
-    threshold = jnp.min(
+def _top_p_threshold(scaled: jax.Array, p) -> jax.Array:
+    """Exact full-vocab top-p cut: the smallest kept logit (descending
+    sort + shared keep rule). ONE implementation — the exact sampler, the
+    static top-p filter, and the speculative truncated dists all cut at
+    this threshold, so tie handling cannot drift between paths."""
+    sorted_logits = jnp.sort(scaled, axis=-1)[..., ::-1]
+    keep = _top_p_keep_mask(sorted_logits, p)
+    return jnp.min(
         jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
     )
+
+
+def _apply_top_p(logits: jax.Array, p: float) -> jax.Array:
+    threshold = _top_p_threshold(logits, jnp.float32(p))
     return jnp.where(logits < threshold, -jnp.inf, logits)
 
 
@@ -151,12 +155,8 @@ def sample_dynamic(
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = logits / temp
 
-    # Per-row top-p on the scaled logits (sort + cumulative mass threshold).
-    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
-    keep = _top_p_keep_mask(sorted_logits, top_p[:, None])
-    threshold = jnp.min(
-        jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
-    )
+    # Per-row top-p on the scaled logits (shared sort + threshold rule).
+    threshold = _top_p_threshold(scaled, top_p[:, None])
     scaled = jnp.where(scaled < threshold, -jnp.inf, scaled)
 
     sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)

@@ -124,7 +124,7 @@ def test_spec_top_p_falls_back_to_plain():
 
 def test_spec_top_p_speculates_with_prefilter():
     """With top_p_candidates set, top_p<1 batches stay on the speculative
-    path (truncated rejection sampling, spec_decode._truncated_dist) —
+    path (truncated rejection sampling, sampling.truncated_dist) —
     the batch-wide plain-step fallback and its acceptance collapse are
     gone. Mixed greedy + sampled batches round through spec too."""
     cfg = dataclasses.replace(SPEC_CONFIG, top_p_candidates=32)
