@@ -44,7 +44,7 @@ from ..parallel.sharding import paged_kv_sharding, shard_params
 from .config import EngineConfig
 from .kv_cache import AllocationError, BlockAllocator, PagedKV, init_paged_kv
 from .metrics import EngineMetrics, RequestTimings
-from .sampling import sample_dynamic
+from .sampling import fold_positions, lane_keys, sample_dynamic_rows
 from .tokenizer import load_tokenizer
 
 
@@ -60,6 +60,16 @@ class GenRequest:
     max_new_tokens: int = 64
     temperature: float = 0.0
     top_p: float = 1.0
+    # Reproducibility root: on a plain (non-speculative) engine, identical
+    # (prompt, seed, params, sampling) yields an identical stream
+    # regardless of batch composition or scheduling — every sampled draw
+    # is keyed by fold_in(seed key, token position). Speculative engines
+    # guarantee greedy exactness and distributional reproducibility only:
+    # the spec path draws differently from the plain path, and which path
+    # a block takes can depend on batchmates (engine._dispatch_step).
+    # Seeds are taken mod 2**64. None → a fresh root from the engine's
+    # seed RNG.
+    seed: Optional[int] = None
     out: queue.Queue = field(default_factory=queue.Queue)
     cancelled: threading.Event = field(default_factory=threading.Event)
     timings: RequestTimings = field(default_factory=RequestTimings)
@@ -91,13 +101,14 @@ class _Slot:
     token_dev: Optional[jax.Array] = None
     token_row: int = 0
     merged: bool = False       # device lane activated (merge dispatched)
+    seed_row: Optional[np.ndarray] = None   # [2] int32 RNG root halves
     prompt_len: int = 0
     prompt_ids: Optional[np.ndarray] = None  # for prefix-cache insertion
 
 
 def _prefill_fn(
     params, cfg: ModelConfig, paged: PagedKV,
-    tokens, start, last_rel, page_table, key, temperature, top_p,
+    tokens, start, last_rel, page_table, seeds, temperature, top_p,
     *, greedy: bool, candidates: int = 0, mesh=None,
 ):
     """Prefill N windows (tokens [N, T]) at absolute positions
@@ -114,8 +125,8 @@ def _prefill_fn(
     `greedy` is a static variant selector: an all-greedy group takes a
     pure-argmax tail (no full-vocab sort, no RNG use) — at 128k-256k vocab
     the top-p sort is a real per-step cost, and greedy is the north-star
-    benchmark mode. The key threads through both variants so the engine
-    keeps one device-resident RNG chain.
+    benchmark mode. Sampled rows draw with fold_in(seed key, sampled
+    token's position) — per-request streams, batch-independent.
     """
     N, T = tokens.shape
     positions = start[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
@@ -124,15 +135,17 @@ def _prefill_fn(
     )
     last = hidden[jnp.arange(N), last_rel]                 # [N, H]
     logits = unembed(params, cfg, last)                    # [N, V]
-    token, new_key = _sample_tail(
-        logits, key, temperature, top_p, greedy, candidates
+    token = _sample_tail(
+        logits, seeds, start + last_rel + 1, temperature, top_p,
+        greedy, candidates,
     )
-    return token, new_key, paged
+    return token, paged
 
 
 def _decode_fn(
     params, cfg: ModelConfig, paged: PagedKV,
-    last_tokens, seq_lens, page_tables, active, caps, key, temperature, top_p,
+    last_tokens, seq_lens, page_tables, active, caps, seeds, temperature,
+    top_p,
     *, greedy: bool, steps: int, eos_id: int, candidates: int = 0, mesh=None,
 ):
     """`steps` decode steps for the whole slot batch in ONE dispatch.
@@ -157,32 +170,34 @@ def _decode_fn(
     """
 
     def one(carry, _):
-        last, seq, act, key, paged = carry
+        last, seq, act, paged = carry
         positions = jnp.maximum(seq - 1, 0)[:, None]       # [B, 1]
         hidden, paged = forward_paged(
             params, cfg, last[:, None], positions, paged, page_tables,
             mesh=mesh,
         )
         logits = unembed(params, cfg, hidden[:, 0])        # [B, V]
-        tokens, new_key = _sample_tail(
-            logits, key, temperature, top_p, greedy, candidates
+        # The new token lands at index seq → that position keys its draw.
+        tokens = _sample_tail(
+            logits, seeds, seq, temperature, top_p, greedy, candidates
         )
         tokens = jnp.where(act, tokens, 0)
         new_seq = seq + act.astype(jnp.int32)
         cont = act & (tokens != eos_id) & (new_seq < caps)
         packed = jnp.where(act, tokens, -1)
-        return (tokens, new_seq, cont, new_key, paged), packed
+        return (tokens, new_seq, cont, paged), packed
 
-    carry = (last_tokens, seq_lens, active, key, paged)
-    (last, seq, act, key, paged), packed = jax.lax.scan(
+    carry = (last_tokens, seq_lens, active, paged)
+    (last, seq, act, paged), packed = jax.lax.scan(
         one, carry, None, length=steps
     )
-    return packed, last, seq, act, key, paged
+    return packed, last, seq, act, paged
 
 
 def _merge_lane_fn(
     last_tokens, seq_lens, page_tables, active, caps, temperature, top_p,
-    tokens_vec, row, slot, seq_len, cap, temp, tp, table_row,
+    seeds, tokens_vec, row, slot, seq_len, cap, temp, tp, table_row,
+    seed_row,
     *, eos_id: int,
 ):
     """Activate ONE decode lane entirely on device: splice the prefill's
@@ -206,6 +221,7 @@ def _merge_lane_fn(
         caps.at[slot].set(cap),
         temperature.at[slot].set(temp),
         top_p.at[slot].set(tp),
+        seeds.at[slot].set(seed_row),
     )
 
 
@@ -226,16 +242,20 @@ def _retire_lane_fn(last_tokens, seq_lens, page_tables, active, caps, slot):
     )
 
 
-def _sample_tail(logits, key, temperature, top_p, greedy: bool,
-                 candidates: int = 0):
+def _sample_tail(logits, seeds, positions, temperature, top_p,
+                 greedy: bool, candidates: int = 0):
     """Shared sampling tail for prefill and decode: greedy takes pure
-    argmax and leaves the key chain untouched; otherwise split + per-row
-    dynamic sampling (optionally top-k-prefiltered, engine config
-    `top_p_candidates` — skips the [B, vocab] sort)."""
+    argmax (no RNG at all); sampled rows draw independently, each keyed
+    by fold_in(lane seed key, `positions[row]`) — deterministic per
+    (request seed, token position), so streams never depend on batch
+    composition, scheduling, or other requests (optionally
+    top-k-prefiltered via `top_p_candidates`, skipping the [B, vocab]
+    sort)."""
     if greedy:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32), key
-    new_key, sub = jax.random.split(key)
-    return sample_dynamic(logits, sub, temperature, top_p, candidates), new_key
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    base = lane_keys(seeds[:, 0], seeds[:, 1])
+    keys = fold_positions(base, positions)
+    return sample_dynamic_rows(logits, keys, temperature, top_p, candidates)
 
 
 _MAX_PREFILL_GROUP = 4   # burst admissions batched per prefill dispatch
@@ -329,7 +349,7 @@ class InferenceEngine:
             _prefill_fn,
             static_argnames=("cfg", "greedy", "candidates", "mesh"),
             donate_argnames=("paged",),
-            out_shardings=(self._repl, self._repl, self._pool_sharding),
+            out_shardings=(self._repl, self._pool_sharding),
         )
         self._dp_steps = NamedSharding(self.mesh, PartitionSpec(None, "dp"))
         self._jit_decode = jax.jit(
@@ -340,7 +360,7 @@ class InferenceEngine:
             donate_argnames=("paged",),
             out_shardings=(
                 self._dp_steps, self._dp_vec, self._dp_vec,
-                self._dp_vec, self._repl, self._pool_sharding,
+                self._dp_vec, self._pool_sharding,
             ),
         )
         # Lane merges: tiny functional updates of the device-resident decode
@@ -349,7 +369,7 @@ class InferenceEngine:
         # the chain keeps stable layouts).
         lane_out = (
             self._dp_vec, self._dp_vec, self._dp_mat, self._dp_vec,
-            self._dp_vec, self._dp_vec, self._dp_vec,
+            self._dp_vec, self._dp_vec, self._dp_vec, self._dp_mat,
         )
         self._jit_merge = jax.jit(
             _merge_lane_fn, static_argnames=("eos_id",),
@@ -358,6 +378,9 @@ class InferenceEngine:
         self._jit_retire = jax.jit(
             _retire_lane_fn, out_shardings=lane_out[:5],
         )
+        # Per-request RNG roots for seedless requests (GenRequest.seed
+        # None): drawn once per admission from the engine seed.
+        self._seed_rng = np.random.default_rng(seed + 3)
 
         if params is None:
             if config.checkpoint_path:
@@ -485,16 +508,11 @@ class InferenceEngine:
         self._caps = np.zeros((B,), dtype=np.int32)
         self._temperature = np.zeros((B,), dtype=np.float32)
         self._top_p = np.ones((B,), dtype=np.float32)
+        self._seeds = np.zeros((B, 2), dtype=np.int32)
         self._slots: list[Optional[_Slot]] = [None] * B
         self._dev: dict = {}
         self._dev_dirty = True
 
-        # Device-resident RNG chain: non-spec steps advance it inside the
-        # jitted call (zero per-step host ops); spec paths advance it via
-        # _advance_key (their jitted fns take a key but don't return one).
-        self._key_dev = jax.device_put(
-            jax.random.PRNGKey(seed + 1), self._repl
-        )
         self._submit: queue.Queue[GenRequest] = queue.Queue()
         # Lookahead pipeline: dispatched-but-unprocessed decode blocks,
         # oldest first. Kept at ≤ lookahead_blocks deep while dispatching.
@@ -746,7 +764,19 @@ class InferenceEngine:
 
         page_table = np.zeros((1, cfg.pages_per_seq), dtype=np.int32)
         page_table[0, : len(pages)] = pages
+        seed = request.seed
+        if seed is None:
+            seed = int(self._seed_rng.integers(0, 1 << 63))
+        # Injective packing of the seed's low 64 bits into two int32
+        # halves (uint32 wraparound, not masking to 31 bits — distinct
+        # 64-bit seeds must never collide to the same stream; seeds are
+        # taken mod 2**64).
+        s = seed & 0xFFFFFFFFFFFFFFFF
+        seed_row = np.array(
+            [(s >> 32) & 0xFFFFFFFF, s & 0xFFFFFFFF], np.uint32
+        ).view(np.int32)
         slot = _Slot(request=request, pages=pages, position_cap=total_len)
+        slot.seed_row = seed_row
         bucket = self._bucket_for(prompt_len)
 
         slot.table = page_table
@@ -807,7 +837,8 @@ class InferenceEngine:
             tokens = np.zeros((1, bucket), dtype=np.int32)
             tokens[0, : len(window_ids)] = window_ids
             token_dev = self._run_prefill(
-                tokens, start, len(window_ids) - 1, slot.table, slot.request
+                tokens, start, len(window_ids) - 1, slot.table, slot.request,
+                slot.seed_row,
             )
             self._merge_slot(slot_idx, slot, token_dev, 0)
         except Exception:
@@ -831,6 +862,7 @@ class InferenceEngine:
         tables = np.zeros((n_pad, cfg.pages_per_seq), dtype=np.int32)
         temp = np.zeros((n_pad,), dtype=np.float32)
         top_p = np.ones((n_pad,), dtype=np.float32)
+        seeds = np.zeros((n_pad, 2), dtype=np.int32)
         for r, (slot_idx, slot, ids, start) in enumerate(group):
             tokens[r, : len(ids)] = ids
             starts[r] = start                   # >0: prefix-cache suffix
@@ -838,16 +870,17 @@ class InferenceEngine:
             tables[r] = slot.table[0]
             temp[r] = slot.request.temperature
             top_p[r] = slot.request.top_p
+            seeds[r] = slot.seed_row
         greedy = bool(np.all(temp == 0.0))
 
         put = partial(jax.device_put, device=self._repl)
         try:
             with jax.profiler.TraceAnnotation("polykey/prefill"):
-                toks_dev, self._key_dev, self.paged = self._jit_prefill(
+                toks_dev, self.paged = self._jit_prefill(
                     self.params, self.model_cfg, self.paged,
                     jax.device_put(tokens, self._prefill_tok),
                     put(starts),
-                    put(last_rel), put(tables), self._key_dev,
+                    put(last_rel), put(tables), put(seeds),
                     put(temp), put(top_p),
                     greedy=greedy,
                     candidates=self.config.top_p_candidates,
@@ -880,7 +913,7 @@ class InferenceEngine:
         zrow = np.zeros((cfg.pages_per_seq,), np.int32)
         for bucket in cfg.prefill_buckets:
             for n in pads:
-                toks_dev, self._key_dev, self.paged = self._jit_prefill(
+                toks_dev, self.paged = self._jit_prefill(
                     self.params, self.model_cfg, self.paged,
                     jax.device_put(
                         np.zeros((n, bucket), np.int32), self._prefill_tok
@@ -888,7 +921,7 @@ class InferenceEngine:
                     put(np.zeros((n,), np.int32)),
                     put(np.zeros((n,), np.int32)),
                     put(np.zeros((n, cfg.pages_per_seq), np.int32)),
-                    self._key_dev,
+                    put(np.zeros((n, 2), np.int32)),
                     put(np.zeros((n,), np.float32)),
                     put(np.ones((n,), np.float32)),
                     greedy=True,
@@ -903,22 +936,22 @@ class InferenceEngine:
                     self._jit_merge(
                         dev["last_tokens"], dev["seq_lens"],
                         dev["page_tables"], dev["active"], dev["caps"],
-                        dev["temperature"], dev["top_p"],
+                        dev["temperature"], dev["top_p"], dev["seeds"],
                         toks_dev, np.int32(0), np.int32(0),
                         np.int32(1), np.int32(2), np.float32(0.0),
-                        np.float32(1.0), zrow,
+                        np.float32(1.0), zrow, np.zeros((2,), np.int32),
                         eos_id=self.tokenizer.eos_id,
                     )
         outs = self._jit_decode(
             self.params, self.model_cfg, self.paged,
             dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
-            dev["active"], dev["caps"], self._key_dev,
+            dev["active"], dev["caps"], dev["seeds"],
             dev["temperature"], dev["top_p"],
             greedy=True, steps=self._block_steps,
             eos_id=self.tokenizer.eos_id,
             candidates=self.config.top_p_candidates, mesh=self.mesh,
         )
-        *_, self._key_dev, self.paged = outs
+        *_, self.paged = outs
         self._jit_retire(
             dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
             dev["active"], dev["caps"], np.int32(0),
@@ -927,16 +960,10 @@ class InferenceEngine:
         # The dirty flag forces a fresh upload once real slots exist.
         self._dev_dirty = True
 
-    def _advance_key(self):
-        """Split the device-resident key chain; returns the subkey (for the
-        spec-decode jitted fns, which consume but don't return keys)."""
-        keys = jax.random.split(self._key_dev)
-        self._key_dev = keys[0]
-        return keys[1]
-
     def _run_prefill(
         self, tokens: np.ndarray, start: int, last_rel: int,
         page_table: np.ndarray, request: GenRequest,
+        seed_row: np.ndarray,
     ) -> jax.Array:
         """One prefill window at absolute offset `start`, sampling from
         relative index `last_rel`. Returns the sampled token as a DEVICE
@@ -949,6 +976,7 @@ class InferenceEngine:
             put(np.asarray([start], dtype=np.int32)),
             put(np.asarray([last_rel], dtype=np.int32)),
             put(np.ascontiguousarray(page_table)),
+            put(seed_row.reshape(1, 2)),
         )
         sampling = (
             put(np.asarray([request.temperature], dtype=np.float32)),
@@ -960,14 +988,14 @@ class InferenceEngine:
                     self.params, self.draft_params,
                     self.model_cfg, self.draft_cfg,
                     self.paged, self.d_paged,
-                    *common, self._advance_key(), *sampling,
+                    *common, *sampling,
                     candidates=self.config.top_p_candidates,
                     mesh=self.mesh,
                 )
             else:
-                first_token, self._key_dev, self.paged = self._jit_prefill(
+                first_token, self.paged = self._jit_prefill(
                     self.params, self.model_cfg, self.paged,
-                    *common, self._key_dev, *sampling,
+                    *common, *sampling,
                     greedy=request.temperature == 0.0,
                     candidates=self.config.top_p_candidates,
                     mesh=self.mesh,
@@ -993,13 +1021,15 @@ class InferenceEngine:
             (
                 dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
                 dev["active"], dev["caps"], dev["temperature"], dev["top_p"],
+                dev["seeds"],
             ) = self._jit_merge(
                 dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
                 dev["active"], dev["caps"], dev["temperature"], dev["top_p"],
+                dev["seeds"],
                 toks_dev, np.int32(row), np.int32(slot_idx),
                 np.int32(slot.prompt_len + 1), np.int32(slot.position_cap),
                 np.float32(request.temperature), np.float32(request.top_p),
-                slot.table[0],
+                slot.table[0], slot.seed_row,
                 eos_id=self.tokenizer.eos_id,
             )
         except Exception as e:
@@ -1022,6 +1052,7 @@ class InferenceEngine:
         self._caps[slot_idx] = slot.position_cap
         self._temperature[slot_idx] = request.temperature
         self._top_p[slot_idx] = request.top_p
+        self._seeds[slot_idx] = slot.seed_row
 
     def _resolve_prefills(self, block: bool = False) -> None:
         """Deliver first tokens whose async D2H copies have landed (all of
@@ -1088,6 +1119,7 @@ class InferenceEngine:
         try:
             token_dev = self._run_prefill(
                 tokens, slot.filled, take - 1, slot.table, request,
+                slot.seed_row,
             )
         except Exception as e:
             self._finish(slot_idx, error=f"prefill failed: {e}")
@@ -1110,6 +1142,7 @@ class InferenceEngine:
             "caps": jax.device_put(self._caps, self._dp_vec),
             "temperature": jax.device_put(self._temperature, self._dp_vec),
             "top_p": jax.device_put(self._top_p, self._dp_vec),
+            "seeds": jax.device_put(self._seeds, self._dp_mat),
         }
         self._dev_dirty = False
 
@@ -1148,9 +1181,7 @@ class InferenceEngine:
             )
             return (
                 "spec",
-                self._dispatch_spec(
-                    dev, self._advance_key(), spec_candidates
-                ),
+                self._dispatch_spec(dev, spec_candidates),
                 self._snapshot_requests(),
             )
         # Static variant: an all-greedy batch (the benchmark mode) skips
@@ -1158,7 +1189,7 @@ class InferenceEngine:
         # compiled variants exist; the mix flips only at slot transitions.
         greedy = bool(np.all(self._temperature[self._active] == 0.0))
         with jax.profiler.TraceAnnotation("polykey/decode"):
-            (packed_dev, last_dev, seq_dev, act_dev, self._key_dev,
+            (packed_dev, last_dev, seq_dev, act_dev,
              self.paged) = self._jit_decode(
                 self.params,
                 self.model_cfg,
@@ -1168,7 +1199,7 @@ class InferenceEngine:
                 dev["page_tables"],
                 dev["active"],
                 dev["caps"],
-                self._key_dev,
+                dev["seeds"],
                 dev["temperature"],
                 dev["top_p"],
                 greedy=greedy,
@@ -1246,7 +1277,7 @@ class InferenceEngine:
                     break
         self.metrics.on_step(emitted)
 
-    def _dispatch_spec(self, dev: dict, key, candidates: int = 0):
+    def _dispatch_spec(self, dev: dict, candidates: int = 0):
         """Dispatch one draft/verify round (spec_decode.py). `candidates`
         is 0 when every active row has top_p >= 1 — the round then skips
         all truncation work (plain softmax dists)."""
@@ -1257,7 +1288,7 @@ class InferenceEngine:
                 self.model_cfg, self.draft_cfg,
                 self.paged, self.d_paged,
                 dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
-                dev["active"], dev["caps"], jax.device_put(key, self._repl),
+                dev["active"], dev["caps"], dev["seeds"],
                 dev["temperature"], dev["top_p"], gamma=self._gamma,
                 eos_id=self.tokenizer.eos_id,
                 candidates=candidates, mesh=self.mesh,
@@ -1334,6 +1365,7 @@ class InferenceEngine:
         self._seq_lens[slot_idx] = 0
         self._last_tokens[slot_idx] = 0
         self._page_tables[slot_idx] = 0
+        self._seeds[slot_idx] = 0
         if slot.merged and self.dead is None and not self._stop.is_set():
             # Retire the device lane (stop stale-table writes) without
             # flushing the pipeline — a tiny chained dispatch, the mirror

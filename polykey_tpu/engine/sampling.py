@@ -103,6 +103,29 @@ def _apply_top_p(logits: jax.Array, p: float) -> jax.Array:
     return jnp.where(logits < threshold, -jnp.inf, logits)
 
 
+def _masked_rows(logits, temp, top_p, candidates: int):
+    """Shared top-p masking for the dynamic samplers. Returns
+    (greedy [B], masked [B, C or V], idx [B, C] | None, scaled_full):
+    categorical over `masked` (mapped through idx when present) realizes
+    the truncated distribution; `scaled_full` serves top_p >= 1 rows."""
+    if candidates and candidates < logits.shape[-1]:
+        scaled_full = logits / temp                       # [B, V]
+        lse = jax.scipy.special.logsumexp(
+            scaled_full, axis=-1, keepdims=True
+        )
+        vals, idx = jax.lax.top_k(scaled_full, candidates)  # desc [B, C]
+        greedy = idx[:, 0].astype(jnp.int32)
+        probs = jnp.exp(vals - lse)       # true full-vocab probabilities
+        keep = _prefix_keep_mask(probs, top_p[:, None])
+        return greedy, jnp.where(keep, vals, -jnp.inf), idx, scaled_full
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / temp
+    # Per-row top-p on the scaled logits (shared sort + threshold rule).
+    threshold = _top_p_threshold(scaled, top_p[:, None])
+    masked = jnp.where(scaled < threshold, -jnp.inf, scaled)
+    return greedy, masked, None, scaled
+
+
 def sample_dynamic(
     logits: jax.Array,            # [B, vocab] fp32
     key: jax.Array,
@@ -110,7 +133,8 @@ def sample_dynamic(
     top_p: jax.Array,             # [B] — 1.0 → disabled for that row
     candidates: int = 0,          # static: 0 → exact (full-vocab sort)
 ) -> jax.Array:
-    """Per-row sampling with *data-dependent* temperature/top-p.
+    """Per-row sampling with *data-dependent* temperature/top-p, one
+    shared RNG key for the whole batch.
 
     The continuous-batching decode step serves many requests with different
     sampling settings in one jitted call, so the settings arrive as arrays
@@ -129,17 +153,10 @@ def sample_dynamic(
     Pass candidates=0 for the exact full-vocab path.
     """
     temp = jnp.maximum(temperature, 1e-6)[:, None]
-
-    if candidates and candidates < logits.shape[-1]:
-        scaled_full = logits / temp                       # [B, V]
-        lse = jax.scipy.special.logsumexp(
-            scaled_full, axis=-1, keepdims=True
-        )
-        vals, idx = jax.lax.top_k(scaled_full, candidates)  # desc [B, C]
-        greedy = idx[:, 0].astype(jnp.int32)
-        probs = jnp.exp(vals - lse)       # true full-vocab probabilities
-        keep = _prefix_keep_mask(probs, top_p[:, None])
-        masked = jnp.where(keep, vals, -jnp.inf)
+    greedy, masked, idx, scaled_full = _masked_rows(
+        logits, temp, top_p, candidates
+    )
+    if idx is not None:
         k_pre, k_full = jax.random.split(key)
         local = jax.random.categorical(k_pre, masked, axis=-1)
         truncated = jnp.take_along_axis(
@@ -150,16 +167,63 @@ def sample_dynamic(
             k_full, scaled_full, axis=-1
         ).astype(jnp.int32)
         sampled = jnp.where(top_p >= 1.0, full, truncated)
-        return jnp.where(temperature == 0.0, greedy, sampled)
+    else:
+        sampled = jax.random.categorical(
+            key, masked, axis=-1
+        ).astype(jnp.int32)
+    return jnp.where(temperature == 0.0, greedy, sampled)
 
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    scaled = logits / temp
 
-    # Per-row top-p on the scaled logits (shared sort + threshold rule).
-    threshold = _top_p_threshold(scaled, top_p[:, None])
-    scaled = jnp.where(scaled < threshold, -jnp.inf, scaled)
+def _row_categorical(keys: jax.Array, logits: jax.Array) -> jax.Array:
+    """Independent per-row draws: keys [B, 2] uint32, logits [B, V] → [B]."""
+    return jax.vmap(
+        lambda k, l: jax.random.categorical(k, l)
+    )(keys, logits).astype(jnp.int32)
 
-    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+
+def lane_keys(seed_hi: jax.Array, seed_lo: jax.Array) -> jax.Array:
+    """Per-lane base PRNG keys [B, 2] from two int32 seed halves — the
+    engine's per-request RNG roots (engine.py: every sampled draw for a
+    request is keyed by fold_in(base, token position), so a request's
+    stream depends only on (seed, prompt), never on batch composition or
+    scheduling)."""
+    def one(hi, lo):
+        return jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(0), hi), lo
+        )
+
+    return jax.vmap(one)(seed_hi, seed_lo)
+
+
+def fold_positions(base_keys: jax.Array, positions: jax.Array) -> jax.Array:
+    """fold_in each lane's base key with its token position → [B, 2]."""
+    return jax.vmap(jax.random.fold_in)(base_keys, positions)
+
+
+def sample_dynamic_rows(
+    logits: jax.Array,            # [B, vocab] fp32
+    keys: jax.Array,              # [B, 2] uint32 — per-row keys
+    temperature: jax.Array,       # [B]
+    top_p: jax.Array,             # [B]
+    candidates: int = 0,
+) -> jax.Array:
+    """sample_dynamic with an independent RNG key per row — the engine's
+    seeded path. Identical masking (shared _masked_rows); only the draw
+    granularity differs."""
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    greedy, masked, idx, scaled_full = _masked_rows(
+        logits, temp, top_p, candidates
+    )
+    if idx is not None:
+        keys2 = jax.vmap(lambda k: jax.random.fold_in(k, 1))(keys)
+        local = _row_categorical(keys, masked)
+        truncated = jnp.take_along_axis(
+            idx, local[:, None], axis=-1
+        )[:, 0].astype(jnp.int32)
+        full = _row_categorical(keys2, scaled_full)
+        sampled = jnp.where(top_p >= 1.0, full, truncated)
+    else:
+        sampled = _row_categorical(keys, masked)
     return jnp.where(temperature == 0.0, greedy, sampled)
 
 

@@ -25,6 +25,16 @@ sampling.py (`candidates`; full-vocab probabilities via logsumexp, no
 sort); candidates=0 disables the top-p path, and the engine then routes
 top_p<1 batches through the plain decode step instead.
 
+RNG: every draw keys on fold_in(lane seed key, token position) plus a
+stream tag (draft sample / acceptance uniform / residual), so WITHIN the
+spec path a seeded request's randomness is reproducible. Note the spec
+path's sampled STREAM differs from the plain path's for the same seed
+(drafts draw from the draft model's distribution before acceptance), and
+which path a block takes can depend on batchmates (engine._dispatch_step
+gates on the whole batch) — so spec-enabled engines guarantee greedy
+exactness and distributional reproducibility, not draw-for-draw
+batch-independence; plain engines guarantee the full contract.
+
 Both functions are pure; the engine jits them with its mesh out_shardings.
 """
 
@@ -35,13 +45,19 @@ import jax.numpy as jnp
 
 from ..models.config import ModelConfig
 from ..models.transformer import forward_paged, unembed
-from .sampling import truncated_dist
+from .sampling import (
+    _row_categorical,
+    fold_positions,
+    lane_keys,
+    sample_dynamic_rows,
+    truncated_dist,
+)
 
 
 def spec_prefill_fn(
     t_params, d_params, t_cfg: ModelConfig, d_cfg: ModelConfig,
     t_paged, d_paged,
-    tokens, start, last_rel, page_table, key, temperature, top_p,
+    tokens, start, last_rel, page_table, seeds, temperature, top_p,
     candidates: int = 0, mesh=None,
 ):
     """Prefill BOTH caches for one window; first token from the TARGET.
@@ -51,8 +67,6 @@ def spec_prefill_fn(
     the draft pool: the draft model must see the full prompt or its
     proposals start from a cold cache and acceptance collapses.
     """
-    from .sampling import sample_dynamic
-
     T = tokens.shape[1]
     positions = start[0] + jnp.arange(T, dtype=jnp.int32)[None, :]
     hidden, t_paged = forward_paged(
@@ -63,14 +77,16 @@ def spec_prefill_fn(
     )
     last = hidden[0, last_rel[0]][None]
     logits = unembed(t_params, t_cfg, last)
-    token = sample_dynamic(logits, key, temperature, top_p, candidates)
+    base = lane_keys(seeds[:, 0], seeds[:, 1])            # [1, 2]
+    keys = fold_positions(base, start + last_rel + 1)
+    token = sample_dynamic_rows(logits, keys, temperature, top_p, candidates)
     return token[0], t_paged, d_paged
 
 
 def spec_decode_fn(
     t_params, d_params, t_cfg: ModelConfig, d_cfg: ModelConfig,
     t_paged, d_paged,
-    last_tokens, seq_lens, page_tables, active, caps, key, temperature,
+    last_tokens, seq_lens, page_tables, active, caps, seeds, temperature,
     top_p, gamma: int, eos_id: int, candidates: int = 0, mesh=None,
 ):
     """One draft/verify round for the whole slot batch.
@@ -96,12 +112,31 @@ def spec_decode_fn(
     pos = jnp.maximum(seq_lens - 1, 0)
     greedy_row = temperature == 0.0                       # [B]
     temp = jnp.maximum(temperature, 1e-6)                 # [B]
+    # Per-lane RNG roots; each draw keys on fold_in(base, token position)
+    # plus a stream tag, so draft sampling / acceptance / residual draws
+    # are independent AND a request's randomness is reproducible and
+    # batch-independent (same contract as the plain path's _sample_tail).
+    base = lane_keys(seeds[:, 0], seeds[:, 1])            # [B, 2]
+
+    def _tagged(positions, tag):
+        """Per-lane keys fold_in(fold_in(base, position), tag) for [B] or
+        [B, n] positions — THE key-derivation scheme; acceptance uniforms
+        and residual draws must use this same helper so the (seed,
+        position, tag) contract cannot drift between streams."""
+        def one(base_row, p):
+            return jax.random.fold_in(jax.random.fold_in(base_row, p), tag)
+
+        if positions.ndim == 1:
+            return jax.vmap(one)(base, positions)
+        return jax.vmap(
+            lambda b, ps: jax.vmap(lambda q: one(b, q))(ps)
+        )(base, positions)
     # Greedy rows must see untruncated dists (their acceptance is argmax
     # equality; truncation is irrelevant and top_p may be any value).
     eff_top_p = jnp.where(greedy_row, 1.0, top_p)         # [B]
 
     # --- Draft gamma tokens autoregressively (bandwidth-light model). -----
-    def draft_step(carry, k):
+    def draft_step(carry, _):
         d_paged, tok, p = carry
         hidden, d_paged = forward_paged(
             d_params, d_cfg, tok[:, None], p[:, None], d_paged, page_tables,
@@ -113,17 +148,16 @@ def spec_decode_fn(
             if candidates
             else jax.nn.softmax(logits / temp[:, None], axis=-1)
         )
-        sampled = jax.random.categorical(
-            k, jnp.log(jnp.maximum(dist, 1e-20)), axis=-1
-        ).astype(jnp.int32)
+        sampled = _row_categorical(
+            _tagged(p + 1, 101), jnp.log(jnp.maximum(dist, 1e-20))
+        )
         nxt = jnp.where(
             greedy_row, jnp.argmax(logits, axis=-1).astype(jnp.int32), sampled
         )
         return (d_paged, nxt, p + 1), (nxt, dist)
 
-    key, kd = jax.random.split(key)
     (d_paged, _, _), (drafts, d_dists) = jax.lax.scan(
-        draft_step, (d_paged, last_tokens, pos), jax.random.split(kd, gamma)
+        draft_step, (d_paged, last_tokens, pos), None, length=gamma
     )
     drafts = drafts.T                                     # [B, gamma]
     d_dists = jnp.swapaxes(d_dists, 0, 1)                 # [B, gamma, V]
@@ -150,6 +184,7 @@ def spec_decode_fn(
 
     t_choice = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # [B, γ+1]
     match = drafts == t_choice[:, :gamma]
+    draft_idx = pos[:, None] + 1 + jnp.arange(gamma, dtype=jnp.int32)[None, :]
 
     if candidates:
         t_probs = truncated_dist(
@@ -160,8 +195,9 @@ def spec_decode_fn(
         )
     else:
         t_probs = jax.nn.softmax(t_logits / temp[:, None, None], axis=-1)
-    key, ka = jax.random.split(key)
-    u = jax.random.uniform(ka, (B, gamma))
+    u = jax.vmap(jax.vmap(lambda k: jax.random.uniform(k)))(
+        _tagged(draft_idx, 102)
+    )                                                     # [B, gamma]
     accept_sampled = rejection_accept(t_probs, d_dists, drafts, u)
 
     accept = jnp.where(greedy_row[:, None], match, accept_sampled)
@@ -171,10 +207,9 @@ def spec_decode_fn(
     # Extra token: target argmax at the frontier (greedy) / residual or
     # bonus sample (sampled rows) [Leviathan et al. 2023].
     dist = residual_extra_dist(t_probs, d_dists, n_acc)
-    key, kr = jax.random.split(key)
-    extra_sampled = jax.random.categorical(
-        kr, jnp.log(jnp.maximum(dist, 1e-20)), axis=-1
-    ).astype(jnp.int32)
+    extra_sampled = _row_categorical(
+        _tagged(pos + 1 + n_acc, 103), jnp.log(jnp.maximum(dist, 1e-20))
+    )
     extra = jnp.where(greedy_row, t_choice[rows, n_acc], extra_sampled)
 
     # --- Emit accepted prefix + extra; advance per-row state. -------------

@@ -103,6 +103,9 @@ class TpuService(Service):
             # degenerate values (negative temp, top_p=0) reach the sampler.
             temperature=max(0.0, float(params.get("temperature", 0.0))),
             top_p=min(1.0, max(0.0, float(params.get("top_p", 1.0)))),
+            # Reproducible sampling: same (prompt, seed, sampling) → same
+            # stream regardless of batch composition (engine.GenRequest).
+            seed=(int(params["seed"]) if "seed" in params else None),
         )
 
     def _drain(self, request: GenRequest, timeout: float):

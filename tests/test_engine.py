@@ -262,6 +262,61 @@ def test_lookahead_depth_greedy_equality():
     assert run(4, 3) == run(1, 1)
 
 
+def test_seeded_sampling_batch_independent():
+    """A seeded sampled request must produce an identical stream no matter
+    what else is in the batch, which engine geometry serves it, or how
+    scheduling interleaves — every draw is keyed by (request seed, token
+    position), not by a shared RNG chain. Different seeds must diverge."""
+    import dataclasses
+
+    def run(cfg, companions):
+        eng = InferenceEngine(cfg)
+        try:
+            target = GenRequest(prompt="seeded stream", max_new_tokens=10,
+                                temperature=1.0, top_p=0.9, seed=42)
+            others = [
+                GenRequest(prompt=f"noise {i}", max_new_tokens=8,
+                           temperature=0.7, seed=100 + i)
+                for i in range(companions)
+            ]
+            for r in [*others[:companions // 2], target,
+                      *others[companions // 2:]]:
+                eng.submit(r)
+            result = None
+            for r in [target, *others]:
+                tokens, done, error = _collect(r)
+                assert error is None and done is not None
+                if r is target:
+                    result = tokens
+            return result
+        finally:
+            eng.shutdown()
+
+    alone = run(TEST_CONFIG, 0)
+    crowded = run(TEST_CONFIG, 3)
+    other_geometry = run(
+        dataclasses.replace(
+            TEST_CONFIG, max_decode_slots=2, decode_block_steps=2,
+            lookahead_blocks=3,
+        ),
+        1,
+    )
+    assert alone == crowded == other_geometry
+    assert len(alone) > 1
+
+    different_seed = None
+    eng = InferenceEngine(TEST_CONFIG)
+    try:
+        r = GenRequest(prompt="seeded stream", max_new_tokens=10,
+                       temperature=1.0, top_p=0.9, seed=43)
+        eng.submit(r)
+        different_seed, done, error = _collect(r)
+        assert error is None
+    finally:
+        eng.shutdown()
+    assert different_seed != alone
+
+
 def test_cancellation_frees_slot(engine):
     request = GenRequest(prompt="cancel me", max_new_tokens=32, temperature=1.0)
     engine.submit(request)
