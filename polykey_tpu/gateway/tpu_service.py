@@ -7,9 +7,12 @@ serving engine instead of proxying to an external API. Zero external calls.
 
 Tools:
 - ``llm_generate`` (alias ``generate``) — params: prompt (string, required),
-  max_tokens, temperature, top_p. Unary returns the full completion as
-  string_output; the streaming RPC emits incremental UTF-8-safe deltas and a
-  terminal chunk with Usage (TTFT, tok/s).
+  max_tokens, temperature, top_p, seed, stop (string or list of strings:
+  generation cuts BEFORE the earliest match, which is never emitted; the
+  engine request is cancelled so no further compute is spent). Unary
+  returns the full completion as string_output; the streaming RPC emits
+  incremental UTF-8-safe deltas and a terminal chunk with Usage (TTFT,
+  tok/s).
 - ``engine_stats`` — struct_output snapshot of engine metrics and pool state.
 - the reference's mock tools (example_tool / struct_tool / file_tool) keep
   their exact semantics via delegation to MockService, so a client of the
@@ -105,8 +108,23 @@ class TpuService(Service):
             top_p=min(1.0, max(0.0, float(params.get("top_p", 1.0)))),
             # Reproducible sampling: same (prompt, seed, sampling) → same
             # stream regardless of batch composition (engine.GenRequest).
-            seed=(int(params["seed"]) if "seed" in params else None),
+            seed=self._parse_seed(params),
         )
+
+    @staticmethod
+    def _parse_seed(params: dict):
+        if "seed" not in params:
+            return None
+        sv = params["seed"]
+        # Struct numbers are IEEE doubles: beyond 2^53 distinct integers
+        # collapse to the same float, silently breaking the documented
+        # distinct-seeds-never-collide contract — reject instead.
+        if isinstance(sv, float) and (sv != int(sv) or abs(sv) > 2 ** 53):
+            raise ValueError(
+                "'seed' must be an integer with |seed| <= 2**53 (JSON "
+                "numbers are doubles; larger seeds would silently collide)"
+            )
+        return int(sv)
 
     def _drain(self, request: GenRequest, timeout: float):
         """Yield engine events until done/error; raises on timeout."""
@@ -119,6 +137,109 @@ class TpuService(Service):
             yield kind, value
             if kind in ("done", "error"):
                 return
+
+    @staticmethod
+    def _parse_stops(params: dict) -> list[str]:
+        stop = params.get("stop")
+        if stop is None:
+            return []
+        if isinstance(stop, str):
+            return [stop] if stop else []
+        import collections.abc
+
+        if isinstance(stop, (dict, collections.abc.Mapping, struct_pb2.Struct)):
+            # A mapping would silently iterate its KEYS as stop strings.
+            raise ValueError("'stop' must be a string or a list of strings")
+        try:
+            stops = [s for s in stop]
+        except TypeError:
+            raise ValueError(
+                "'stop' must be a string or a list of strings"
+            ) from None
+        if not all(isinstance(s, str) and s for s in stops):
+            raise ValueError("'stop' entries must be non-empty strings")
+        return stops
+
+    def _text_events(self, request: GenRequest, stops: list[str]):
+        """Decode engine tokens into text deltas, applying stop sequences:
+        yields ("delta", str) then ("done", timings | None).
+
+        Stop handling holds back up to max(len(stop))-1 trailing chars so
+        a stop string arriving split across deltas is still caught and
+        never emitted; on a match the engine request is cancelled (no
+        further device work) and the stream ends cleanly at the text
+        BEFORE the earliest match. The engine's own "cancelled" error is
+        the expected outcome of that cancellation, not a failure.
+        """
+        tokenizer = self.engine.tokenizer
+        incremental = isinstance(tokenizer, ByteTokenizer)
+        utf8_tail = b""
+        all_ids: list[int] = []
+        emitted = ""
+        hold = max((len(s) for s in stops), default=1) - 1
+        buf = ""
+        stopped = False
+        timings = None
+        for kind, value in self._drain(
+            request, self.engine.config.request_timeout_s
+        ):
+            if kind == "token":
+                if incremental:
+                    delta, utf8_tail = tokenizer.decode_incremental(
+                        [value], utf8_tail
+                    )
+                else:
+                    # HF detokenization is context-dependent: re-decode
+                    # the full prefix and emit the textual diff.
+                    all_ids.append(value)
+                    text = tokenizer.decode(all_ids)
+                    delta, emitted = text[len(emitted):], text
+                if not delta:
+                    continue
+                if not stops:
+                    yield "delta", delta
+                    continue
+                buf += delta
+                cut = min(
+                    (i for i in (buf.find(s) for s in stops) if i >= 0),
+                    default=-1,
+                )
+                if cut >= 0:
+                    if buf[:cut]:
+                        yield "delta", buf[:cut]
+                    buf = ""
+                    stopped = True
+                    request.cancelled.set()
+                    break
+                if hold and len(buf) > hold:
+                    yield "delta", buf[:-hold]
+                    buf = buf[-hold:]
+                elif not hold:
+                    yield "delta", buf
+                    buf = ""
+            elif kind == "error":
+                raise RuntimeError(value)
+            else:
+                timings = value
+        if stopped:
+            # Drain the terminal event the cancellation produces so the
+            # engine's queue is not abandoned mid-handshake; the output is
+            # already complete, so even a drain timeout must not destroy
+            # it. Timings live on the request object (engine._finish fills
+            # them for cancelled requests too), so Usage survives the
+            # cancellation path.
+            try:
+                for kind, value in self._drain(
+                    request, self.engine.config.request_timeout_s
+                ):
+                    if kind in ("done", "error"):
+                        break
+            except TimeoutError:
+                pass
+            timings = request.timings
+        elif buf:
+            yield "delta", buf
+        yield "done", timings
 
     # -- Service interface --------------------------------------------------
 
@@ -175,17 +296,31 @@ class TpuService(Service):
         if tool_name not in _LLM_TOOLS:
             return self._mock.execute_tool(tool_name, parameters, secret_id, metadata)
 
+        params = dict(parameters) if parameters is not None else {}
         request = self._build_request(parameters)
+        stops = self._parse_stops(params)
         self.engine.submit(request)
 
-        token_ids: list[int] = []
-        for kind, value in self._drain(request, self.engine.config.request_timeout_s):
-            if kind == "token":
-                token_ids.append(value)
-            elif kind == "error":
-                raise RuntimeError(value)
+        if not stops:
+            # No stop scanning → no per-token decode: collect ids and
+            # detokenize once (the diff-decode in _text_events is
+            # O(n^2) host work for context-dependent tokenizers).
+            token_ids: list[int] = []
+            for kind, value in self._drain(
+                request, self.engine.config.request_timeout_s
+            ):
+                if kind == "token":
+                    token_ids.append(value)
+                elif kind == "error":
+                    raise RuntimeError(value)
+            text = self.engine.tokenizer.decode(token_ids)
+        else:
+            pieces: list[str] = []
+            for kind, value in self._text_events(request, stops):
+                if kind == "delta":
+                    pieces.append(value)
+            text = "".join(pieces)
 
-        text = self.engine.tokenizer.decode(token_ids)
         response = pk.ExecuteToolResponse(
             status=cmn.Status(code=200, message="Tool executed successfully"),
             string_output=text,
@@ -202,34 +337,16 @@ class TpuService(Service):
             )
             return
 
+        params = dict(parameters) if parameters is not None else {}
         request = self._build_request(parameters)
+        stops = self._parse_stops(params)
         self.engine.submit(request)
 
-        tokenizer = self.engine.tokenizer
-        incremental = isinstance(tokenizer, ByteTokenizer)
-        utf8_tail = b""
-        all_ids: list[int] = []
-        emitted = ""
         timings = None
         try:
-            for kind, value in self._drain(
-                request, self.engine.config.request_timeout_s
-            ):
-                if kind == "token":
-                    if incremental:
-                        delta, utf8_tail = tokenizer.decode_incremental(
-                            [value], utf8_tail
-                        )
-                    else:
-                        # HF detokenization is context-dependent: re-decode
-                        # the full prefix and emit the textual diff.
-                        all_ids.append(value)
-                        text = tokenizer.decode(all_ids)
-                        delta, emitted = text[len(emitted):], text
-                    if delta:
-                        yield pk.ExecuteToolStreamChunk(delta=delta)
-                elif kind == "error":
-                    raise RuntimeError(value)
+            for kind, value in self._text_events(request, stops):
+                if kind == "delta":
+                    yield pk.ExecuteToolStreamChunk(delta=value)
                 else:
                     timings = value
         except GeneratorExit:

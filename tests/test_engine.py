@@ -262,6 +262,67 @@ def test_lookahead_depth_greedy_equality():
     assert run(4, 3) == run(1, 1)
 
 
+def test_stop_sequences():
+    """`stop` cuts generation BEFORE the earliest match, never emits the
+    stop text (even when it spans delta boundaries — every byte-tokenizer
+    delta is one char, so any multi-char stop spans), and cancels the
+    engine request. Unary and streaming agree.
+
+    Uses an ASCII-vocab model variant (vocab 96 → every generated id
+    renders one byte) so greedy output is dense text; tiny-llama's 512
+    vocab mostly lands outside the byte tokenizer's range."""
+    import dataclasses
+
+    from google.protobuf import struct_pb2
+
+    from polykey_tpu.gateway.tpu_service import TpuService
+    from polykey_tpu.models.config import MODEL_REGISTRY, TINY_LLAMA
+
+    MODEL_REGISTRY.setdefault(
+        "tiny-llama-ascii",
+        dataclasses.replace(TINY_LLAMA, name="tiny-llama-ascii", vocab_size=96),
+    )
+    eng = InferenceEngine(
+        dataclasses.replace(TEST_CONFIG, model="tiny-llama-ascii")
+    )
+    service = TpuService(eng)
+    try:
+        def run(stop=None, stream=False):
+            params = struct_pb2.Struct()
+            d = {"prompt": "stop test prompt", "max_tokens": 24}
+            if stop is not None:
+                d["stop"] = stop
+            params.update(d)
+            if stream:
+                chunks = list(
+                    service.execute_tool_stream(
+                        "llm_generate", params, None, None
+                    )
+                )
+                return "".join(c.delta for c in chunks)
+            return service.execute_tool(
+                "llm_generate", params, None, None
+            ).string_output
+
+        full = run()
+        assert len(full) >= 6, repr(full)
+        stop = full[3:6]            # guaranteed mid-stream match
+        cut = run(stop=stop)
+        assert cut == full[: full.index(stop)]
+        assert stop not in cut
+        assert run(stop=stop, stream=True) == cut
+        # List form; a never-matching stop leaves the output unchanged.
+        assert run(stop=["@@never@@", stop]) == cut
+        assert run(stop="@@never@@") == full
+        # Invalid stop types are rejected.
+        import pytest as _pytest
+
+        with _pytest.raises(Exception):
+            run(stop=[""])
+    finally:
+        eng.shutdown()
+
+
 def test_seeded_sampling_batch_independent():
     """A seeded sampled request must produce an identical stream no matter
     what else is in the batch, which engine geometry serves it, or how
