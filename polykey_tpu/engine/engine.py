@@ -13,14 +13,25 @@ Design:
 - Static shapes everywhere: the decode batch is a fixed array of
   `max_decode_slots` slots; prompts prefill through a small set of padded
   length buckets. Slot occupancy is data (`active` mask), not shape.
-- Step loop: admit (prefill one request per free slot) → decode one step for
-  all active slots → deliver tokens → retire finished slots. Prefills
-  interleave between decode steps, so running streams stall for at most one
-  prefill bucket.
+- Latency-tolerant loop: decode runs in K-step blocks (one lax.scan
+  dispatch each, device-side EOS/cap stopping), and up to
+  `lookahead_blocks` blocks stay in flight while the host reads one
+  block behind through async D2H copies. Admissions prefill in padded
+  buckets (batched for bursts, chunked for long prompts) and activate
+  their lanes via tiny on-device merge dispatches — no sync, no pipeline
+  flush; retirements dispatch the mirror-image lane reset. Dispatch is
+  asynchronous and effectively free; only first syncs of fresh results
+  pay the host↔device roundtrip (PERF.md), so steady state pays ~one
+  hidden sync per block regardless of latency.
 - Inactive slots point their page tables at the reserved garbage page 0 and
   carry position 0; their lanes compute masked garbage that is never read.
 - Page pools are donated through every jitted step (in-place update — the
-  pool is by far the largest buffer).
+  pool is by far the largest buffer); the donation chain also totally
+  orders every dispatch on the device, which is what makes stale
+  in-flight blocks' writes safe (see _retire_lane_fn / _merge_slot).
+- RNG: no global chain — per-lane seed halves ride the device state and
+  every sampled draw keys on fold_in(seed key, token position)
+  (GenRequest.seed).
 """
 
 from __future__ import annotations
