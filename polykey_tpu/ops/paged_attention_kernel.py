@@ -306,7 +306,14 @@ def _decode_call(
 
 def use_paged_kernel(num_kv_heads: int, head_dim: int) -> bool:
     """The DMA kernel needs TPU hardware; the folded head-lane dimension
-    (num_kv_heads · head_dim) must be 128-aligned for DMA tiling."""
+    (num_kv_heads · head_dim) must be 128-aligned for DMA tiling.
+    POLYKEY_DISABLE_PAGED_KERNEL=1 is the operational kill-switch: the
+    gather path serves every geometry, so a kernel-compile regression on
+    new hardware must never take the whole TPU path down."""
+    import os
+
+    if os.environ.get("POLYKEY_DISABLE_PAGED_KERNEL", "").lower() in ("1", "true"):
+        return False
     return jax.default_backend() == "tpu" and (num_kv_heads * head_dim) % 128 == 0
 
 

@@ -231,6 +231,23 @@ def test_flash_kernel_shard_mapped_on_mesh():
     assert float(jnp.max(jnp.abs(ref - out))) < TOL
 
 
+def test_kernel_kill_switches(monkeypatch):
+    """POLYKEY_DISABLE_PAGED_KERNEL / POLYKEY_DISABLE_FLASH force the jnp
+    paths regardless of backend — the operational escape hatch if a
+    Mosaic compile regresses on new hardware."""
+    from polykey_tpu.ops.flash_attention import use_flash
+    from polykey_tpu.ops.paged_attention_kernel import use_paged_kernel
+
+    monkeypatch.setenv("POLYKEY_DISABLE_PAGED_KERNEL", "1")
+    monkeypatch.setenv("POLYKEY_DISABLE_FLASH", "1")
+    assert not use_paged_kernel(8, 128)
+    assert not use_flash(512, 512, 128)
+    monkeypatch.delenv("POLYKEY_DISABLE_PAGED_KERNEL")
+    monkeypatch.delenv("POLYKEY_DISABLE_FLASH")
+    # Back to backend-driven dispatch (False on CPU, True on TPU).
+    assert use_paged_kernel(8, 128) == (jax.default_backend() == "tpu")
+
+
 def test_paged_decode_fallback_off_tpu():
     q, kp, vp, pt, pos = _paged_case(2, 4, 2, 24, 8, 4, [[3], [19]])
     ref = paged_attention(q, kp, vp, pt, pos, scale=0.3)
