@@ -1,0 +1,110 @@
+"""Randomized full-feature-matrix stress for the serving stack.
+
+Hammers a spec-enabled, prefix-cached, pipelined engine through the
+TpuService layer with mixed greedy/sampled/seeded/top-p requests, stop
+sequences, and mid-stream client cancellations, then asserts no errors
+and no page leaks. This is the exploratory big sibling of the checked-in
+soak test (tests/test_engine_soak.py) — run it after engine-loop surgery.
+
+Env: STRESS_SECONDS (default 120), STRESS_WORKERS (default 12).
+Run: python scripts/stress_matrix.py   (CPU; forces jax_platforms=cpu)
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+
+def main() -> None:
+    jax.config.update("jax_platforms", "cpu")
+
+    from google.protobuf import struct_pb2
+
+    from polykey_tpu.engine.config import EngineConfig
+    from polykey_tpu.engine.engine import InferenceEngine
+    from polykey_tpu.gateway.tpu_service import TpuService
+
+    seconds = float(os.environ.get("STRESS_SECONDS", "120"))
+    workers = int(os.environ.get("STRESS_WORKERS", "12"))
+
+    cfg = EngineConfig(
+        model="tiny-llama", tokenizer="byte", dtype="float32",
+        max_decode_slots=4, page_size=8, num_pages=96, max_seq_len=64,
+        prefill_buckets=(16, 32), max_new_tokens_cap=24,
+        draft_model="tiny-llama", spec_gamma=3, top_p_candidates=32,
+        prefix_cache=True, lookahead_blocks=3, decode_block_steps=4,
+    )
+    eng = InferenceEngine(cfg)
+    svc = TpuService(eng)
+    rng = random.Random(0)
+    errors: list[str] = []
+    done_count, cancels = [0], [0]
+    deadline = time.monotonic() + seconds
+
+    def worker(wid: int) -> None:
+        wrng = random.Random(1000 + wid)
+        while time.monotonic() < deadline and len(errors) < 5:
+            p = struct_pb2.Struct()
+            d = {
+                "prompt": wrng.choice(
+                    ["shared prefix " * 3, "zq", "mixed load " * 2]
+                ) + str(wrng.randrange(5)),
+                "max_tokens": wrng.randrange(1, 20),
+            }
+            if wrng.random() < 0.5:
+                d["temperature"] = wrng.uniform(0.2, 1.2)
+                if wrng.random() < 0.5:
+                    d["top_p"] = wrng.uniform(0.3, 1.0)
+                if wrng.random() < 0.5:
+                    d["seed"] = wrng.randrange(1 << 40)
+            if wrng.random() < 0.3:
+                d["stop"] = wrng.choice(["%", "ab", ["x", "%%"]])
+            p.update(d)
+            try:
+                if wrng.random() < 0.5:
+                    it = svc.execute_tool_stream("llm_generate", p, None, None)
+                    for _ in it:
+                        if wrng.random() < 0.05:
+                            it.close()
+                            cancels[0] += 1
+                            break
+                else:
+                    svc.execute_tool("llm_generate", p, None, None)
+                done_count[0] += 1
+            except Exception as e:  # any error fails the run
+                errors.append(f"w{wid}: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    time.sleep(2)
+    free = eng.allocator.num_free
+    snap = eng.stats()
+    eng.shutdown()
+
+    print(f"requests done: {done_count[0]}, client cancels: {cancels[0]}")
+    print("errors:", errors[:5])
+    # Free pages = pool minus reserved page minus live prefix-cache refs.
+    floor = cfg.num_pages - 1 - snap.get("prefix_cache_pages", 0)
+    print(f"pages free: {free} (floor given cache refs: {floor})")
+    assert not errors, errors
+    assert free >= floor, (free, floor)
+    print("STRESS OK", {
+        k: snap[k]
+        for k in ("requests_completed", "tokens_generated", "spec_acceptance")
+        if k in snap
+    })
+
+
+if __name__ == "__main__":
+    main()

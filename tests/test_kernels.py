@@ -234,18 +234,24 @@ def test_flash_kernel_shard_mapped_on_mesh():
 def test_kernel_kill_switches(monkeypatch):
     """POLYKEY_DISABLE_PAGED_KERNEL / POLYKEY_DISABLE_FLASH force the jnp
     paths regardless of backend — the operational escape hatch if a
-    Mosaic compile regresses on new hardware."""
-    from polykey_tpu.ops.flash_attention import use_flash
-    from polykey_tpu.ops.paged_attention_kernel import use_paged_kernel
+    Mosaic compile regresses on new hardware. The backend is patched to
+    "tpu" so the env check is what flips the result (on CPU both
+    predicates are False anyway and the asserts would be vacuous)."""
+    from polykey_tpu.ops import flash_attention as fa
+    from polykey_tpu.ops import paged_attention_kernel as pak
 
-    monkeypatch.setenv("POLYKEY_DISABLE_PAGED_KERNEL", "1")
-    monkeypatch.setenv("POLYKEY_DISABLE_FLASH", "1")
-    assert not use_paged_kernel(8, 128)
-    assert not use_flash(512, 512, 128)
+    monkeypatch.setattr(fa.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(pak.jax, "default_backend", lambda: "tpu")
+    assert pak.use_paged_kernel(8, 128)
+    assert fa.use_flash(512, 512, 128)
+    for v in ("1", "true"):
+        monkeypatch.setenv("POLYKEY_DISABLE_PAGED_KERNEL", v)
+        monkeypatch.setenv("POLYKEY_DISABLE_FLASH", v)
+        assert not pak.use_paged_kernel(8, 128)
+        assert not fa.use_flash(512, 512, 128)
     monkeypatch.delenv("POLYKEY_DISABLE_PAGED_KERNEL")
     monkeypatch.delenv("POLYKEY_DISABLE_FLASH")
-    # Back to backend-driven dispatch (False on CPU, True on TPU).
-    assert use_paged_kernel(8, 128) == (jax.default_backend() == "tpu")
+    assert pak.use_paged_kernel(8, 128)
 
 
 def test_paged_decode_fallback_off_tpu():
