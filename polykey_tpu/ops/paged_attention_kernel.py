@@ -24,9 +24,16 @@ VMEM; their logits are masked, and V is zeroed on those rows so masked
 weights never multiply uninitialized data (0·NaN would poison the
 accumulator).
 
+The kernel emits UNNORMALIZED online-softmax state (acc, m, l) over a
+page sub-range: the wrapper normalizes locally, or — context-parallel
+decode, mesh sp>1 — each sp shard covers a contiguous slice of every
+sequence's pages and partial states merge via pmax/psum before
+normalizing (see paged_attention_decode).
+
 Covers GQA, logit soft-capping, and dynamic sliding windows; falls back to
 the gather implementation off-TPU (`use_kernel` dispatch in
-paged_attention_decode).
+paged_attention_decode, with the POLYKEY_DISABLE_PAGED_KERNEL
+kill-switch).
 """
 
 from __future__ import annotations
