@@ -78,6 +78,58 @@ class HFTokenizer:
         return self._tok.decode(ids, skip_special_tokens=True)
 
 
+class IncrementalDetokenizer:
+    """Bounded-window incremental detokenization for context-dependent
+    tokenizers (BPE / sentencepiece, where decode(prefix + t) is not
+    decode(prefix) + decode(t)).
+
+    The naive streaming approach re-decodes the full prefix per token —
+    O(n²) host work over a stream. This keeps the standard two-offset
+    window (the vLLM detokenizer recurrence): `prefix_offset` marks ids
+    whose text is committed, `read_offset` marks ids represented in
+    emitted text; each push decodes only ids[prefix_offset:], a handful
+    of tokens in steady state. A delta is emitted only when the window's
+    text GROWS and doesn't end in U+FFFD (an incomplete byte-fallback
+    sequence must finish before its text is released, so streamed chunks
+    never contain replacement characters mid-character).
+
+    ''.join of pushes equals decode(all ids) up to any trailing
+    incomplete sequence, which `flush()` reports."""
+
+    def __init__(self, tok: Tokenizer):
+        self._tok = tok
+        self._ids: list[int] = []
+        self._prefix_off = 0
+        self._read_off = 0
+
+    _WINDOW_CAP = 64   # force-commit bound on uncommitted ids
+
+    def push(self, token_id: int) -> str:
+        self._ids.append(int(token_id))
+        prefix = self._tok.decode(self._ids[self._prefix_off:self._read_off])
+        full = self._tok.decode(self._ids[self._prefix_off:])
+        if len(full) > len(prefix) and not full.endswith("�"):
+            self._prefix_off = self._read_off
+            self._read_off = len(self._ids)
+            return full[len(prefix):]
+        if len(self._ids) - self._prefix_off > self._WINDOW_CAP:
+            # Degenerate run (e.g. skipped specials or invalid byte
+            # fallback) whose text never grows: force-commit so the
+            # window — and the per-push re-decode — stays bounded, even
+            # at the cost of releasing a trailing U+FFFD.
+            delta = full[len(prefix):] if len(full) > len(prefix) else ""
+            self._prefix_off = self._read_off = len(self._ids)
+            return delta
+        return ""
+
+    def flush(self) -> str:
+        """Text still held back (e.g. a trailing incomplete sequence)."""
+        prefix = self._tok.decode(self._ids[self._prefix_off:self._read_off])
+        full = self._tok.decode(self._ids[self._prefix_off:])
+        self._prefix_off = self._read_off = len(self._ids)
+        return full[len(prefix):] if len(full) > len(prefix) else ""
+
+
 def load_tokenizer(spec: str) -> Tokenizer:
     """'byte' → ByteTokenizer; anything else is a local HF tokenizer path."""
     if spec == "byte":

@@ -27,7 +27,7 @@ from typing import Iterator, Optional
 
 from ..engine.config import EngineConfig
 from ..engine.engine import GenRequest, InferenceEngine
-from ..engine.tokenizer import ByteTokenizer
+from ..engine.tokenizer import ByteTokenizer, IncrementalDetokenizer
 from ..engine.watchdog import Watchdog
 from ..proto import common_v2_pb2 as cmn
 from ..proto import polykey_v2_pb2 as pk
@@ -174,8 +174,7 @@ class TpuService(Service):
         tokenizer = self.engine.tokenizer
         incremental = isinstance(tokenizer, ByteTokenizer)
         utf8_tail = b""
-        all_ids: list[int] = []
-        emitted = ""
+        detok = None if incremental else IncrementalDetokenizer(tokenizer)
         hold = max((len(s) for s in stops), default=1) - 1
         buf = ""
         stopped = False
@@ -189,11 +188,9 @@ class TpuService(Service):
                         [value], utf8_tail
                     )
                 else:
-                    # HF detokenization is context-dependent: re-decode
-                    # the full prefix and emit the textual diff.
-                    all_ids.append(value)
-                    text = tokenizer.decode(all_ids)
-                    delta, emitted = text[len(emitted):], text
+                    # Context-dependent detokenization (BPE/sentencepiece):
+                    # bounded-window incremental decode, O(n) total.
+                    delta = detok.push(value)
                 if not delta:
                     continue
                 if not stops:
@@ -237,8 +234,21 @@ class TpuService(Service):
             except TimeoutError:
                 pass
             timings = request.timings
-        elif buf:
-            yield "delta", buf
+        else:
+            # End of stream: release held-back text (the incremental
+            # detokenizer's window and/or the stop scanner's tail), still
+            # honoring a stop that only completes in the final text.
+            tail = detok.flush() if detok is not None else ""
+            buf += tail
+            if buf:
+                cut = min(
+                    (i for i in (buf.find(s) for s in stops) if i >= 0),
+                    default=-1,
+                )
+                if cut >= 0:
+                    buf = buf[:cut]
+                if buf:
+                    yield "delta", buf
         yield "done", timings
 
     # -- Service interface --------------------------------------------------
@@ -303,8 +313,8 @@ class TpuService(Service):
 
         if not stops:
             # No stop scanning → no per-token decode: collect ids and
-            # detokenize once (the diff-decode in _text_events is
-            # O(n^2) host work for context-dependent tokenizers).
+            # detokenize once (one decode call beats _text_events'
+            # per-token window decodes when no one needs deltas).
             token_ids: list[int] = []
             for kind, value in self._drain(
                 request, self.engine.config.request_timeout_s

@@ -40,3 +40,38 @@ def test_incremental_decode_splits_multibyte():
 def test_load_tokenizer_byte():
     tok = load_tokenizer("byte")
     assert isinstance(tok, ByteTokenizer)
+
+
+def test_incremental_detokenizer_context_dependent():
+    """The bounded-window detokenizer must reproduce full-prefix decoding
+    for a context-DEPENDENT tokenizer: this fake mixes whole-word pieces
+    with UTF-8 byte-fallback ids (sentencepiece-style), so a multi-byte
+    character's text only exists once all its bytes arrived, and partial
+    sequences must be held back (never streamed as U+FFFD)."""
+    from polykey_tpu.engine.tokenizer import IncrementalDetokenizer
+
+    euro = "€".encode("utf-8")  # 3 bytes -> ids 100, 101, 102
+
+    class ByteFallbackTok:
+        pieces = {0: b"he", 1: b"llo", 2: b" wor", 3: b"ld", 4: b" ",
+                  100: euro[0:1], 101: euro[1:2], 102: euro[2:3]}
+
+        def decode(self, ids):
+            return b"".join(self.pieces[i] for i in ids).decode(
+                "utf-8", errors="replace"
+            )
+
+    tok = ByteFallbackTok()
+    ids = [0, 1, 4, 100, 101, 102, 2, 3]
+    detok = IncrementalDetokenizer(tok)
+    chunks = [detok.push(i) for i in ids]
+    assert "�" not in "".join(chunks)
+    # Bytes of '€' are held until the character completes.
+    assert chunks[3] == "" and chunks[4] == "" and chunks[5] == "€"
+    assert "".join(chunks) + detok.flush() == tok.decode(ids) == "hello € world"
+    # Trailing incomplete sequence: held back by push, surfaced by flush.
+    detok2 = IncrementalDetokenizer(tok)
+    out = "".join(detok2.push(i) for i in [0, 100, 101])
+    assert out == "he"
+    # Python collapses the incomplete trailing sequence to one U+FFFD.
+    assert detok2.flush() == "�"
