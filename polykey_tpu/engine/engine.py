@@ -55,7 +55,7 @@ from ..parallel.sharding import paged_kv_sharding, shard_params
 from .config import EngineConfig
 from .kv_cache import AllocationError, BlockAllocator, PagedKV, init_paged_kv
 from .metrics import EngineMetrics, RequestTimings
-from .sampling import fold_positions, lane_keys, sample_dynamic_rows
+from .sampling import sample_tail
 from .tokenizer import load_tokenizer
 
 
@@ -222,7 +222,7 @@ def _merge_lane_fn(
     The lane is born live only if its first token isn't EOS and the
     position budget allows generation (the same conditions the host's
     _maybe_finish applies when it later emits the first token)."""
-    token = tokens_vec.reshape(-1)[row]   # [N] groups or scalar (spec)
+    token = tokens_vec.reshape(-1)[row]   # [N] group/prefill token vector
     live = (token != eos_id) & (seq_len < cap)
     return (
         last_tokens.at[slot].set(token),
@@ -255,18 +255,9 @@ def _retire_lane_fn(last_tokens, seq_lens, page_tables, active, caps, slot):
 
 def _sample_tail(logits, seeds, positions, temperature, top_p,
                  greedy: bool, candidates: int = 0):
-    """Shared sampling tail for prefill and decode: greedy takes pure
-    argmax (no RNG at all); sampled rows draw independently, each keyed
-    by fold_in(lane seed key, `positions[row]`) — deterministic per
-    (request seed, token position), so streams never depend on batch
-    composition, scheduling, or other requests (optionally
-    top-k-prefiltered via `top_p_candidates`, skipping the [B, vocab]
-    sort)."""
-    if greedy:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    base = lane_keys(seeds[:, 0], seeds[:, 1])
-    keys = fold_positions(base, positions)
-    return sample_dynamic_rows(logits, keys, temperature, top_p, candidates)
+    return sample_tail(
+        logits, seeds, positions, temperature, top_p, greedy, candidates
+    )
 
 
 _MAX_PREFILL_GROUP = 4   # burst admissions batched per prefill dispatch
@@ -488,7 +479,8 @@ class InferenceEngine:
             )
             self._jit_spec_prefill = jax.jit(
                 spec_prefill_fn,
-                static_argnames=("t_cfg", "d_cfg", "candidates", "mesh"),
+                static_argnames=("t_cfg", "d_cfg", "greedy", "candidates",
+                                 "mesh"),
                 donate_argnames=("t_paged", "d_paged"),
                 out_shardings=(
                     self._repl, self._pool_sharding, self._pool_sharding,
@@ -668,9 +660,9 @@ class InferenceEngine:
         """Admit waiting requests into free slots. Short prompts are
         gathered into per-bucket groups and prefilled in ONE batched
         dispatch per group (burst admissions — e.g. cold start — pay one
-        device call instead of one per request); long prompts register for
-        chunked prefill. Spec engines dispatch per-request (the spec
-        prefill fn is single-row)."""
+        device call instead of one per request; spec engines batch the
+        same way, prefilling both pools per dispatch); long prompts
+        register for chunked prefill."""
         admitted = False
         count = 0
         groups: dict[int, list] = {}    # bucket → [(slot_idx, slot, ids)]
@@ -724,10 +716,10 @@ class InferenceEngine:
 
     def _prepare_request(self, slot_idx: int, request: GenRequest):
         """Tokenize, budget, allocate pages, and register the slot.
-        Returns (bucket, slot_idx, slot, prompt_ids) for short prompts
-        (the caller batches their prefill dispatches) or None for
-        long prompts (registered for chunked prefill) and spec engines
-        (dispatched here, single-row)."""
+        Returns (bucket, slot_idx, slot, prompt_ids, start) for short
+        prompts (the caller batches their prefill dispatches — plain and
+        spec engines alike) or None for long prompts (registered for
+        chunked prefill)."""
         cfg = self.config
         request.timings.prefill_start = time.monotonic()
 
@@ -798,9 +790,8 @@ class InferenceEngine:
             # Prefill only the suffix. A bucket-sized suffix rides the
             # batched bucket path at its own width (a hit must not cost
             # more than a miss); longer suffixes chunk from the offset.
-            # Spec engines take the single-row spec prefill (both pools)
-            # at the suffix bucket — cached pages already hold BOTH
-            # models' prefix KV (spec prefill writes target + draft).
+            # On spec engines the group dispatch prefills BOTH pools, and
+            # cached pages already hold both models' prefix KV.
             filled = len(matched) * cfg.page_size
             suffix = ids[filled:]
             suffix_bucket = self._bucket_for(len(suffix))
@@ -808,11 +799,6 @@ class InferenceEngine:
             if suffix_bucket is None:
                 slot.pending = ids
                 slot.filled = filled
-                return None
-            if self._spec:
-                self._dispatch_spec_prefill(
-                    slot_idx, slot, suffix, filled, suffix_bucket
-                )
                 return None
             return suffix_bucket, slot_idx, slot, suffix, filled
 
@@ -830,34 +816,7 @@ class InferenceEngine:
         # Registered but inactive until _resolve_prefills reads the token —
         # after the next decode block is dispatched, so prefill overlaps it.
         self._slots[slot_idx] = slot
-
-        if self._spec:
-            self._dispatch_spec_prefill(slot_idx, slot, ids, 0, bucket)
-            return None
-
         return bucket, slot_idx, slot, ids, 0
-
-    def _dispatch_spec_prefill(
-        self, slot_idx: int, slot: _Slot, window_ids: np.ndarray,
-        start: int, bucket: int,
-    ) -> None:
-        """Single-row spec prefill dispatch (both pools) for the window
-        `window_ids` at absolute offset `start` — the whole prompt for
-        cache misses, the suffix for prefix-cache hits."""
-        try:
-            tokens = np.zeros((1, bucket), dtype=np.int32)
-            tokens[0, : len(window_ids)] = window_ids
-            token_dev = self._run_prefill(
-                tokens, start, len(window_ids) - 1, slot.table, slot.request,
-                slot.seed_row,
-            )
-            self._merge_slot(slot_idx, slot, token_dev, 0)
-        except Exception:
-            # On any dispatch failure the slot must not linger as a
-            # permanently-inactive reservation.
-            self._slots[slot_idx] = None
-            self.allocator.release_all(slot.pages)
-            raise
 
     def _dispatch_prefill_group(self, bucket: int, group: list) -> None:
         """One batched prefill dispatch for up to _MAX_PREFILL_GROUP
@@ -885,18 +844,34 @@ class InferenceEngine:
         greedy = bool(np.all(temp == 0.0))
 
         put = partial(jax.device_put, device=self._repl)
+        common = (
+            jax.device_put(tokens, self._prefill_tok),
+            put(starts), put(last_rel), put(tables), put(seeds),
+            put(temp), put(top_p),
+        )
         try:
             with jax.profiler.TraceAnnotation("polykey/prefill"):
-                toks_dev, self.paged = self._jit_prefill(
-                    self.params, self.model_cfg, self.paged,
-                    jax.device_put(tokens, self._prefill_tok),
-                    put(starts),
-                    put(last_rel), put(tables), put(seeds),
-                    put(temp), put(top_p),
-                    greedy=greedy,
-                    candidates=self.config.top_p_candidates,
-                    mesh=self.mesh,
-                )
+                if self._spec:
+                    # Spec burst admissions batch exactly like plain ones
+                    # (spec_prefill_fn is N-row); both pools prefill in
+                    # the one dispatch.
+                    toks_dev, self.paged, self.d_paged = self._jit_spec_prefill(
+                        self.params, self.draft_params,
+                        self.model_cfg, self.draft_cfg,
+                        self.paged, self.d_paged,
+                        *common,
+                        greedy=greedy,
+                        candidates=self.config.top_p_candidates,
+                        mesh=self.mesh,
+                    )
+                else:
+                    toks_dev, self.paged = self._jit_prefill(
+                        self.params, self.model_cfg, self.paged,
+                        *common,
+                        greedy=greedy,
+                        candidates=self.config.top_p_candidates,
+                        mesh=self.mesh,
+                    )
         except Exception as e:
             # Contain the failure to this group: every member slot is
             # already registered, so each must be finished (pages released,
@@ -1000,6 +975,7 @@ class InferenceEngine:
                     self.model_cfg, self.draft_cfg,
                     self.paged, self.d_paged,
                     *common, *sampling,
+                    greedy=request.temperature == 0.0,
                     candidates=self.config.top_p_candidates,
                     mesh=self.mesh,
                 )

@@ -241,3 +241,18 @@ def sample(
     if params.top_p < 1.0:
         logits = _apply_top_p(logits, params.top_p)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def sample_tail(logits, seeds, positions, temperature, top_p,
+                greedy: bool, candidates: int = 0):
+    """THE shared sampling tail for prefill and decode (plain and
+    speculative paths — one implementation so key derivation cannot
+    drift): greedy takes pure argmax (no RNG); sampled rows draw
+    independently, each keyed by fold_in(lane seed key, positions[row])."""
+    import jax.numpy as jnp
+
+    if greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    base = lane_keys(seeds[:, 0], seeds[:, 1])
+    keys = fold_positions(base, positions)
+    return sample_dynamic_rows(logits, keys, temperature, top_p, candidates)

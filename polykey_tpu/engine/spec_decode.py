@@ -49,7 +49,7 @@ from .sampling import (
     _row_categorical,
     fold_positions,
     lane_keys,
-    sample_dynamic_rows,
+    sample_tail,
     truncated_dist,
 )
 
@@ -58,29 +58,31 @@ def spec_prefill_fn(
     t_params, d_params, t_cfg: ModelConfig, d_cfg: ModelConfig,
     t_paged, d_paged,
     tokens, start, last_rel, page_table, seeds, temperature, top_p,
-    candidates: int = 0, mesh=None,
+    greedy: bool = False, candidates: int = 0, mesh=None,
 ):
-    """Prefill BOTH caches for one window; first token from the TARGET.
+    """Prefill BOTH caches for N windows; first tokens from the TARGET.
 
-    Same contract as engine._prefill_fn (start offset + relative sampling
-    index → serves whole short prompts and long-prompt chunks alike) plus
+    Same contract as engine._prefill_fn (N windows at per-row start
+    offsets + relative sampling indices → serves batched burst
+    admissions, single admissions, and long-prompt chunks alike) plus
     the draft pool: the draft model must see the full prompt or its
     proposals start from a cold cache and acceptance collapses.
     """
-    T = tokens.shape[1]
-    positions = start[0] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    N, T = tokens.shape
+    positions = start[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
     hidden, t_paged = forward_paged(
         t_params, t_cfg, tokens, positions, t_paged, page_table, mesh=mesh
     )
     _, d_paged = forward_paged(
         d_params, d_cfg, tokens, positions, d_paged, page_table, mesh=mesh
     )
-    last = hidden[0, last_rel[0]][None]
-    logits = unembed(t_params, t_cfg, last)
-    base = lane_keys(seeds[:, 0], seeds[:, 1])            # [1, 2]
-    keys = fold_positions(base, start + last_rel + 1)
-    token = sample_dynamic_rows(logits, keys, temperature, top_p, candidates)
-    return token[0], t_paged, d_paged
+    last = hidden[jnp.arange(N), last_rel]                # [N, H]
+    logits = unembed(t_params, t_cfg, last)               # [N, V]
+    token = sample_tail(
+        logits, seeds, start + last_rel + 1, temperature, top_p,
+        greedy, candidates,
+    )
+    return token, t_paged, d_paged
 
 
 def spec_decode_fn(
