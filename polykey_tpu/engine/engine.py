@@ -146,7 +146,7 @@ def _prefill_fn(
     )
     last = hidden[jnp.arange(N), last_rel]                 # [N, H]
     logits = unembed(params, cfg, last)                    # [N, V]
-    token = _sample_tail(
+    token = sample_tail(
         logits, seeds, start + last_rel + 1, temperature, top_p,
         greedy, candidates,
     )
@@ -189,7 +189,7 @@ def _decode_fn(
         )
         logits = unembed(params, cfg, hidden[:, 0])        # [B, V]
         # The new token lands at index seq → that position keys its draw.
-        tokens = _sample_tail(
+        tokens = sample_tail(
             logits, seeds, seq, temperature, top_p, greedy, candidates
         )
         tokens = jnp.where(act, tokens, 0)
@@ -250,13 +250,6 @@ def _retire_lane_fn(last_tokens, seq_lens, page_tables, active, caps, slot):
         page_tables.at[slot].set(jnp.zeros_like(page_tables[0])),
         active.at[slot].set(False),
         caps.at[slot].set(0),
-    )
-
-
-def _sample_tail(logits, seeds, positions, temperature, top_p,
-                 greedy: bool, candidates: int = 0):
-    return sample_tail(
-        logits, seeds, positions, temperature, top_p, greedy, candidates
     )
 
 
@@ -523,7 +516,7 @@ class InferenceEngine:
 
         self._inflight_q: deque = deque()
         self._depth = config.lookahead_blocks
-        if config.compile_warmup and not self._spec:
+        if config.compile_warmup:
             self._compile_warmup()
         self._wake = threading.Event()
         self._stop = threading.Event()
@@ -884,10 +877,11 @@ class InferenceEngine:
             self._merge_slot(slot_idx, slot, toks_dev, r)
 
     def _compile_warmup(self) -> None:
-        """Pre-compile the greedy prefill group shapes and the greedy decode
-        block against the reserved garbage page. Runs in __init__ before
-        the engine thread starts, so there is no concurrent owner of the
-        donated pools; first real requests then never pay compile time."""
+        """Pre-compile the greedy prefill group shapes and the greedy
+        decode block (or spec round) against the reserved garbage page.
+        Runs in __init__ before the engine thread starts, so there is no
+        concurrent owner of the donated pools; first real requests then
+        never pay compile time."""
         cfg = self.config
         B = cfg.max_decode_slots
         put = partial(jax.device_put, device=self._repl)
@@ -899,8 +893,7 @@ class InferenceEngine:
         zrow = np.zeros((cfg.pages_per_seq,), np.int32)
         for bucket in cfg.prefill_buckets:
             for n in pads:
-                toks_dev, self.paged = self._jit_prefill(
-                    self.params, self.model_cfg, self.paged,
+                window = (
                     jax.device_put(
                         np.zeros((n, bucket), np.int32), self._prefill_tok
                     ),
@@ -910,10 +903,25 @@ class InferenceEngine:
                     put(np.zeros((n, 2), np.int32)),
                     put(np.zeros((n,), np.float32)),
                     put(np.ones((n,), np.float32)),
-                    greedy=True,
-                    candidates=self.config.top_p_candidates,
-                    mesh=self.mesh,
                 )
+                if self._spec:
+                    toks_dev, self.paged, self.d_paged = self._jit_spec_prefill(
+                        self.params, self.draft_params,
+                        self.model_cfg, self.draft_cfg,
+                        self.paged, self.d_paged,
+                        *window,
+                        greedy=True,
+                        candidates=self.config.top_p_candidates,
+                        mesh=self.mesh,
+                    )
+                else:
+                    toks_dev, self.paged = self._jit_prefill(
+                        self.params, self.model_cfg, self.paged,
+                        *window,
+                        greedy=True,
+                        candidates=self.config.top_p_candidates,
+                        mesh=self.mesh,
+                    )
                 if bucket == cfg.prefill_buckets[0]:
                     # Warm the lane merge with the prefill's OWN device
                     # output — a numpy stand-in would compile a different
@@ -928,16 +936,31 @@ class InferenceEngine:
                         np.float32(1.0), zrow, np.zeros((2,), np.int32),
                         eos_id=self.tokenizer.eos_id,
                     )
-        outs = self._jit_decode(
-            self.params, self.model_cfg, self.paged,
-            dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
-            dev["active"], dev["caps"], dev["seeds"],
-            dev["temperature"], dev["top_p"],
-            greedy=True, steps=self._block_steps,
-            eos_id=self.tokenizer.eos_id,
-            candidates=self.config.top_p_candidates, mesh=self.mesh,
-        )
-        *_, self.paged = outs
+        if self._spec:
+            # The spec round is the steady-state step; its compile is the
+            # heavy one (draft scan + verify + draft-sync forwards).
+            outs = self._jit_spec_decode(
+                self.params, self.draft_params,
+                self.model_cfg, self.draft_cfg,
+                self.paged, self.d_paged,
+                dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
+                dev["active"], dev["caps"], dev["seeds"],
+                dev["temperature"], dev["top_p"], gamma=self._gamma,
+                eos_id=self.tokenizer.eos_id,
+                candidates=0, mesh=self.mesh,
+            )
+            *_, self.paged, self.d_paged = outs
+        else:
+            outs = self._jit_decode(
+                self.params, self.model_cfg, self.paged,
+                dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
+                dev["active"], dev["caps"], dev["seeds"],
+                dev["temperature"], dev["top_p"],
+                greedy=True, steps=self._block_steps,
+                eos_id=self.tokenizer.eos_id,
+                candidates=self.config.top_p_candidates, mesh=self.mesh,
+            )
+            *_, self.paged = outs
         self._jit_retire(
             dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
             dev["active"], dev["caps"], np.int32(0),

@@ -249,8 +249,6 @@ def sample_tail(logits, seeds, positions, temperature, top_p,
     speculative paths — one implementation so key derivation cannot
     drift): greedy takes pure argmax (no RNG); sampled rows draw
     independently, each keyed by fold_in(lane seed key, positions[row])."""
-    import jax.numpy as jnp
-
     if greedy:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     base = lane_keys(seeds[:, 0], seeds[:, 1])

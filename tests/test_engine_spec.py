@@ -224,3 +224,32 @@ def test_spec_grpc_streaming_e2e():
             server.stop(grace=None)
     finally:
         eng.shutdown()
+
+
+def test_spec_compile_warmup_matches_cold():
+    """Spec engines now take compile warmup (spec prefill groups + the
+    spec round); warmed output must equal the cold engine's bit-for-bit
+    and the merge/prefill caches must cover the first admission."""
+    cold, _ = _run_prompts(SPEC_CONFIG)
+    warm_cfg = dataclasses.replace(SPEC_CONFIG, compile_warmup=True)
+    eng = InferenceEngine(warm_cfg)
+    try:
+        n_prefill = eng._jit_spec_prefill._cache_size()
+        n_merge = eng._jit_merge._cache_size()
+        n_round = eng._jit_spec_decode._cache_size()
+        reqs = [GenRequest(prompt=p, max_new_tokens=8) for p in PROMPTS]
+        for r in reqs:
+            eng.submit(r)
+        outs = []
+        for r in reqs:
+            tokens, done, error = _collect(r)
+            assert error is None and done is not None
+            outs.append(tokens)
+        assert outs == cold
+        # No new greedy compiles after warmup.
+        assert eng._jit_spec_prefill._cache_size() == n_prefill
+        assert eng._jit_merge._cache_size() == n_merge
+        # The spec ROUND is the heavy compile - it must be warmed too.
+        assert eng._jit_spec_decode._cache_size() == n_round
+    finally:
+        eng.shutdown()
