@@ -442,9 +442,8 @@ def main() -> None:
             t0 = time.monotonic()
             params1 = fabricate_params(cfg1, "bfloat16", quantize=False)
             log(f"fabricated {model_a} tree in {time.monotonic() - t0:.1f}s")
-            # (Spec engines have no warmup path — engine gates it off — so
-            # phase C's first requests pay the spec compiles; the timed
-            # window starts after bench_engine's own e2e warmup.)
+            # compile_warmup inherits from cfg_a: spec engines warm the
+            # spec prefill groups and the spec round since round 3.
             cfg_c = _dc.replace(cfg_a, draft_model=model_a, spec_gamma=4)
             phase_c = bench_engine(
                 cfg_c, params1, n_req // 2, prompt_len, max_new,

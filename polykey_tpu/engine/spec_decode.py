@@ -117,7 +117,7 @@ def spec_decode_fn(
     # Per-lane RNG roots; each draw keys on fold_in(base, token position)
     # plus a stream tag, so draft sampling / acceptance / residual draws
     # are independent AND a request's randomness is reproducible and
-    # batch-independent (same contract as the plain path's _sample_tail).
+    # batch-independent (same contract as the plain path's sampling.sample_tail).
     base = lane_keys(seeds[:, 0], seeds[:, 1])            # [B, 2]
 
     def _tagged(positions, tag):
