@@ -21,9 +21,17 @@ runs on CPU with a tiny model so the line still carries evidence, with
 "platform": "cpu" and vs_baseline null. Any crash still prints a diagnostic
 JSON line and exits 0.
 
-Knobs (env): POLYKEY_BENCH_MODEL, POLYKEY_BENCH_REQUESTS, POLYKEY_BENCH_PROMPT,
-POLYKEY_BENCH_NEW_TOKENS, POLYKEY_BENCH_SKIP_8B=1, POLYKEY_BENCH_PROBE_TRIES,
-POLYKEY_BENCH_PROBE_TIMEOUT.
+Phases beyond A/B: A2 prefix-cache TTFT (cold vs warm suffix prefill),
+D long-context (2k prompts / 4k positions, chunked prefill), C
+speculative serving with draft == target (the acceptance-1.0 ceiling).
+A compile-shaped phase-A failure on TPU retries once with the Pallas
+kill-switches set (kernels_disabled recorded in the artifact).
+
+Knobs (env): POLYKEY_BENCH_MODEL, POLYKEY_BENCH_REQUESTS,
+POLYKEY_BENCH_PROMPT, POLYKEY_BENCH_NEW_TOKENS, POLYKEY_BENCH_BLOCK,
+POLYKEY_BENCH_LOOKAHEAD, POLYKEY_BENCH_8B_SLOTS, POLYKEY_BENCH_SKIP_8B=1,
+POLYKEY_BENCH_SKIP_SPEC=1, POLYKEY_BENCH_SKIP_LONGCTX=1,
+POLYKEY_BENCH_PROBE_TRIES, POLYKEY_BENCH_PROBE_TIMEOUT.
 
 All progress chatter goes to stderr; stdout carries only the JSON line.
 """
