@@ -294,10 +294,17 @@ def main() -> None:
             phase_a = bench_engine(
                 cfg_a, None, n_req, prompt_len if on_tpu else 24, max_new)
         except Exception as e:
+            # Match compile-specific markers only: the old extra
+            # 'XlaRuntimeError' marker also covered runtime faults like an
+            # HBM RESOURCE_EXHAUSTED, which the jnp fallback would not
+            # survive either. A VMEM exhaustion DURING Mosaic compilation
+            # still matches (the message names mosaic/pallas) — that one
+            # the fallback does survive, since the jnp paths use no
+            # kernel scratch.
+            msg = f"{type(e).__name__}: {e}".lower()
             compile_shaped = any(
-                s in f"{type(e).__name__}: {e}"
-                for s in ("Mosaic", "mosaic", "pallas", "Pallas",
-                          "lowering", "XlaRuntimeError", "Compilation")
+                s in msg for s in ("mosaic", "pallas", "lowering",
+                                   "compilation")
             )
             if not (on_tpu and compile_shaped):
                 raise

@@ -904,24 +904,28 @@ class InferenceEngine:
                     put(np.zeros((n,), np.float32)),
                     put(np.ones((n,), np.float32)),
                 )
-                if self._spec:
-                    toks_dev, self.paged, self.d_paged = self._jit_spec_prefill(
-                        self.params, self.draft_params,
-                        self.model_cfg, self.draft_cfg,
-                        self.paged, self.d_paged,
-                        *window,
-                        greedy=True,
-                        candidates=self.config.top_p_candidates,
-                        mesh=self.mesh,
-                    )
-                else:
-                    toks_dev, self.paged = self._jit_prefill(
-                        self.params, self.model_cfg, self.paged,
-                        *window,
-                        greedy=True,
-                        candidates=self.config.top_p_candidates,
-                        mesh=self.mesh,
-                    )
+                # greedy is a static argname keyed on the BATCH (all-greedy
+                # vs any-sampled), so both variants occur at serving time —
+                # warm both or the first sampled admission pays a compile.
+                for greedy in (True, False):
+                    if self._spec:
+                        toks_dev, self.paged, self.d_paged = self._jit_spec_prefill(
+                            self.params, self.draft_params,
+                            self.model_cfg, self.draft_cfg,
+                            self.paged, self.d_paged,
+                            *window,
+                            greedy=greedy,
+                            candidates=self.config.top_p_candidates,
+                            mesh=self.mesh,
+                        )
+                    else:
+                        toks_dev, self.paged = self._jit_prefill(
+                            self.params, self.model_cfg, self.paged,
+                            *window,
+                            greedy=greedy,
+                            candidates=self.config.top_p_candidates,
+                            mesh=self.mesh,
+                        )
                 if bucket == cfg.prefill_buckets[0]:
                     # Warm the lane merge with the prefill's OWN device
                     # output — a numpy stand-in would compile a different
@@ -939,28 +943,57 @@ class InferenceEngine:
         if self._spec:
             # The spec round is the steady-state step; its compile is the
             # heavy one (draft scan + verify + draft-sync forwards).
-            outs = self._jit_spec_decode(
-                self.params, self.draft_params,
-                self.model_cfg, self.draft_cfg,
-                self.paged, self.d_paged,
-                dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
-                dev["active"], dev["caps"], dev["seeds"],
-                dev["temperature"], dev["top_p"], gamma=self._gamma,
-                eos_id=self.tokenizer.eos_id,
-                candidates=0, mesh=self.mesh,
-            )
-            *_, self.paged, self.d_paged = outs
+            # _dispatch_spec alternates between candidates=0 (all rows
+            # greedy/untruncated) and candidates=top_p_candidates, and
+            # each value is a distinct compile — warm both so the first
+            # truncated-top-p batch at serving time doesn't stall.
+            warm_candidates = [0]
+            if self.config.top_p_candidates > 0:
+                warm_candidates.append(self.config.top_p_candidates)
+            for cand in warm_candidates:
+                outs = self._jit_spec_decode(
+                    self.params, self.draft_params,
+                    self.model_cfg, self.draft_cfg,
+                    self.paged, self.d_paged,
+                    dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
+                    dev["active"], dev["caps"], dev["seeds"],
+                    dev["temperature"], dev["top_p"], gamma=self._gamma,
+                    eos_id=self.tokenizer.eos_id,
+                    candidates=cand, mesh=self.mesh,
+                )
+                *_, self.paged, self.d_paged = outs
+            if self.config.top_p_candidates == 0:
+                # Without the top-k prefilter, a batch containing any
+                # sampled top_p<1 row leaves the spec path entirely and
+                # takes the PLAIN decode block (see _dispatch_step's
+                # all_untruncated gate) — warm that fallback too. Only
+                # greedy=False is reachable there: all_untruncated can
+                # only be False via a temp>0 row, which makes the batch
+                # non-greedy.
+                outs = self._jit_decode(
+                    self.params, self.model_cfg, self.paged,
+                    dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
+                    dev["active"], dev["caps"], dev["seeds"],
+                    dev["temperature"], dev["top_p"],
+                    greedy=False, steps=self._block_steps,
+                    eos_id=self.tokenizer.eos_id,
+                    candidates=0, mesh=self.mesh,
+                )
+                *_, self.paged = outs
         else:
-            outs = self._jit_decode(
-                self.params, self.model_cfg, self.paged,
-                dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
-                dev["active"], dev["caps"], dev["seeds"],
-                dev["temperature"], dev["top_p"],
-                greedy=True, steps=self._block_steps,
-                eos_id=self.tokenizer.eos_id,
-                candidates=self.config.top_p_candidates, mesh=self.mesh,
-            )
-            *_, self.paged = outs
+            # greedy is batch-keyed at dispatch (all-greedy vs any-sampled);
+            # warm both static variants.
+            for greedy in (True, False):
+                outs = self._jit_decode(
+                    self.params, self.model_cfg, self.paged,
+                    dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
+                    dev["active"], dev["caps"], dev["seeds"],
+                    dev["temperature"], dev["top_p"],
+                    greedy=greedy, steps=self._block_steps,
+                    eos_id=self.tokenizer.eos_id,
+                    candidates=self.config.top_p_candidates, mesh=self.mesh,
+                )
+                *_, self.paged = outs
         self._jit_retire(
             dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
             dev["active"], dev["caps"], np.int32(0),

@@ -22,6 +22,7 @@ Tools:
 
 from __future__ import annotations
 
+import math
 import queue
 from typing import Iterator, Optional
 
@@ -119,7 +120,9 @@ class TpuService(Service):
         # Struct numbers are IEEE doubles: beyond 2^53 distinct integers
         # collapse to the same float, silently breaking the documented
         # distinct-seeds-never-collide contract — reject instead.
-        if isinstance(sv, float) and (sv != int(sv) or abs(sv) > 2 ** 53):
+        if isinstance(sv, float) and (
+            not math.isfinite(sv) or sv != int(sv) or abs(sv) > 2 ** 53
+        ):
             raise ValueError(
                 "'seed' must be an integer with |seed| <= 2**53 (JSON "
                 "numbers are doubles; larger seeds would silently collide)"

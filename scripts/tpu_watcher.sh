@@ -1,0 +1,27 @@
+#!/bin/bash
+# Detached TPU-tunnel watcher: probe every ~90s; when the tunnel answers,
+# run the Mosaic kernel check and then the full bench, recording artifacts
+# under perf/. Launch with:
+#   setsid nohup bash scripts/tpu_watcher.sh >/dev/null 2>&1 &
+# (kill by exact argv, never pkill -f — see perf/README.md)
+cd /root/repo || exit 1
+mkdir -p perf
+LOG=perf/watcher.log
+exec >>"$LOG" 2>&1
+echo "$(date -Is) watcher start pid=$$"
+while true; do
+  if timeout 60 python -c "import jax; d=jax.devices()[0]; print(d.platform, d.device_kind)" 2>/dev/null | grep -q tpu; then
+    echo "$(date -Is) tunnel LIVE"
+    ts=$(date +%Y%m%d_%H%M%S)
+    timeout 2400 python scripts/tpu_kernel_check.py > "perf/kernel_check_${ts}.txt" 2>&1
+    echo "$(date -Is) kernel-check rc=$? -> perf/kernel_check_${ts}.txt"
+    POLYKEY_BENCH_PROBE_TRIES=1 timeout 7200 python bench.py \
+      > "perf/bench_watcher_${ts}.json" 2> "perf/bench_watcher_${ts}.log"
+    echo "$(date -Is) bench rc=$? -> perf/bench_watcher_${ts}.json"
+    break
+  else
+    echo "$(date -Is) tunnel down"
+  fi
+  sleep 90
+done
+echo "$(date -Is) watcher done"

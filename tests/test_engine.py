@@ -262,7 +262,7 @@ def test_lookahead_depth_greedy_equality():
     assert run(4, 3) == run(1, 1)
 
 
-def test_stop_sequences():
+def test_stop_sequences(monkeypatch):
     """`stop` cuts generation BEFORE the earliest match, never emits the
     stop text (even when it spans delta boundaries — every byte-tokenizer
     delta is one char, so any multi-char stop spans), and cancels the
@@ -278,7 +278,10 @@ def test_stop_sequences():
     from polykey_tpu.gateway.tpu_service import TpuService
     from polykey_tpu.models.config import MODEL_REGISTRY, TINY_LLAMA
 
-    MODEL_REGISTRY.setdefault(
+    # monkeypatch (not setdefault) so the registry entry is removed on
+    # teardown — registry contents must not depend on test order.
+    monkeypatch.setitem(
+        MODEL_REGISTRY,
         "tiny-llama-ascii",
         dataclasses.replace(TINY_LLAMA, name="tiny-llama-ascii", vocab_size=96),
     )
@@ -527,5 +530,48 @@ def test_quantized_engine_serves():
         assert e1 is None and e2 is None
         assert d1 is not None and d2 is not None
         assert t1 == t2 and len(t1) == 8
+    finally:
+        eng.shutdown()
+
+
+def test_parse_seed_rejects_nonfinite_and_unsafe_floats():
+    """JSON Struct numbers are doubles: NaN/Infinity and integers beyond
+    2**53 must all raise the same descriptive ValueError (not
+    OverflowError), and safe integer-valued floats must parse."""
+    import pytest
+
+    from polykey_tpu.gateway.tpu_service import TpuService
+
+    parse = TpuService._parse_seed
+    assert parse({}) is None
+    assert parse({"seed": 42}) == 42
+    assert parse({"seed": 42.0}) == 42
+    for bad in (float("nan"), float("inf"), float("-inf"),
+                1.5, float(2 ** 53 + 2)):
+        with pytest.raises(ValueError, match="seed"):
+            parse({"seed": bad})
+
+
+def test_compile_warmup_covers_sampled_variants():
+    """greedy is a batch-keyed static argname on both prefill and the
+    decode block, so warmup must pre-compile the greedy=False variants
+    too — the first sampled request must not trigger any new compile."""
+    import dataclasses
+
+    eng = InferenceEngine(
+        dataclasses.replace(TEST_CONFIG, compile_warmup=True)
+    )
+    try:
+        n_prefill = eng._jit_prefill._cache_size()
+        n_decode = eng._jit_decode._cache_size()
+        r = GenRequest(
+            prompt="sampled warm probe", max_new_tokens=8,
+            temperature=0.9, top_p=0.8, seed=11,
+        )
+        eng.submit(r)
+        tokens, done, error = _collect(r)
+        assert error is None and done is not None and tokens
+        assert eng._jit_prefill._cache_size() == n_prefill
+        assert eng._jit_decode._cache_size() == n_decode
     finally:
         eng.shutdown()

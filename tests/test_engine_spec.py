@@ -253,3 +253,49 @@ def test_spec_compile_warmup_matches_cold():
         assert eng._jit_spec_decode._cache_size() == n_round
     finally:
         eng.shutdown()
+
+
+def test_spec_compile_warmup_covers_top_p_candidates():
+    """With top_p_candidates>0, the spec round dispatches with BOTH
+    candidates=0 (all-greedy batches) and candidates=top_p_candidates
+    (any truncated-top-p row) — warmup must pre-compile both variants so
+    the first sampled batch never stalls on a serving-time compile."""
+    cfg = dataclasses.replace(
+        SPEC_CONFIG, top_p_candidates=32, compile_warmup=True
+    )
+    eng = InferenceEngine(cfg)
+    try:
+        n_round = eng._jit_spec_decode._cache_size()
+        n_prefill = eng._jit_spec_prefill._cache_size()
+        r = GenRequest(
+            prompt="warm top-p probe", max_new_tokens=8,
+            temperature=0.9, top_p=0.8, seed=7,
+        )
+        eng.submit(r)
+        tokens, done, error = _collect(r)
+        assert error is None and done is not None and tokens
+        assert eng._jit_spec_decode._cache_size() == n_round
+        assert eng._jit_spec_prefill._cache_size() == n_prefill
+    finally:
+        eng.shutdown()
+
+
+def test_spec_compile_warmup_covers_plain_fallback():
+    """With top_p_candidates=0 a sampled top_p<1 batch leaves the spec
+    path and takes the PLAIN decode block — warmup must pre-compile that
+    fallback variant too (greedy=False, candidates=0)."""
+    cfg = dataclasses.replace(SPEC_CONFIG, compile_warmup=True)
+    assert cfg.top_p_candidates == 0
+    eng = InferenceEngine(cfg)
+    try:
+        n_plain = eng._jit_decode._cache_size()
+        r = GenRequest(
+            prompt="plain fallback probe", max_new_tokens=8,
+            temperature=0.9, top_p=0.8, seed=3,
+        )
+        eng.submit(r)
+        tokens, done, error = _collect(r)
+        assert error is None and done is not None and tokens
+        assert eng._jit_decode._cache_size() == n_plain
+    finally:
+        eng.shutdown()
