@@ -1,7 +1,12 @@
-"""gRPC server reflection (v1alpha), backed by the default descriptor pool.
+"""gRPC server reflection (v1 + v1alpha), backed by the default descriptor
+pool.
 
 Hand-rolled because grpcio-reflection is not in the image; the reference gets
-this from grpc-go (/root/reference/cmd/polykey/main.go:80). Supports the
+this from grpc-go (/root/reference/cmd/polykey/main.go:80), whose
+reflection.Register serves BOTH grpc.reflection.v1.ServerReflection and the
+v1alpha name — modern grpcurl tries v1 first. The v1 protocol is a pure
+rename of v1alpha (identical message shapes and field numbers), so one
+handler serves both service names with the same wire bytes. Supports the
 queries grpcurl issues: list_services, file_containing_symbol, and
 file_by_filename (each file response includes transitive imports).
 """
@@ -12,14 +17,21 @@ import grpc
 from google.protobuf import descriptor_pool
 
 from ..proto import reflection_v1alpha_pb2 as refl_pb
+# Imported for its side effect: registering the v1 file in the default
+# descriptor pool, so describing the advertised v1 service name resolves
+# (grpc-go registers descriptors for both names).
+from ..proto import reflection_v1_pb2 as _refl_v1_pb  # noqa: F401
 
 from ..proto.health_v1_grpc import SERVICE_NAME as _HEALTH_SERVICE
 from ..proto.polykey_v2_grpc import SERVICE_NAME as _POLYKEY_SERVICE
 
 SERVICE_NAME = "grpc.reflection.v1alpha.ServerReflection"
+SERVICE_NAME_V1 = "grpc.reflection.v1.ServerReflection"
 
 # Services this server exposes, as registered in gateway.server.
-_EXPOSED_SERVICES = (_POLYKEY_SERVICE, _HEALTH_SERVICE, SERVICE_NAME)
+_EXPOSED_SERVICES = (
+    _POLYKEY_SERVICE, _HEALTH_SERVICE, SERVICE_NAME_V1, SERVICE_NAME,
+)
 
 
 def _file_with_deps(pool, file_desc) -> list[bytes]:
@@ -84,10 +96,13 @@ def add_reflection_to_server(servicer: ReflectionService, server) -> None:
         request_deserializer=refl_pb.ServerReflectionRequest.FromString,
         response_serializer=refl_pb.ServerReflectionResponse.SerializeToString,
     )
+    # Same handler under both names: v1 is wire-identical to v1alpha
+    # (grpc-go parity — reflection.Register serves both).
     server.add_generic_rpc_handlers(
-        (
+        tuple(
             grpc.method_handlers_generic_handler(
-                SERVICE_NAME, {"ServerReflectionInfo": handler}
-            ),
+                name, {"ServerReflectionInfo": handler}
+            )
+            for name in (SERVICE_NAME_V1, SERVICE_NAME)
         )
     )

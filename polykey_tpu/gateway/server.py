@@ -38,6 +38,7 @@ from .health import HealthService
 from .interceptor import LoggingInterceptor
 from .jsonlog import Logger
 from .reflection import SERVICE_NAME as REFLECTION_SERVICE_NAME
+from .reflection import SERVICE_NAME_V1 as REFLECTION_SERVICE_NAME_V1
 from .reflection import ReflectionService, add_reflection_to_server
 from .service import Service
 from ..proto.health_v1_grpc import SERVICE_NAME as HEALTH_SERVICE_NAME
@@ -144,6 +145,7 @@ def build_server(
 _SERVICE_TABLE = {
     SERVICE_NAME: ["ExecuteTool", "ExecuteToolStream"],
     HEALTH_SERVICE_NAME: ["Check", "Watch"],
+    REFLECTION_SERVICE_NAME_V1: ["ServerReflectionInfo"],
     REFLECTION_SERVICE_NAME: ["ServerReflectionInfo"],
 }
 

@@ -14,7 +14,7 @@ protoc -I protos \
   --python_out="$OUT" \
   --descriptor_set_out="$OUT/descriptor_set.binpb" --include_imports \
   protos/common_v2.proto protos/polykey_v2.proto protos/health_v1.proto \
-  protos/reflection_v1alpha.proto
+  protos/reflection_v1alpha.proto protos/reflection_v1.proto
 
 # protoc emits absolute imports between generated modules; rewrite to
 # package-relative so polykey_tpu.proto is importable from anywhere.

@@ -133,3 +133,38 @@ def test_reflection_list_and_lookup(stack):
     assert "grpc.health.v1.Health" in services
     files = responses[1].file_descriptor_response.file_descriptor_proto
     assert len(files) >= 2  # polykey_v2.proto + its imports
+
+
+def test_reflection_v1_list_and_lookup(stack):
+    """grpc-go's reflection.Register serves v1 AND v1alpha (modern grpcurl
+    tries v1 first); the v1 protocol is wire-identical, so the same
+    queries must succeed on the v1 method path and list both reflection
+    service names."""
+    channel, _, _ = stack
+    refl = channel.stream_stream(
+        "/grpc.reflection.v1.ServerReflection/ServerReflectionInfo",
+        request_serializer=refl_pb.ServerReflectionRequest.SerializeToString,
+        response_deserializer=refl_pb.ServerReflectionResponse.FromString,
+    )
+    requests = [
+        refl_pb.ServerReflectionRequest(list_services=""),
+        refl_pb.ServerReflectionRequest(
+            file_containing_symbol="polykey.v2.PolykeyService"
+        ),
+        # Every ADVERTISED service must describe (grpcurl walks the list).
+        refl_pb.ServerReflectionRequest(
+            file_containing_symbol="grpc.reflection.v1.ServerReflection"
+        ),
+    ]
+    responses = list(refl.__call__(iter(requests), timeout=5))
+    services = {s.name for s in responses[0].list_services_response.service}
+    assert "grpc.reflection.v1.ServerReflection" in services
+    assert "grpc.reflection.v1alpha.ServerReflection" in services
+    assert "polykey.v2.PolykeyService" in services
+    files = responses[1].file_descriptor_response.file_descriptor_proto
+    assert len(files) >= 2
+    v1_files = responses[2].file_descriptor_response.file_descriptor_proto
+    assert v1_files, "v1 reflection service descriptor must resolve"
+    assert responses[2].WhichOneof("message_response") == (
+        "file_descriptor_response"
+    )
