@@ -558,8 +558,15 @@ def test_compile_warmup_covers_sampled_variants():
     too — the first sampled request must not trigger any new compile."""
     import dataclasses
 
+    # Unique shape key (slots/buckets used by no other test): jax.jit
+    # caches are shared across engine instances with equal jit params, so
+    # a shared shape would let earlier sampled-request tests pre-populate
+    # the entries and this test would pass even with warmup broken.
     eng = InferenceEngine(
-        dataclasses.replace(TEST_CONFIG, compile_warmup=True)
+        dataclasses.replace(
+            TEST_CONFIG, compile_warmup=True,
+            max_decode_slots=5, prefill_buckets=(24,),
+        )
     )
     try:
         n_prefill = eng._jit_prefill._cache_size()
@@ -573,5 +580,73 @@ def test_compile_warmup_covers_sampled_variants():
         assert error is None and done is not None and tokens
         assert eng._jit_prefill._cache_size() == n_prefill
         assert eng._jit_decode._cache_size() == n_decode
+    finally:
+        eng.shutdown()
+
+
+def test_compile_warmup_greedy_only_mode():
+    """warm_sampled_variants=False (the greedy-only benchmark mode) must
+    still fully pre-compile the greedy path: a greedy request triggers no
+    new compile. (No cross-engine cache-size comparison here — jax.jit
+    wrappers over the same function with equal jit params SHARE the
+    underlying cache across engine instances, so only same-engine deltas
+    are meaningful.)"""
+    import dataclasses
+
+    eng = InferenceEngine(
+        dataclasses.replace(
+            TEST_CONFIG, compile_warmup=True, warm_sampled_variants=False,
+            # Unique shape key — see test_compile_warmup_covers_sampled_variants.
+            max_decode_slots=6, prefill_buckets=(40,),
+        )
+    )
+    try:
+        n_prefill = eng._jit_prefill._cache_size()
+        n_decode = eng._jit_decode._cache_size()
+        r = GenRequest(prompt="greedy only probe", max_new_tokens=8)
+        eng.submit(r)
+        tokens, done, error = _collect(r)
+        assert error is None and done is not None and tokens
+        assert eng._jit_prefill._cache_size() == n_prefill
+        assert eng._jit_decode._cache_size() == n_decode
+    finally:
+        eng.shutdown()
+
+
+def test_adaptive_block_solo_vs_loaded():
+    """Load-adaptive blocking: a lone stream dispatches the small solo
+    block (max(1, K//8)); concurrent streams dispatch the full K. Output
+    is identical to the static-block engine either way."""
+    import dataclasses
+
+    cfg = dataclasses.replace(TEST_CONFIG, decode_block_steps=8)
+    static_cfg = dataclasses.replace(cfg, adaptive_block=False)
+
+    def run_solo(config):
+        eng = InferenceEngine(config)
+        try:
+            r = GenRequest(prompt="adaptive probe", max_new_tokens=12)
+            eng.submit(r)
+            tokens, done, error = _collect(r)
+            assert error is None and done is not None
+            return tokens, eng._last_dispatch_steps
+        finally:
+            eng.shutdown()
+
+    solo_tokens, solo_k = run_solo(cfg)
+    static_tokens, static_k = run_solo(static_cfg)
+    assert solo_k == 1 and static_k == 8
+    assert solo_tokens == static_tokens
+
+    # Under load (>1 active stream) the adaptive engine uses the full K.
+    eng = InferenceEngine(cfg)
+    try:
+        reqs = [GenRequest(prompt=f"load {i}", max_new_tokens=12)
+                for i in range(3)]
+        for r in reqs:
+            eng.submit(r)
+        outs = [_collect(r) for r in reqs]
+        assert all(e is None for _, _, e in outs)
+        assert eng._last_dispatch_steps == 8
     finally:
         eng.shutdown()

@@ -195,3 +195,14 @@ def test_bad_geometry_rejected():
         # Axis values below 1 (e.g. POLYKEY_SP=0 typo) must fail loudly,
         # not build a zero-device mesh.
         dataclasses.replace(BASE_CONFIG, sp=0).validate()
+
+
+@_needs(8)
+def test_hybrid_2slices_matches_single_device(reference_outputs):
+    """num_slices=2: the engine builds a hybrid DCN mesh
+    (parallel/distributed.py:create_hybrid_mesh) with per-slice dp=2
+    folded into a dp axis of 4 across two simulated slices; greedy
+    serving output must be bit-identical to the single-device engine."""
+    assert _run_prompts(
+        dataclasses.replace(BASE_CONFIG, tp=2, dp=2, num_slices=2)
+    ) == reference_outputs

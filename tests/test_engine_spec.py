@@ -260,8 +260,12 @@ def test_spec_compile_warmup_covers_top_p_candidates():
     candidates=0 (all-greedy batches) and candidates=top_p_candidates
     (any truncated-top-p row) — warmup must pre-compile both variants so
     the first sampled batch never stalls on a serving-time compile."""
+    # Unique shape key (slots/buckets used by no other test): jit caches
+    # are shared across engine instances, so shared shapes could be
+    # pre-populated by earlier tests and mask a warmup regression.
     cfg = dataclasses.replace(
-        SPEC_CONFIG, top_p_candidates=32, compile_warmup=True
+        SPEC_CONFIG, top_p_candidates=32, compile_warmup=True,
+        max_decode_slots=7, prefill_buckets=(48,),
     )
     eng = InferenceEngine(cfg)
     try:
@@ -284,7 +288,11 @@ def test_spec_compile_warmup_covers_plain_fallback():
     """With top_p_candidates=0 a sampled top_p<1 batch leaves the spec
     path and takes the PLAIN decode block — warmup must pre-compile that
     fallback variant too (greedy=False, candidates=0)."""
-    cfg = dataclasses.replace(SPEC_CONFIG, compile_warmup=True)
+    cfg = dataclasses.replace(
+        SPEC_CONFIG, compile_warmup=True,
+        # Unique shape key — see test_spec_compile_warmup_covers_top_p_candidates.
+        max_decode_slots=9, prefill_buckets=(56,),
+    )
     assert cfg.top_p_candidates == 0
     eng = InferenceEngine(cfg)
     try:
@@ -297,5 +305,79 @@ def test_spec_compile_warmup_covers_plain_fallback():
         tokens, done, error = _collect(r)
         assert error is None and done is not None and tokens
         assert eng._jit_decode._cache_size() == n_plain
+    finally:
+        eng.shutdown()
+
+
+def test_adaptive_gamma_drops_on_bad_draft():
+    """The gamma dial (VERDICT r2 #8): a draft that keeps getting
+    rejected must drag the acceptance EWMA under the low-water mark and
+    halve dispatch gamma; greedy output stays the target's chain
+    regardless (the core spec guarantee)."""
+    plain, _ = _run_prompts(BASE_CONFIG)
+    cfg = dataclasses.replace(SPEC_CONFIG, spec_gamma=4)
+    eng = InferenceEngine(cfg)
+    try:
+        assert eng._gamma == 4 and eng._gamma_low == 2
+        outs = []
+        for _ in range(3):   # enough rounds for the EWMA to move
+            reqs = [GenRequest(prompt=p, max_new_tokens=8) for p in PROMPTS]
+            for r in reqs:
+                eng.submit(r)
+            outs.append([_collect(r)[0] for r in reqs])
+        # tiny-llama draft at a different seed is a terrible predictor:
+        # the dial must have dropped to the low rung.
+        assert eng._accept_ewma < 0.35
+        assert eng._gamma == eng._gamma_low
+        assert eng.stats()["spec_gamma"] == eng._gamma
+        for out in outs:
+            assert out == plain
+    finally:
+        eng.shutdown()
+
+
+def test_adaptive_gamma_stays_high_with_perfect_draft():
+    """draft == target ⇒ acceptance 1.0 ⇒ the dial never leaves the full
+    gamma."""
+    import jax
+    import jax.numpy as jnp
+
+    from polykey_tpu.models.config import get_config
+    from polykey_tpu.models.transformer import init_params
+
+    params = init_params(
+        jax.random.PRNGKey(5), get_config("tiny-llama"), jnp.float32
+    )
+    cfg = dataclasses.replace(SPEC_CONFIG, spec_gamma=4)
+    eng = InferenceEngine(cfg, params=params, draft_params=params)
+    try:
+        reqs = [GenRequest(prompt=p, max_new_tokens=12) for p in PROMPTS]
+        for r in reqs:
+            eng.submit(r)
+        for r in reqs:
+            tokens, done, error = _collect(r)
+            assert error is None and done is not None
+        assert eng._gamma == 4
+        assert eng.metrics.snapshot()["spec_acceptance"] == 1.0
+    finally:
+        eng.shutdown()
+
+
+def test_adaptive_gamma_off_pins_full_gamma():
+    """POLYKEY_ADAPTIVE_GAMMA=0 semantics: the ladder collapses to the
+    configured gamma and the dial never moves."""
+    cfg = dataclasses.replace(
+        SPEC_CONFIG, spec_gamma=4, adaptive_gamma=False
+    )
+    eng = InferenceEngine(cfg)
+    try:
+        assert eng._gamma_low == eng._gamma_max == 4
+        reqs = [GenRequest(prompt=p, max_new_tokens=8) for p in PROMPTS]
+        for r in reqs:
+            eng.submit(r)
+        for r in reqs:
+            tokens, done, error = _collect(r)
+            assert error is None and done is not None
+        assert eng._gamma == 4
     finally:
         eng.shutdown()
