@@ -389,6 +389,9 @@ def paged_attention_decode(
         # Under pp the per-layer pool slice is stage-local, not replicated
         # across pp — the shard_map specs below would be wrong. The gather
         # path is GSPMD-partitionable as-is, so pp>1 meshes take it.
+        # Decided position (PERF.md "pp in serving"): pp is a capacity/
+        # prefill axis; the ~3× attention-read traffic here is accepted,
+        # and >HBM models should serve tp(+sp)-first instead.
         from .paged_attention import paged_attention
 
         return paged_attention(

@@ -1,24 +1,48 @@
 #!/bin/bash
 # Detached TPU-tunnel watcher: probe every ~90s; when the tunnel answers,
-# run the Mosaic kernel check and then the full bench, recording artifacts
-# under perf/. Launch with:
+# run the Mosaic kernel check (once — skipped after a passing run) and then
+# the full bench, recording artifacts under perf/. Keeps watching until a
+# TPU-backed bench artifact lands or the retry budget is spent; a tunnel
+# flap mid-bench (CPU-fallback artifact) triggers another attempt.
+# Launch with:
 #   setsid nohup bash scripts/tpu_watcher.sh >/dev/null 2>&1 &
 # (kill by exact argv, never pkill -f — see perf/README.md)
 cd /root/repo || exit 1
 mkdir -p perf
 LOG=perf/watcher.log
+BENCH_TRIES=0
+MAX_BENCH_TRIES=6
 exec >>"$LOG" 2>&1
 echo "$(date -Is) watcher start pid=$$"
 while true; do
   if timeout 60 python -c "import jax; d=jax.devices()[0]; print(d.platform, d.device_kind)" 2>/dev/null | grep -q tpu; then
     echo "$(date -Is) tunnel LIVE"
     ts=$(date +%Y%m%d_%H%M%S)
-    timeout 2400 python scripts/tpu_kernel_check.py > "perf/kernel_check_${ts}.txt" 2>&1
-    echo "$(date -Is) kernel-check rc=$? -> perf/kernel_check_${ts}.txt"
+    if [ ! -f perf/kernel_check_ok ]; then
+      timeout 2400 python scripts/tpu_kernel_check.py > "perf/kernel_check_${ts}.txt" 2>&1
+      kc_rc=$?
+      echo "$(date -Is) kernel-check rc=${kc_rc} -> perf/kernel_check_${ts}.txt"
+      if [ "$kc_rc" -eq 0 ]; then
+        echo "perf/kernel_check_${ts}.txt" > perf/kernel_check_ok
+      fi
+    fi
+    BENCH_TRIES=$((BENCH_TRIES + 1))
     POLYKEY_BENCH_PROBE_TRIES=1 timeout 7200 python bench.py \
       > "perf/bench_watcher_${ts}.json" 2> "perf/bench_watcher_${ts}.log"
-    echo "$(date -Is) bench rc=$? -> perf/bench_watcher_${ts}.json"
-    break
+    bench_rc=$?
+    echo "$(date -Is) bench attempt ${BENCH_TRIES}/${MAX_BENCH_TRIES} rc=${bench_rc} -> perf/bench_watcher_${ts}.json"
+    # Only stop once a real TPU artifact landed: a tunnel flap mid-run
+    # makes bench fall back to CPU (rc=0, "platform": "cpu").
+    if grep -q '"platform": "tpu"' "perf/bench_watcher_${ts}.json"; then
+      break
+    fi
+    rm -f "perf/bench_watcher_${ts}.json" "perf/bench_watcher_${ts}.log"
+    if [ "$BENCH_TRIES" -ge "$MAX_BENCH_TRIES" ]; then
+      echo "$(date -Is) bench retry budget spent; stopping"
+      break
+    fi
+    echo "$(date -Is) bench artifact was not tpu-backed (removed); backing off 300s"
+    sleep 300
   else
     echo "$(date -Is) tunnel down"
   fi

@@ -259,3 +259,46 @@ def test_paged_decode_fallback_off_tpu():
     ref = paged_attention(q, kp, vp, pt, pos, scale=0.3)
     out = paged_attention_decode(q, kp, vp, pt, pos, scale=0.3)
     assert float(jnp.max(jnp.abs(ref - out))) < TOL
+
+
+def test_pp_mesh_routes_to_gather_path(monkeypatch):
+    """Decided position (PERF.md "pp in serving"): under pp>1 the decode
+    wrapper must take the GSPMD-partitionable gather path — the kernel's
+    shard_map specs have no pp dimension and the per-layer pool slice is
+    stage-local — and the result must still match the reference."""
+    from jax.sharding import NamedSharding, PartitionSpec as P_
+
+    import polykey_tpu.ops.paged_attention_kernel as pak
+    from polykey_tpu.parallel.mesh import MeshConfig, create_mesh
+
+    if jax.device_count() < 4:
+        pytest.skip("needs 4 devices")
+
+    q, kp, vp, pt, pos = _paged_case(
+        4, 8, 2, 64, 16, 8, [[5], [37], [63], [100]]
+    )
+    ref = paged_attention(q, kp, vp, pt, pos, scale=0.125)
+
+    mesh = create_mesh(MeshConfig(pp=2, tp=2), devices=jax.devices()[:4])
+    from polykey_tpu.ops import paged_attention as pa_mod
+
+    calls = {"gather": 0}
+    real = pa_mod.paged_attention
+
+    def spy(*args, **kwargs):
+        calls["gather"] += 1
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(
+        "polykey_tpu.ops.paged_attention.paged_attention", spy
+    )
+    out = pak.paged_attention_decode(
+        jax.device_put(q, NamedSharding(mesh, P_(None, None, "tp", None))),
+        jax.device_put(kp, NamedSharding(mesh, P_(None, None, "tp", None))),
+        jax.device_put(vp, NamedSharding(mesh, P_(None, None, "tp", None))),
+        jax.device_put(pt, NamedSharding(mesh, P_())),
+        jax.device_put(pos, NamedSharding(mesh, P_())),
+        scale=0.125, interpret=True, mesh=mesh,
+    )
+    assert calls["gather"] == 1
+    assert float(jnp.max(jnp.abs(ref - out))) < TOL
