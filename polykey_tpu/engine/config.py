@@ -95,9 +95,11 @@ class EngineConfig:
     adaptive_block: bool = True
 
     # In-flight decode blocks (pipeline depth): the engine keeps up to
-    # `lookahead_blocks` dispatched-but-unprocessed blocks on the device
-    # queue, so host-side processing and D2H latency hide behind device
-    # compute. Device-side stopping + per-block request snapshots make
+    # `lookahead_blocks` dispatched-but-unprocessed FULL-K blocks on the
+    # device queue, so host-side processing and D2H latency hide behind
+    # device compute. When adaptive blocking shrinks K the depth scales
+    # up by the same factor (capped at 64 blocks), keeping
+    # steps-in-flight constant. Device-side stopping + per-block request snapshots make
     # stale blocks safe (engine.py _run); the cost is up to
     # lookahead_blocks x decode_block_steps wasted device steps when a
     # stream finishes. 1 → classic dispatch-then-process.

@@ -14,9 +14,11 @@ Design:
   `max_decode_slots` slots; prompts prefill through a small set of padded
   length buckets. Slot occupancy is data (`active` mask), not shape.
 - Latency-tolerant loop: decode runs in K-step blocks (one lax.scan
-  dispatch each, device-side EOS/cap stopping), and up to
-  `lookahead_blocks` blocks stay in flight while the host reads one
-  block behind through async D2H copies. Admissions prefill in padded
+  dispatch each, device-side EOS/cap stopping), and a bounded pipeline
+  of blocks stays in flight (`lookahead_blocks` at the full K, deepened
+  proportionally when adaptive blocking shrinks K, so steps-in-flight —
+  and therefore roundtrip hiding — stay constant) while the host reads
+  one block behind through async D2H copies. Admissions prefill in padded
   buckets (batched for bursts, chunked for long prompts) and activate
   their lanes via tiny on-device merge dispatches — no sync, no pipeline
   flush; retirements dispatch the mirror-image lane reset. Dispatch is
@@ -545,11 +547,21 @@ class InferenceEngine:
 
         self._submit: queue.Queue[GenRequest] = queue.Queue()
         # Lookahead pipeline: dispatched-but-unprocessed decode blocks,
-        # oldest first. Kept at ≤ lookahead_blocks deep while dispatching.
+        # oldest first. Kept at ≤ _depth_target deep while dispatching
+        # (lookahead_blocks, scaled up when adaptive blocking shrinks K —
+        # constant steps-in-flight).
         from collections import deque
 
         self._inflight_q: deque = deque()
         self._depth = config.lookahead_blocks
+        # In-flight target for the CURRENT block size: when the adaptive
+        # dispatcher shrinks K, the pipeline deepens by the same factor
+        # (constant steps-in-flight), because roundtrip hiding needs
+        # depth × block_time ≥ the tunnel latency — a K/8 block at the
+        # configured depth would leave the host stalled on un-landed
+        # copies. The 64-block cap binds only for large lookahead_blocks
+        # (the scale factor itself tops out at block_steps // solo_steps).
+        self._depth_target = self._depth
         if config.compile_warmup:
             self._compile_warmup()
         self._wake = threading.Event()
@@ -637,7 +649,8 @@ class InferenceEngine:
                     # may never rewind live device state, so the whole
                     # pipeline drains first.
                     self._drain_inflight()
-                # Lookahead pipeline: keep up to `_depth` blocks in flight.
+                # Lookahead pipeline: keep up to `_depth_target` blocks in
+                # flight (constant steps-in-flight across block sizes).
                 # Device-side stopping makes stale blocks safe (a stream the
                 # host finished was stopped on device by the same EOS/cap
                 # condition, so its lookahead emit lanes read -1);
@@ -651,7 +664,7 @@ class InferenceEngine:
                     dispatched = True
                     worked = True
                 self._resolve_prefills()
-                target = self._depth if dispatched else 0
+                target = self._depth_target if dispatched else 0
                 while len(self._inflight_q) > target:
                     self._process_step(self._inflight_q.popleft())
                     worked = True
@@ -1268,6 +1281,7 @@ class InferenceEngine:
             spec_candidates = (
                 0 if all_untruncated else self.config.top_p_candidates
             )
+            self._depth_target = self._depth   # spec rounds: full-size blocks
             return (
                 "spec",
                 self._dispatch_spec(dev, spec_candidates),
@@ -1283,6 +1297,9 @@ class InferenceEngine:
             self._solo_steps if int(act.sum()) == 1 else self._block_steps
         )
         self._last_dispatch_steps = steps
+        self._depth_target = min(
+            64, self._depth * (self._block_steps // max(1, steps))
+        )
         with jax.profiler.TraceAnnotation("polykey/decode"):
             (packed_dev, last_dev, seq_dev, act_dev,
              self.paged) = self._jit_decode(

@@ -629,14 +629,18 @@ def test_adaptive_block_solo_vs_loaded():
             eng.submit(r)
             tokens, done, error = _collect(r)
             assert error is None and done is not None
-            return tokens, eng._last_dispatch_steps
+            return tokens, eng._last_dispatch_steps, eng._depth_target
         finally:
             eng.shutdown()
 
-    solo_tokens, solo_k = run_solo(cfg)
-    static_tokens, static_k = run_solo(static_cfg)
+    solo_tokens, solo_k, solo_depth = run_solo(cfg)
+    static_tokens, static_k, static_depth = run_solo(static_cfg)
     assert solo_k == 1 and static_k == 8
     assert solo_tokens == static_tokens
+    # Constant steps-in-flight: shrinking K deepens the pipeline by the
+    # same factor (depth x block_time must keep covering the roundtrip).
+    assert solo_depth == cfg.lookahead_blocks * 8
+    assert static_depth == cfg.lookahead_blocks
 
     # Under load (>1 active stream) the adaptive engine uses the full K.
     eng = InferenceEngine(cfg)
