@@ -18,79 +18,128 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def check_paged_decode() -> None:
-    from polykey_tpu.ops.paged_attention import paged_attention
-    from polykey_tpu.ops.paged_attention_kernel import paged_attention_decode
-
-    # Llama-3-8B decode geometry: 32 q heads, 8 kv heads, D=128, ps=16.
-    B, Hq, Hk, D, ps, P = 8, 32, 8, 128, 16, 32
+def _paged_inputs(B, Hq, Hk, D, ps, P, dtype, seed=0):
+    """Disjoint per-row page tables; row b's context grows with b up to
+    the full P·ps window so partial last groups and full tables both
+    compile into the one launch."""
     N = B * P + 1
-    key = jax.random.PRNGKey(0)
+    key = jax.random.PRNGKey(seed)
     kq, kk, kv = jax.random.split(key, 3)
-    q = jax.random.normal(kq, (B, 1, Hq, D), jnp.float32)
-    kp = jax.random.normal(kk, (N, ps, Hk, D), jnp.float32)
-    vp = jax.random.normal(kv, (N, ps, Hk, D), jnp.float32)
-    positions = np.array([[5], [37], [160], [255], [301], [340], [480], [511]],
-                         np.int32)[:B]
+    q = jax.random.normal(kq, (B, 1, Hq, D), dtype)
+    kp = jax.random.normal(kk, (N, ps, Hk, D), dtype)
+    vp = jax.random.normal(kv, (N, ps, Hk, D), dtype)
+    max_pos = P * ps - 1
+    positions = np.linspace(5, max_pos, B).astype(np.int32).reshape(B, 1)
     pts = np.zeros((B, P), np.int32)
     page = 1
     for b in range(B):
-        for j in range(positions[b, 0] // ps + 1):
+        for j in range(int(positions[b, 0]) // ps + 1):
             pts[b, j] = page
             page += 1
-    pts, positions = jnp.asarray(pts), jnp.asarray(positions)
+    return q, kp, vp, jnp.asarray(pts), jnp.asarray(positions)
 
-    for softcap, win in [(None, None), (50.0, None), (None, 128)]:
-        w = None if win is None else jnp.int32(win)
-        ref = paged_attention(
-            q, kp, vp, pts, positions, scale=0.125,
-            logit_softcap=softcap, window=w,
-        )
-        t0 = time.monotonic()
-        out = paged_attention_decode(
-            q, kp, vp, pts, positions, scale=0.125,
-            logit_softcap=softcap, window=w, force_kernel=True,
-        )
-        out.block_until_ready()
-        err = float(jnp.max(jnp.abs(ref - out)))
-        print(f"paged decode softcap={softcap} win={win}: "
-              f"err={err:.2e} ({time.monotonic() - t0:.1f}s inc. compile)")
-        assert err < 2e-2, f"paged kernel mismatch: {err}"
 
-    # Timed steady-state: kernel vs gather at the same geometry.
-    timed = {}
-    for name, fn in [
-        ("kernel", lambda: paged_attention_decode(
-            q, kp, vp, pts, positions, scale=0.125, force_kernel=True)),
-        ("gather", lambda: paged_attention(
-            q, kp, vp, pts, positions, scale=0.125)),
-    ]:
-        fn()[0].block_until_ready()
-        t0 = time.monotonic()
-        for _ in range(20):
-            out = fn()
-        out.block_until_ready()
-        timed[name] = (time.monotonic() - t0) / 20 * 1e3
-    print(f"per-call: kernel {timed['kernel']:.2f} ms, "
-          f"gather {timed['gather']:.2f} ms")
+def check_paged_decode() -> None:
+    """VERDICT r2 #2 geometries: 8B serving shape at B=32 / 512-4k ctx in
+    bf16 (the serving dtype), Gemma-2 (Hk=16, softcap+sliding-window
+    COMBINED), explicit pages_per_block G variants, plus the fp32 tight-
+    tolerance sanity case."""
+    from polykey_tpu.ops.paged_attention import paged_attention
+    from polykey_tpu.ops.paged_attention_kernel import paged_attention_decode
+
+    cases = [
+        # (label, B, Hq, Hk, D, ps, P, dtype, tol, variants)
+        ("8b-fp32-512", 8, 32, 8, 128, 16, 32, jnp.float32, 2e-2,
+         [(None, None, 0), (50.0, None, 0), (None, 128, 0)]),
+        # Serving dtype at serving batch and long context; includes the
+        # Gemma combination (softcap AND window) and forced G variants
+        # (auto is 8 at ps=16 — G=1 and G=3 exercise the group loop
+        # boundaries, incl. a partial last group).
+        ("8b-bf16-4k", 32, 32, 8, 128, 16, 256, jnp.bfloat16, 8e-2,
+         [(None, None, 0), (None, None, 1), (None, None, 3),
+          (50.0, 1024, 0)]),
+        ("gemma27b-bf16-2k", 16, 32, 16, 128, 16, 128, jnp.bfloat16, 8e-2,
+         [(50.0, 1024, 0)]),
+    ]
+    for label, B, Hq, Hk, D, ps, P, dtype, tol, variants in cases:
+        q, kp, vp, pts, positions = _paged_inputs(B, Hq, Hk, D, ps, P, dtype)
+        refs: dict = {}
+        for softcap, win, g in variants:
+            w = None if win is None else jnp.int32(win)
+            if (softcap, win) not in refs:
+                refs[(softcap, win)] = paged_attention(
+                    q, kp, vp, pts, positions, scale=0.125,
+                    logit_softcap=softcap, window=w,
+                )
+            ref = refs[(softcap, win)]
+            t0 = time.monotonic()
+            out = paged_attention_decode(
+                q, kp, vp, pts, positions, scale=0.125,
+                logit_softcap=softcap, window=w, force_kernel=True,
+                pages_per_block=g,
+            )
+            out.block_until_ready()
+            err = float(jnp.max(jnp.abs(
+                ref.astype(jnp.float32) - out.astype(jnp.float32))))
+            print(f"paged {label} softcap={softcap} win={win} G={g or 'auto'}: "
+                  f"err={err:.2e} ({time.monotonic() - t0:.1f}s inc. compile)")
+            assert err < tol, f"paged kernel mismatch ({label}): {err}"
+
+        # Timed steady-state kernel vs gather per geometry — the tok/s-
+        # relevant delta (attention is the decode bandwidth bound).
+        timed = {}
+        for name, fn in [
+            ("kernel", lambda: paged_attention_decode(
+                q, kp, vp, pts, positions, scale=0.125, force_kernel=True)),
+            ("gather", lambda: paged_attention(
+                q, kp, vp, pts, positions, scale=0.125)),
+        ]:
+            fn()[0].block_until_ready()
+            t0 = time.monotonic()
+            for _ in range(20):
+                out = fn()
+            out.block_until_ready()
+            timed[name] = (time.monotonic() - t0) / 20 * 1e3
+        print(f"{label} per-call: kernel {timed['kernel']:.2f} ms, "
+              f"gather {timed['gather']:.2f} ms "
+              f"({timed['gather'] / max(timed['kernel'], 1e-9):.2f}x)")
 
 
 def check_flash() -> None:
     from polykey_tpu.ops.attention import attention, make_attention_mask
     from polykey_tpu.ops.flash_attention import flash_attention
 
-    B, T, S, Hq, Hk, D = 2, 512, 512, 32, 8, 128
-    key = jax.random.PRNGKey(1)
-    kq, kk, kv = jax.random.split(key, 3)
-    q = jax.random.normal(kq, (B, T, Hq, D), jnp.float32)
-    k = jax.random.normal(kk, (B, S, Hk, D), jnp.float32)
-    v = jax.random.normal(kv, (B, S, Hk, D), jnp.float32)
-    qpos = jnp.broadcast_to(jnp.arange(T), (B, T))
-    ref = attention(q, k, v, make_attention_mask(qpos, S), scale=0.088)
-    out = flash_attention(q, k, v, qpos, scale=0.088, force_kernel=True)
-    err = float(jnp.max(jnp.abs(ref - out)))
-    print(f"flash prefill: err={err:.2e}")
-    assert err < 2e-2, f"flash kernel mismatch: {err}"
+    cases = [
+        ("512-fp32", 2, 512, jnp.float32, 2e-2, None, None),
+        # Long-context prefill at the serving dtype, plus the Gemma
+        # combination (softcap + sliding window).
+        ("2k-bf16", 2, 2048, jnp.bfloat16, 8e-2, None, None),
+        ("2k-bf16-gemma", 2, 2048, jnp.bfloat16, 8e-2, 50.0, 1024),
+    ]
+    for label, B, T, dtype, tol, softcap, win in cases:
+        S, Hq, Hk, D = T, 32, 8, 128
+        key = jax.random.PRNGKey(1)
+        kq, kk, kv = jax.random.split(key, 3)
+        q = jax.random.normal(kq, (B, T, Hq, D), dtype)
+        k = jax.random.normal(kk, (B, S, Hk, D), dtype)
+        v = jax.random.normal(kv, (B, S, Hk, D), dtype)
+        qpos = jnp.broadcast_to(jnp.arange(T), (B, T))
+        w = None if win is None else jnp.int32(win)
+        ref = attention(
+            q, k, v, make_attention_mask(qpos, S, sliding_window=win),
+            scale=0.088, logit_softcap=softcap,
+        )
+        t0 = time.monotonic()
+        out = flash_attention(
+            q, k, v, qpos, scale=0.088, logit_softcap=softcap, window=w,
+            force_kernel=True,
+        )
+        out.block_until_ready()
+        err = float(jnp.max(jnp.abs(
+            ref.astype(jnp.float32) - out.astype(jnp.float32))))
+        print(f"flash {label}: err={err:.2e} "
+              f"({time.monotonic() - t0:.1f}s inc. compile)")
+        assert err < tol, f"flash kernel mismatch ({label}): {err}"
 
 
 def main() -> int:
