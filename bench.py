@@ -34,7 +34,9 @@ Knobs (env): POLYKEY_BENCH_MODEL, POLYKEY_BENCH_REQUESTS,
 POLYKEY_BENCH_PROMPT, POLYKEY_BENCH_NEW_TOKENS, POLYKEY_BENCH_BLOCK,
 POLYKEY_BENCH_LOOKAHEAD, POLYKEY_BENCH_8B_SLOTS, POLYKEY_BENCH_SKIP_8B=1,
 POLYKEY_BENCH_SKIP_SPEC=1, POLYKEY_BENCH_SKIP_LONGCTX=1,
-POLYKEY_BENCH_PROBE_TRIES, POLYKEY_BENCH_PROBE_TIMEOUT.
+POLYKEY_BENCH_SKIP_GEMMA_SPEC=1, POLYKEY_BENCH_GEMMA_SLOTS,
+POLYKEY_BENCH_TOKENIZER, POLYKEY_BENCH_PROBE_TRIES,
+POLYKEY_BENCH_PROBE_TIMEOUT.
 
 All progress chatter goes to stderr; stdout carries only the JSON line.
 """
@@ -546,6 +548,49 @@ def main() -> None:
         except Exception as e:
             log(f"phase C failed: {e}")
             result["engine_spec"] = {"error": str(e)}
+
+    # --- Phase C2: BASELINE config 5's actual SHAPE — a Gemma-2 target
+    # server-streamed with a real smaller-family draft (2B drafting for
+    # 9B, both int8; 27B exceeds one v5e's HBM — tp≥2 territory). Random
+    # weights mean acceptance is noise, so the adaptive-gamma dial is
+    # left ON and its collapse to the low rung is itself the evidence;
+    # throughput here is a floor, not the spec win. ---
+    if on_tpu and os.environ.get("POLYKEY_BENCH_SKIP_GEMMA_SPEC", "") != "1":
+        try:
+            log("--- phase C2: gemma-2-9b int8 + gemma-2-2b draft ---")
+            from polykey_tpu.models.config import get_config
+
+            t0 = time.monotonic()
+            params9 = fabricate_params(
+                get_config("gemma-2-9b"), "bfloat16", quantize=True)
+            params2 = fabricate_params(
+                get_config("gemma-2-2b"), "bfloat16", quantize=True)
+            log(f"fabricated 9B+2B int8 trees in {time.monotonic() - t0:.1f}s")
+            slots_g = int(os.environ.get("POLYKEY_BENCH_GEMMA_SLOTS", "8"))
+            cfg_c2 = EngineConfig(
+                model="gemma-2-9b",
+                draft_model="gemma-2-2b",
+                spec_gamma=4,
+                dtype="bfloat16",
+                quantize=False,  # params arrive pre-quantized
+                max_decode_slots=slots_g,
+                page_size=16,
+                num_pages=slots_g * 32 + 64,
+                max_seq_len=512,
+                prefill_buckets=(prompt_len,),
+                max_new_tokens_cap=max_new,
+                decode_block_steps=block,
+                lookahead_blocks=lookahead,
+                compile_warmup=True,
+                warm_sampled_variants=False,
+            )
+            result["engine_gemma_spec"] = bench_engine(
+                cfg_c2, params9, 2 * slots_g, prompt_len, max_new,
+                draft_params=params2,
+            )
+        except Exception as e:
+            log(f"phase C2 failed: {e}")
+            result["engine_gemma_spec"] = {"error": str(e)}
 
     # --- Compose the single line. Headline = the target-comparable number
     # when it exists (8B-class engine tok/s), else the phase-A number with

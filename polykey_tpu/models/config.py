@@ -148,6 +148,30 @@ GEMMA2_27B = ModelConfig(
     scale_embeddings=True,
 )
 
+GEMMA2_2B = ModelConfig(
+    # The family's small member (HF gemma-2-2b config.json values) — the
+    # natural speculative DRAFT for gemma-2-9b/27b (same 256k vocab).
+    name="gemma-2-2b",
+    vocab_size=256_128,
+    hidden_size=2304,
+    intermediate_size=9216,
+    num_layers=26,
+    num_heads=8,
+    num_kv_heads=4,
+    head_dim=256,
+    max_seq_len=8192,
+    rope_theta=10_000.0,
+    rms_norm_eps=1e-6,
+    tie_embeddings=True,
+    activation="gelu_tanh",
+    attn_logit_softcap=50.0,
+    final_logit_softcap=30.0,
+    sliding_window=4096,
+    query_pre_attn_scalar=256.0,
+    use_post_norms=True,
+    scale_embeddings=True,
+)
+
 GEMMA2_9B = ModelConfig(
     name="gemma-2-9b",
     vocab_size=256_128,
@@ -217,6 +241,7 @@ MODEL_REGISTRY = {
         MIXTRAL_8X7B,
         GEMMA2_27B,
         GEMMA2_9B,
+        GEMMA2_2B,
         TINY_LLAMA,
         TINY_MIXTRAL,
         TINY_GEMMA,

@@ -381,3 +381,29 @@ def test_adaptive_gamma_off_pins_full_gamma():
         assert eng._gamma == 4
     finally:
         eng.shutdown()
+
+
+def test_spec_heterogeneous_draft_architecture(monkeypatch):
+    """A REAL draft is a smaller model of the same family (config 5:
+    gemma-2-2b drafting for 9b) — different depth/heads/widths, same
+    vocab. The engine's draft pool must size itself from the DRAFT
+    config, and greedy output must still equal the plain engine's."""
+    from polykey_tpu.models.config import MODEL_REGISTRY, TINY_GEMMA
+
+    monkeypatch.setitem(
+        MODEL_REGISTRY, "tiny-gemma-draft",
+        dataclasses.replace(
+            TINY_GEMMA, name="tiny-gemma-draft",
+            num_layers=1, num_heads=2, num_kv_heads=1,
+            hidden_size=32, intermediate_size=64,
+            query_pre_attn_scalar=16.0,
+        ),
+    )
+    base = dataclasses.replace(BASE_CONFIG, model="tiny-gemma")
+    plain, _ = _run_prompts(base)
+    spec_cfg = dataclasses.replace(
+        base, draft_model="tiny-gemma-draft", spec_gamma=3
+    )
+    spec, snap = _run_prompts(spec_cfg)
+    assert spec == plain
+    assert snap["drafts_proposed"] > 0
