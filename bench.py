@@ -21,7 +21,11 @@ runs on CPU with a tiny model so the line still carries evidence, with
 "platform": "cpu" and vs_baseline null. Any crash still prints a diagnostic
 JSON line and exits 0.
 
-Phases beyond A/B: A-tok TTFT including real-BPE host encode (the
+Phases beyond A/B: 0 gateway echo roundtrip over real gRPC against the
+mock service (BASELINE config 1 — the dev_client request via
+build_test_request; `gateway_echo` key, `{"error": ...}` on failure,
+CPU-only so it lands even without the TPU), A-tok TTFT including
+real-BPE host encode (the
 locally-trained 32k tokenizer asset under assets/bench_tokenizer, or
 POLYKEY_BENCH_TOKENIZER; a recorded exclusion when absent), A2
 prefix-cache TTFT (cold vs warm suffix prefill), D long-context (2k
@@ -283,6 +287,50 @@ def main() -> None:
     # the sync roundtrip (~100 ms through the tunnel vs ~40 ms of 1B block
     # compute → depth 4; the 8B block is compute-heavier, 3 suffices).
     lookahead = int(os.environ.get("POLYKEY_BENCH_LOOKAHEAD", "4" if on_tpu else "2"))
+
+    # --- Phase 0: gateway echo roundtrip (BASELINE config 1 — dev_client
+    # example_tool over real gRPC against the mock service; pure CPU, so
+    # it lands even when the TPU is unreachable). ---
+    try:
+        import io
+
+        import grpc
+
+        from polykey_tpu.gateway import server as gateway_server
+        from polykey_tpu.gateway.client import build_test_request
+        from polykey_tpu.gateway.jsonlog import Logger
+        from polykey_tpu.gateway.mock_service import MockService
+        from polykey_tpu.proto.polykey_v2_grpc import PolykeyServiceStub
+
+        srv, _, port = gateway_server.build_server(
+            MockService(), Logger(stream=io.StringIO()),
+            address="127.0.0.1:0",
+        )
+        srv.start()
+        try:
+            with grpc.insecure_channel(f"127.0.0.1:{port}") as channel:
+                stub = PolykeyServiceStub(channel)
+                # The canonical dev_client payload (secret_id + metadata),
+                # not a hand-rolled lookalike — config 1 measures THAT
+                # request's serialization path.
+                req = build_test_request()
+                lat = []
+                for _ in range(100):
+                    t0 = time.monotonic()
+                    stub.ExecuteTool(req, timeout=5)
+                    lat.append((time.monotonic() - t0) * 1000)
+                lat.sort()
+                result["gateway_echo"] = {
+                    "p50_ms": round(lat[len(lat) // 2], 3),
+                    "p95_ms": round(lat[int(len(lat) * 0.95)], 3),
+                    "calls": len(lat),
+                }
+                log(f"phase 0 gateway echo: {result['gateway_echo']}")
+        finally:
+            srv.stop(0)
+    except Exception as e:
+        log(f"phase 0 failed: {e}")
+        result["gateway_echo"] = {"error": str(e)}
 
     # --- Phase A: engine bench, 1B-class bf16 (tiny on CPU fallback). ---
     model_a = os.environ.get(
