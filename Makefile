@@ -8,7 +8,7 @@ CXXFLAGS ?= -O2 -std=c++17 -Wall -Wextra
 BUILD_DIR := build
 
 .PHONY: help run run-client test test-models native protos clean bench dryrun \
-	kernel-check tunnel-probe
+	kernel-check tunnel-probe bench-tokenizer tpu-watch
 
 help: ## Show available targets
 	@grep -E '^[a-zA-Z_-]+:.*?## .*$$' $(MAKEFILE_LIST) | \
@@ -50,6 +50,17 @@ kernel-check: ## Compile + compare the Pallas kernels on real TPU
 
 tunnel-probe: ## Measure host<->device dispatch/transfer primitive costs
 	$(PYTHON) scripts/probe_tunnel.py
+
+bench-tokenizer: ## (Re)train the bench's local BPE tokenizer asset
+	$(PYTHON) scripts/build_bench_tokenizer.py
+
+tpu-watch: ## Detached watcher: kernel-check + bench when the TPU tunnel returns
+	@if ps -eo args | grep -q "^bash scripts/tpu_watcher.sh"; then \
+	  echo "watcher already running; tail perf/watcher.log"; \
+	else \
+	  setsid nohup bash scripts/tpu_watcher.sh >/dev/null 2>&1 & \
+	  echo "watcher detached; tail perf/watcher.log"; \
+	fi
 
 dryrun: ## Compile-check the multi-chip sharded step on a virtual mesh
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -143,6 +143,9 @@ def test_engine_config_from_env(monkeypatch):
         "POLYKEY_SP": "2",
         "POLYKEY_DRAFT_MODEL": "tiny-llama",
         "POLYKEY_SPEC_GAMMA": "3",
+        "POLYKEY_NUM_SLICES": "2",
+        "POLYKEY_ADAPTIVE_BLOCK": "0",
+        "POLYKEY_ADAPTIVE_GAMMA": "0",
     }
     for k, v in env.items():
         monkeypatch.setenv(k, v)
@@ -154,4 +157,7 @@ def test_engine_config_from_env(monkeypatch):
     assert (cfg.prefill_chunk, cfg.decode_block_steps) == (64, 4)
     assert (cfg.tp, cfg.dp, cfg.ep, cfg.sp) == (2, 2, 2, 2)
     assert (cfg.draft_model, cfg.spec_gamma) == ("tiny-llama", 3)
+    assert cfg.num_slices == 2
+    # The adaptive knobs default ON; "0" must pin them off.
+    assert not cfg.adaptive_block and not cfg.adaptive_gamma
     cfg.validate()

@@ -206,3 +206,21 @@ def test_hybrid_2slices_matches_single_device(reference_outputs):
     assert _run_prompts(
         dataclasses.replace(BASE_CONFIG, tp=2, dp=2, num_slices=2)
     ) == reference_outputs
+
+
+@_needs(4)
+def test_tp4_matches_single_device(monkeypatch):
+    """Config 3's axis at its real degree: tp=4 serving (Llama-3-8B has
+    Hk=8; the tiny stand-in needs 4 kv heads for tp=4 to divide). Greedy
+    output must equal the single-device engine's for the same model."""
+    from polykey_tpu.models.config import MODEL_REGISTRY, TINY_LLAMA
+
+    monkeypatch.setitem(
+        MODEL_REGISTRY, "tiny-llama-4kv",
+        dataclasses.replace(
+            TINY_LLAMA, name="tiny-llama-4kv", num_heads=8, num_kv_heads=4
+        ),
+    )
+    cfg = dataclasses.replace(BASE_CONFIG, model="tiny-llama-4kv")
+    ref = _run_prompts(cfg)
+    assert _run_prompts(dataclasses.replace(cfg, tp=4)) == ref

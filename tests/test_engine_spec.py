@@ -407,3 +407,18 @@ def test_spec_heterogeneous_draft_architecture(monkeypatch):
     spec, snap = _run_prompts(spec_cfg)
     assert spec == plain
     assert snap["drafts_proposed"] > 0
+
+
+def test_spec_quantized_engine_greedy_matches_quantized_plain():
+    """int8 weight-only target + int8 draft (the phase-C2 serving shape):
+    the quantized spec engine's greedy stream must equal the quantized
+    PLAIN engine's — quantization changes the logits, so the reference
+    is the quantized plain engine, not the fp32 one."""
+    plain_q, _ = _run_prompts(
+        dataclasses.replace(BASE_CONFIG, quantize=True)
+    )
+    spec_q, snap = _run_prompts(
+        dataclasses.replace(SPEC_CONFIG, quantize=True)
+    )
+    assert spec_q == plain_q
+    assert snap["drafts_proposed"] > 0
