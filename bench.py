@@ -34,6 +34,10 @@ draft == target (the acceptance-1.0 ceiling).
 A compile-shaped phase-A failure on TPU retries once with the Pallas
 kill-switches set (kernels_disabled recorded in the artifact).
 
+Run order is 0, A, B, A-tok, A2, D, C, C2 — the headline (B) runs as
+early as possible so a tunnel flap mid-bench still leaves a
+target-comparable number in the artifact.
+
 Knobs (env): POLYKEY_BENCH_MODEL, POLYKEY_BENCH_REQUESTS,
 POLYKEY_BENCH_PROMPT, POLYKEY_BENCH_NEW_TOKENS, POLYKEY_BENCH_BLOCK,
 POLYKEY_BENCH_LOOKAHEAD, POLYKEY_BENCH_8B_SLOTS, POLYKEY_BENCH_SKIP_8B=1,
@@ -389,6 +393,51 @@ def main() -> None:
         log(f"phase A failed: {e}")
         result["engine_1b"] = {"model": model_a, "error": str(e)}
 
+    # --- Phase B: 8B-int8 — the config the 2,000 tok/s target names. ---
+    phase_b = None
+    if on_tpu and os.environ.get("POLYKEY_BENCH_SKIP_8B", "") != "1":
+        try:
+            log("--- phase B: engine bench, llama-3-8b int8 ---")
+            from polykey_tpu.models.config import get_config
+
+            cfg8 = get_config("llama-3-8b")
+            t0 = time.monotonic()
+            params8 = fabricate_params(cfg8, "bfloat16", quantize=True)
+            log(f"fabricated 8B int8 tree in {time.monotonic() - t0:.1f}s")
+            # 32 slots x 512 positions = 1024 pages at full occupancy
+            # (+ reserved garbage page + slack): ~2 GiB of KV next to
+            # ~8.5 GiB of int8 weights on a 16 GiB chip. Batch width is
+            # the single-chip throughput lever while decode stays
+            # weight-bandwidth-bound.
+            slots8 = int(os.environ.get("POLYKEY_BENCH_8B_SLOTS", "32"))
+            cfg_b = EngineConfig(
+                model="llama-3-8b",
+                dtype="bfloat16",
+                quantize=False,  # params arrive pre-quantized
+                max_decode_slots=slots8,
+                page_size=16,
+                num_pages=slots8 * 32 + 64,
+                max_seq_len=512,
+                prefill_buckets=(prompt_len,),
+                max_new_tokens_cap=max_new,
+                decode_block_steps=block,
+                lookahead_blocks=lookahead,
+                compile_warmup=True,
+                warm_sampled_variants=False,
+            )
+            phase_b = bench_engine(
+                cfg_b, params8, max(2 * slots8, 32), prompt_len, max_new
+            )
+            result["engine_8b_int8"] = phase_b
+            # Free the ~8.5 GiB host tree (and let any lingering engine
+            # device buffers drop) before later phases allocate.
+            del params8
+            import gc
+            gc.collect()
+        except Exception as e:
+            log(f"phase B failed: {e}")
+            result["engine_8b_int8"] = {"error": str(e)}
+
     # --- Phase A-tok: TTFT with a REAL BPE tokenizer (VERDICT r2 #4:
     # every previous TTFT excluded host-side encode — the ByteTokenizer
     # is a table lookup; a 32k+ BPE pays real merge work per request).
@@ -493,46 +542,6 @@ def main() -> None:
         log(f"phase A2 failed: {e}")
         result["prefix_cache"] = {"error": str(e)}
 
-    # --- Phase B: 8B-int8 — the config the 2,000 tok/s target names. ---
-    phase_b = None
-    if on_tpu and os.environ.get("POLYKEY_BENCH_SKIP_8B", "") != "1":
-        try:
-            log("--- phase B: engine bench, llama-3-8b int8 ---")
-            from polykey_tpu.models.config import get_config
-
-            cfg8 = get_config("llama-3-8b")
-            t0 = time.monotonic()
-            params8 = fabricate_params(cfg8, "bfloat16", quantize=True)
-            log(f"fabricated 8B int8 tree in {time.monotonic() - t0:.1f}s")
-            # 32 slots x 512 positions = 1024 pages at full occupancy
-            # (+ reserved garbage page + slack): ~2 GiB of KV next to
-            # ~8.5 GiB of int8 weights on a 16 GiB chip. Batch width is
-            # the single-chip throughput lever while decode stays
-            # weight-bandwidth-bound.
-            slots8 = int(os.environ.get("POLYKEY_BENCH_8B_SLOTS", "32"))
-            cfg_b = EngineConfig(
-                model="llama-3-8b",
-                dtype="bfloat16",
-                quantize=False,  # params arrive pre-quantized
-                max_decode_slots=slots8,
-                page_size=16,
-                num_pages=slots8 * 32 + 64,
-                max_seq_len=512,
-                prefill_buckets=(prompt_len,),
-                max_new_tokens_cap=max_new,
-                decode_block_steps=block,
-                lookahead_blocks=lookahead,
-                compile_warmup=True,
-                warm_sampled_variants=False,
-            )
-            phase_b = bench_engine(
-                cfg_b, params8, max(2 * slots8, 32), prompt_len, max_new
-            )
-            result["engine_8b_int8"] = phase_b
-        except Exception as e:
-            log(f"phase B failed: {e}")
-            result["engine_8b_int8"] = {"error": str(e)}
-
     # --- Phase D: long-context serving — 2k-token prompts decoding at 4k
     # positions through chunked prefill + the paged kernel's grouped page
     # streaming (SURVEY §5 long-context; engine defaults are 4k). ---
@@ -593,6 +602,9 @@ def main() -> None:
                 draft_params=params1,
             )
             result["engine_spec"] = phase_c
+            del params1
+            import gc
+            gc.collect()
         except Exception as e:
             log(f"phase C failed: {e}")
             result["engine_spec"] = {"error": str(e)}
@@ -636,6 +648,9 @@ def main() -> None:
                 cfg_c2, params9, 2 * slots_g, prompt_len, max_new,
                 draft_params=params2,
             )
+            del params9, params2
+            import gc
+            gc.collect()
         except Exception as e:
             log(f"phase C2 failed: {e}")
             result["engine_gemma_spec"] = {"error": str(e)}
