@@ -34,9 +34,10 @@ draft == target (the acceptance-1.0 ceiling).
 A compile-shaped phase-A failure on TPU retries once with the Pallas
 kill-switches set (kernels_disabled recorded in the artifact).
 
-Run order is 0, A, B, A-tok, A2, D, C, C2 — the headline (B) runs as
-early as possible so a tunnel flap mid-bench still leaves a
-target-comparable number in the artifact.
+Run order is 0, A, B, B2, A-tok, A2, D, C, C2 — the headline phases
+(B int8, B2 int4; the JSON line takes the better) run as early as
+possible so a tunnel flap mid-bench still leaves a target-comparable
+number in the artifact. POLYKEY_BENCH_SKIP_8B_INT4=1 skips B2.
 
 Knobs (env): POLYKEY_BENCH_MODEL, POLYKEY_BENCH_REQUESTS,
 POLYKEY_BENCH_PROMPT, POLYKEY_BENCH_NEW_TOKENS, POLYKEY_BENCH_BLOCK,
@@ -92,7 +93,7 @@ def probe_backend() -> str | None:
     return None
 
 
-def fabricate_params(cfg, dtype, quantize: bool):
+def fabricate_params(cfg, dtype, quantize: bool, bits: int = 8):
     """Random params with real shapes/dtypes, built leaf-by-leaf on the host
     so an 8B tree never materializes at fp32 on device (or at all): int8
     leaves are filled directly — the engine's throughput doesn't depend on
@@ -106,7 +107,7 @@ def fabricate_params(cfg, dtype, quantize: bool):
 
     def build():
         p = init_params(jax.random.PRNGKey(0), cfg, dtype)
-        return quantize_params(p, cfg) if quantize else p
+        return quantize_params(p, cfg, bits=bits) if quantize else p
 
     tree = jax.eval_shape(build)
     rng = np.random.default_rng(0)
@@ -117,9 +118,13 @@ def fabricate_params(cfg, dtype, quantize: bool):
     pool_f32 = (rng.standard_normal(1 << 20, np.float32) * 0.02)
     pool_bf16 = pool_f32.astype(ml_dtypes.bfloat16)
 
+    pool_i4 = rng.integers(-7, 8, 1 << 20).astype(ml_dtypes.int4)
+
     def make(sd):
         if sd.dtype == np.int8:
             return np.resize(pool_i8, sd.shape)
+        if sd.dtype == ml_dtypes.int4:
+            return np.resize(pool_i4, sd.shape)
         if sd.dtype == np.float32:
             return np.resize(pool_f32, sd.shape)
         return np.resize(pool_bf16, sd.shape)
@@ -438,6 +443,50 @@ def main() -> None:
             log(f"phase B failed: {e}")
             result["engine_8b_int8"] = {"error": str(e)}
 
+    # --- Phase B2: 8B int4 — the beat-the-target lever. Group-wise int4
+    # halves weight HBM traffic vs int8; decode is weight-bandwidth-bound
+    # at these batch sizes, so the ceiling roughly doubles. Same model,
+    # same greedy workload — a valid 8B target number; the headline takes
+    # the better of B/B2. ---
+    phase_b2 = None
+    if (on_tpu
+            and os.environ.get("POLYKEY_BENCH_SKIP_8B", "") != "1"
+            and os.environ.get("POLYKEY_BENCH_SKIP_8B_INT4", "") != "1"):
+        try:
+            log("--- phase B2: engine bench, llama-3-8b int4 ---")
+            from polykey_tpu.models.config import get_config
+
+            cfg8 = get_config("llama-3-8b")
+            t0 = time.monotonic()
+            params4 = fabricate_params(cfg8, "bfloat16", quantize=True, bits=4)
+            log(f"fabricated 8B int4 tree in {time.monotonic() - t0:.1f}s")
+            slots8 = int(os.environ.get("POLYKEY_BENCH_8B_SLOTS", "32"))
+            cfg_b2 = EngineConfig(
+                model="llama-3-8b",
+                dtype="bfloat16",
+                quantize=False,  # params arrive pre-quantized
+                max_decode_slots=slots8,
+                page_size=16,
+                num_pages=slots8 * 32 + 64,
+                max_seq_len=512,
+                prefill_buckets=(prompt_len,),
+                max_new_tokens_cap=max_new,
+                decode_block_steps=block,
+                lookahead_blocks=lookahead,
+                compile_warmup=True,
+                warm_sampled_variants=False,
+            )
+            phase_b2 = bench_engine(
+                cfg_b2, params4, max(2 * slots8, 32), prompt_len, max_new
+            )
+            result["engine_8b_int4"] = phase_b2
+            del params4
+            import gc
+            gc.collect()
+        except Exception as e:
+            log(f"phase B2 failed: {e}")
+            result["engine_8b_int4"] = {"error": str(e)}
+
     # --- Phase A-tok: TTFT with a REAL BPE tokenizer (VERDICT r2 #4:
     # every previous TTFT excluded host-side encode — the ByteTokenizer
     # is a table lookup; a 32k+ BPE pays real merge work per request).
@@ -659,13 +708,24 @@ def main() -> None:
     # when it exists (8B-class engine tok/s), else the phase-A number with
     # vs_baseline null (ADVICE r1: no apples-to-oranges ratio). ---
     baseline = 2000.0  # BASELINE.md: tok/s/chip, 8B-class greedy on v5e
-    if phase_b is not None:
+    # Headline: the best valid 8B greedy number (int8 vs int4 — both are
+    # "Llama-3-8B greedy decode on one chip"; quantization width is an
+    # implementation choice the target doesn't constrain).
+    candidates_8b = [
+        ("int8", phase_b), ("int4", phase_b2)
+    ]
+    best = max(
+        (c for c in candidates_8b if c[1] is not None and "tok_s" in c[1]),
+        key=lambda c: c[1]["tok_s"], default=None,
+    )
+    if best is not None:
+        qname, phase_best = best
         line = {
-            "metric": "llama3_8b_int8_engine_tok_s_per_chip",
-            "value": phase_b["tok_s"],
+            "metric": f"llama3_8b_{qname}_engine_tok_s_per_chip",
+            "value": phase_best["tok_s"],
             "unit": "tok/s",
-            "vs_baseline": round(phase_b["tok_s"] / baseline, 3),
-            "p50_ttft_ms": phase_b["p50_ttft_ms"],
+            "vs_baseline": round(phase_best["tok_s"] / baseline, 3),
+            "p50_ttft_ms": phase_best["p50_ttft_ms"],
             "details": result,
         }
     elif "tok_s" in result.get("engine_1b", {}):

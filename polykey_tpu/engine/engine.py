@@ -408,7 +408,9 @@ class InferenceEngine:
             # enabler — v5e has 16 GiB; see models/quant.py).
             from ..models.quant import quantize_params
 
-            params = quantize_params(params, self.model_cfg)
+            params = quantize_params(
+                params, self.model_cfg, bits=config.quantize_bits
+            )
         self.params = shard_params(params, self.model_cfg, self.mesh)
 
         B, P = config.max_decode_slots, config.pages_per_seq
@@ -497,7 +499,9 @@ class InferenceEngine:
                 # could push the HBM budget the flag exists to protect.
                 from ..models.quant import quantize_params
 
-                d_params = quantize_params(d_params, self.draft_cfg)
+                d_params = quantize_params(
+                    d_params, self.draft_cfg, bits=config.quantize_bits
+                )
             self.draft_params = shard_params(d_params, self.draft_cfg, self.mesh)
             self.d_paged = jax.device_put(
                 init_paged_kv(

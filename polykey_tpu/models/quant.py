@@ -1,4 +1,4 @@
-"""Int8 weight-only quantization for serving.
+"""Int8 / int4 weight-only quantization for serving.
 
 One v5e chip has 16 GiB HBM; Llama-3-8B in bf16 is ~16 GiB of weights alone,
 so the single-chip serving story for 8B-class models (BASELINE.md config 2)
@@ -7,10 +7,24 @@ inside the matmul (`(x @ q) * s` — XLA fuses the int8→bf16 cast into the
 MXU feed, so HBM traffic halves, which is the whole game for bandwidth-bound
 decode). Activations stay bf16; norms/router stay fp (negligible bytes).
 
-Representation: a `QuantizedTensor` pytree leaf-pair (int8 values + fp32
+int4 (POLYKEY_QUANTIZE=int4) halves weight traffic again — the lever for
+beating, not just meeting, the weight-bandwidth-bound throughput target.
+Because 4-bit symmetric ([-7, 7]) is too coarse for a whole contraction
+axis, int4 uses GROUP-WISE scales (group_size along the contraction axis,
+AWQ/GPTQ granularity): q is jnp.int4 (XLA packs 2/byte in HBM),
+s is [..., in/g, out], and dequantization happens on the weight side
+(`x @ (q·s)`), an elementwise producer XLA fuses into the dot's operand
+load. The embedding and lm_head stay int8: the embedding is a sparse
+gather (bandwidth-irrelevant, and int4 gathers lower poorly), and the
+unembed keeps its exact narrow-operand fp32-accumulate path.
+
+Representation: a `QuantizedTensor` pytree leaf-pair (int values + fp32
 scales) that flows through jit/sharding like any array pair. The matmul
 seam is `qdot` — every linear in layers.py/transformer.py routes through it
-and dispatches on type, so the same forward serves fp and int8 trees.
+and dispatches on type, so the same forward serves fp, int8, and int4
+trees. Group-wise `s` has the same rank as `q` with the group axis in the
+contraction position, so row-parallel (Megatron) sharding of the
+contraction axis shards the groups consistently.
 
 The reference has no quantization (25 Go files, no ML — SURVEY.md §2); this
 is owed to the north star's single-chip 8B serving target.
@@ -29,10 +43,13 @@ from .config import ModelConfig
 
 @struct.dataclass
 class QuantizedTensor:
-    """Int8 weights with per-output-channel fp32 scales.
+    """Int8/int4 weights with fp32 scales.
 
-    q: int8, original weight shape [..., in, out]
-    s: fp32, [..., out] — scale over the contraction (in) axis.
+    q: int8 [..., in, out] (bits=8) or int4 (bits=4), weight shape.
+    s: fp32 scales —
+       bits=8: [..., out], per-output-channel over the contraction axis;
+       bits=4: [..., in/group, out], group-wise along the contraction
+       axis (same rank as q, group axis in the contraction position).
     act_dtype: the pre-quantization weight dtype; dequantization targets it
     so an fp32-configured model is not silently narrowed to bf16 (and
     callers sizing KV caches off params["embed"].dtype see the activation
@@ -42,6 +59,7 @@ class QuantizedTensor:
     q: jax.Array
     s: jax.Array
     act_dtype: jnp.dtype = struct.field(pytree_node=False, default=jnp.bfloat16)
+    bits: int = struct.field(pytree_node=False, default=8)
 
     @property
     def shape(self):
@@ -52,26 +70,67 @@ class QuantizedTensor:
         return jnp.dtype(self.act_dtype)
 
 
-def quantize(w: jax.Array) -> QuantizedTensor:
-    """Symmetric per-output-channel int8 quantization of [..., in, out]."""
-    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2)     # [..., out]
-    scale = jnp.maximum(absmax, 1e-8) / 127.0
+def quantize(
+    w: jax.Array, bits: int = 8, group_size: int = 128
+) -> QuantizedTensor:
+    """Symmetric quantization of [..., in, out].
+
+    bits=8: per-output-channel scales. bits=4: group-wise scales along
+    the contraction axis (group_size, shrunk to the full axis when it
+    doesn't divide — tiny test models)."""
+    if bits == 8:
+        absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2)  # [..., out]
+        scale = jnp.maximum(absmax, 1e-8) / 127.0
+        q = jnp.clip(
+            jnp.round(w.astype(jnp.float32) / scale[..., None, :]), -127, 127
+        ).astype(jnp.int8)
+        return QuantizedTensor(q=q, s=scale, act_dtype=jnp.dtype(w.dtype))
+    if bits != 4:
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
+    cin = w.shape[-2]
+    g = group_size if cin % group_size == 0 else cin
+    wf = w.astype(jnp.float32)
+    grouped = wf.reshape(*w.shape[:-2], cin // g, g, w.shape[-1])
+    absmax = jnp.max(jnp.abs(grouped), axis=-2)            # [..., G, out]
+    scale = jnp.maximum(absmax, 1e-8) / 7.0
     q = jnp.clip(
-        jnp.round(w.astype(jnp.float32) / scale[..., None, :]), -127, 127
-    ).astype(jnp.int8)
-    return QuantizedTensor(q=q, s=scale, act_dtype=jnp.dtype(w.dtype))
+        jnp.round(grouped / scale[..., None, :]), -7, 7
+    ).reshape(w.shape).astype(jnp.int4)
+    return QuantizedTensor(
+        q=q, s=scale, act_dtype=jnp.dtype(w.dtype), bits=4
+    )
 
 
 def dequantize(w: QuantizedTensor, dtype=jnp.bfloat16) -> jax.Array:
+    if w.bits == 4:
+        # One group-layout implementation only — qdot's fused path and
+        # this reference must never drift apart.
+        return _deq_weight(w, jnp.float32).astype(dtype)
     return (w.q.astype(jnp.float32) * w.s[..., None, :]).astype(dtype)
 
 
 WeightLike = Union[jax.Array, QuantizedTensor]
 
 
+def _deq_weight(w: QuantizedTensor, dtype) -> jax.Array:
+    """Weight-side group-wise dequantization in the activation dtype — an
+    elementwise producer XLA fuses into the consuming dot's operand load,
+    so HBM traffic stays int4 values + small scales."""
+    G = w.s.shape[-2]
+    cin, cout = w.q.shape[-2], w.q.shape[-1]
+    grouped = w.q.reshape(*w.q.shape[:-2], G, cin // G, cout).astype(dtype)
+    return (grouped * w.s[..., None, :].astype(dtype)).reshape(w.q.shape)
+
+
 def qdot(x: jax.Array, w: WeightLike) -> jax.Array:
-    """x @ w with on-the-fly dequantization for QuantizedTensor weights."""
+    """x @ w with on-the-fly dequantization for QuantizedTensor weights.
+
+    int8 scales fold AFTER the matmul (per-output-channel); int4 scales
+    vary along the contraction axis, so dequantization moves to the
+    weight side of the dot."""
     if isinstance(w, QuantizedTensor):
+        if w.bits == 4:
+            return x @ _deq_weight(w, x.dtype)
         y = x @ w.q.astype(x.dtype)
         return y * w.s.astype(x.dtype)
     return x @ w
@@ -80,11 +139,14 @@ def qdot(x: jax.Array, w: WeightLike) -> jax.Array:
 def qeinsum_expert(
     pattern: str, x: jax.Array, w: WeightLike, e_axis: int, **kwargs
 ):
-    """Expert-stacked einsum: scales are [E, out]; `e_axis` names the expert
-    axis in the OUTPUT (out is always last). Covers both MoE formulations:
-    'bth,ehi->beti' (e_axis=1) and the dispatch path 'ech,ehi->eci'
-    (e_axis=0)."""
+    """Expert-stacked einsum: int8 scales are [E, out]; `e_axis` names the
+    expert axis in the OUTPUT (out is always last). Covers both MoE
+    formulations: 'bth,ehi->beti' (e_axis=1) and the dispatch path
+    'ech,ehi->eci' (e_axis=0). int4 dequantizes weight-side (group axis
+    inside the expert stack)."""
     if isinstance(w, QuantizedTensor):
+        if w.bits == 4:
+            return jnp.einsum(pattern, x, _deq_weight(w, x.dtype), **kwargs)
         y = jnp.einsum(pattern, x, w.q.astype(x.dtype), **kwargs)
         shape = [1] * y.ndim
         shape[e_axis] = w.s.shape[0]
@@ -139,17 +201,20 @@ def unembed_logits(hidden: jax.Array, embed_or_head: WeightLike, tied: bool):
 _QUANT_LEAVES = ("wq", "wk", "wv", "wo", "gate", "up", "down", "lm_head")
 
 
-def quantize_params(params: dict, cfg: ModelConfig) -> dict:
+def quantize_params(params: dict, cfg: ModelConfig, bits: int = 8) -> dict:
     """Quantize every linear weight in the tree; norms, router, and biases
     stay fp. The embedding is quantized per hidden channel so the same
-    tensor serves lookup and (tied) unembedding."""
+    tensor serves lookup and (tied) unembedding. With bits=4 the BLOCK
+    linears go int4 group-wise; embed/lm_head stay int8 (sparse gather +
+    the exact narrow-operand unembed path — see module docstring)."""
 
     def walk(node):
         if isinstance(node, dict):
             out = {}
             for name, child in node.items():
                 if name in _QUANT_LEAVES and isinstance(child, jax.Array):
-                    out[name] = quantize(child)
+                    leaf_bits = 8 if name == "lm_head" else bits
+                    out[name] = quantize(child, bits=leaf_bits)
                 else:
                     # Covers the experts subtree too: gate/up/down are in
                     # _QUANT_LEAVES and quantize() handles the leading
@@ -170,6 +235,15 @@ def quantize_params(params: dict, cfg: ModelConfig) -> dict:
 
 
 def params_bytes(params) -> int:
-    """Total parameter storage in bytes (quantized trees count q + s)."""
-    leaves = jax.tree.leaves(params)
-    return sum(leaf.size * leaf.dtype.itemsize for leaf in leaves)
+    """Total parameter storage in bytes (quantized trees count q + s).
+
+    int4 counts 0.5 byte/element: XLA packs s4 two-per-byte in device
+    HBM (the number that matters for the bandwidth bound), even though
+    the host-side numpy representation is byte-per-element."""
+    total = 0
+    for leaf in jax.tree.leaves(params):
+        if leaf.dtype == jnp.int4:
+            total += (leaf.size + 1) // 2
+        else:
+            total += leaf.size * leaf.dtype.itemsize
+    return total

@@ -51,14 +51,32 @@ _TOP_RULES: dict[tuple[str, ...], P] = {
 }
 
 
-def _spec_for_path(path: tuple[str, ...]) -> P:
+def _spec_for_path(
+    path: tuple[str, ...], leaf=None, mesh: Optional[Mesh] = None
+) -> P:
     # Quantized leaves (models/quant.py QuantizedTensor): `q` keeps the
-    # weight's spec; `s` is the weight shape minus the contraction (-2)
-    # axis, so its spec is the weight spec with that axis dropped
+    # weight's spec. int8 `s` is the weight shape minus the contraction
+    # (-2) axis, so its spec is the weight spec with that axis dropped
     # (e.g. wq [L, H, out] P("pp", None, "tp") → s [L, out] P("pp", "tp")).
+    # int4 `s` is group-wise [..., in/g, out] — SAME rank as q with the
+    # group axis in the contraction position, so a tp-sharded contraction
+    # axis shards the groups the same way WHEN the group count divides;
+    # otherwise (tiny models: one group) the group axis replicates and
+    # GSPMD re-shards at the dequant reshape. Discriminated by rank.
     if path and path[-1] in ("q", "s"):
         base = _spec_for_path(path[:-1])
         if path[-1] == "q":
+            return base
+        ndim = getattr(leaf, "ndim", -1)
+        if ndim == len(base):                # group-wise (int4)
+            contr = base[-2]
+            if contr is not None and mesh is not None:
+                axes = contr if isinstance(contr, tuple) else (contr,)
+                size = 1
+                for a in axes:
+                    size *= mesh.shape[a]
+                if leaf.shape[-2] % size != 0:
+                    return P(*base[:-2], None, base[-1])
             return base
         return P(*base[:-2], base[-1]) if len(base) >= 2 else base
     if path in _TOP_RULES:
@@ -92,7 +110,9 @@ def param_shardings(cfg: ModelConfig, mesh: Mesh, params_tree=None):
             lambda: init_params(jax.random.PRNGKey(0), cfg)
         )
     return jax.tree_util.tree_map_with_path(
-        lambda path, _: NamedSharding(mesh, _spec_for_path(_path_keys(path))),
+        lambda path, leaf: NamedSharding(
+            mesh, _spec_for_path(_path_keys(path), leaf, mesh)
+        ),
         params_tree,
     )
 

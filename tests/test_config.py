@@ -158,6 +158,17 @@ def test_engine_config_from_env(monkeypatch):
     assert (cfg.tp, cfg.dp, cfg.ep, cfg.sp) == (2, 2, 2, 2)
     assert (cfg.draft_model, cfg.spec_gamma) == ("tiny-llama", 3)
     assert cfg.num_slices == 2
+    assert cfg.quantize_bits == 8
     # The adaptive knobs default ON; "0" must pin them off.
     assert not cfg.adaptive_block and not cfg.adaptive_gamma
+    cfg.validate()
+
+
+def test_engine_config_int4_env(monkeypatch):
+    """POLYKEY_QUANTIZE=int4 selects 4-bit weight-only quantization."""
+    from polykey_tpu.engine.config import EngineConfig
+
+    monkeypatch.setenv("POLYKEY_QUANTIZE", "int4")
+    cfg = EngineConfig.from_env()
+    assert cfg.quantize and cfg.quantize_bits == 4
     cfg.validate()

@@ -654,3 +654,25 @@ def test_adaptive_block_solo_vs_loaded():
         assert eng._last_dispatch_steps == 8
     finally:
         eng.shutdown()
+
+
+def test_int4_engine_serves():
+    """POLYKEY_QUANTIZE=int4 path: group-wise int4 weight-only engine
+    generates end to end and stays deterministic (greedy)."""
+    import dataclasses
+
+    eng = InferenceEngine(
+        dataclasses.replace(TEST_CONFIG, quantize=True, quantize_bits=4)
+    )
+    try:
+        r1 = GenRequest(prompt="hello", max_new_tokens=8, temperature=0.0)
+        r2 = GenRequest(prompt="hello", max_new_tokens=8, temperature=0.0)
+        eng.submit(r1)
+        t1, d1, e1 = _collect(r1)
+        eng.submit(r2)
+        t2, d2, e2 = _collect(r2)
+        assert e1 is None and e2 is None
+        assert d1 is not None and d2 is not None
+        assert t1 == t2 and len(t1) == 8
+    finally:
+        eng.shutdown()
