@@ -44,6 +44,7 @@ POLYKEY_BENCH_PROMPT, POLYKEY_BENCH_NEW_TOKENS, POLYKEY_BENCH_BLOCK,
 POLYKEY_BENCH_LOOKAHEAD, POLYKEY_BENCH_8B_SLOTS, POLYKEY_BENCH_SKIP_8B=1,
 POLYKEY_BENCH_SKIP_SPEC=1, POLYKEY_BENCH_SKIP_LONGCTX=1,
 POLYKEY_BENCH_SKIP_GEMMA_SPEC=1, POLYKEY_BENCH_GEMMA_SLOTS,
+POLYKEY_BENCH_SKIP_8B_INT4=1, POLYKEY_BENCH_8B_INT4_SLOTS,
 POLYKEY_BENCH_TOKENIZER, POLYKEY_BENCH_PROBE_TRIES,
 POLYKEY_BENCH_PROBE_TIMEOUT.
 
@@ -460,7 +461,11 @@ def main() -> None:
             t0 = time.monotonic()
             params4 = fabricate_params(cfg8, "bfloat16", quantize=True, bits=4)
             log(f"fabricated 8B int4 tree in {time.monotonic() - t0:.1f}s")
-            slots8 = int(os.environ.get("POLYKEY_BENCH_8B_SLOTS", "32"))
+            # int4 frees ~4 GiB of HBM vs int8 — spend it on batch width
+            # (48 slots ≈ 3.2 GiB KV at 512 ctx next to ~4.4 GiB weights):
+            # more tokens per weight pass while decode stays bandwidth-
+            # bound.
+            slots8 = int(os.environ.get("POLYKEY_BENCH_8B_INT4_SLOTS", "48"))
             cfg_b2 = EngineConfig(
                 model="llama-3-8b",
                 dtype="bfloat16",
