@@ -464,8 +464,12 @@ def main() -> None:
             # int4 frees ~4 GiB of HBM vs int8 — spend it on batch width
             # (48 slots ≈ 3.2 GiB KV at 512 ctx next to ~4.4 GiB weights):
             # more tokens per weight pass while decode stays bandwidth-
-            # bound.
-            slots8 = int(os.environ.get("POLYKEY_BENCH_8B_INT4_SLOTS", "48"))
+            # bound. An explicit POLYKEY_BENCH_8B_SLOTS cap (operator HBM
+            # budget) carries over unless the int4 knob overrides it.
+            slots8 = int(os.environ.get(
+                "POLYKEY_BENCH_8B_INT4_SLOTS",
+                os.environ.get("POLYKEY_BENCH_8B_SLOTS", "48"),
+            ))
             cfg_b2 = EngineConfig(
                 model="llama-3-8b",
                 dtype="bfloat16",

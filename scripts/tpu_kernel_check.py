@@ -61,48 +61,64 @@ def check_paged_decode() -> None:
         ("gemma27b-bf16-2k", 16, 32, 16, 128, 16, 128, jnp.bfloat16, 8e-2,
          [(50.0, 1024, 0)]),
     ]
+    failures: list[str] = []
     for label, B, Hq, Hk, D, ps, P, dtype, tol, variants in cases:
-        q, kp, vp, pts, positions = _paged_inputs(B, Hq, Hk, D, ps, P, dtype)
-        refs: dict = {}
-        for softcap, win, g in variants:
-            w = None if win is None else jnp.int32(win)
-            if (softcap, win) not in refs:
-                refs[(softcap, win)] = paged_attention(
+        # Isolate per-case: an unattended run (tpu_watcher) must keep the
+        # other geometries' evidence when one compile or OOM fails.
+        q = kp = vp = None
+        try:
+            q, kp, vp, pts, positions = _paged_inputs(
+                B, Hq, Hk, D, ps, P, dtype)
+            refs: dict = {}
+            for softcap, win, g in variants:
+                w = None if win is None else jnp.int32(win)
+                if (softcap, win) not in refs:
+                    refs[(softcap, win)] = paged_attention(
+                        q, kp, vp, pts, positions, scale=0.125,
+                        logit_softcap=softcap, window=w,
+                    )
+                ref = refs[(softcap, win)]
+                t0 = time.monotonic()
+                out = paged_attention_decode(
                     q, kp, vp, pts, positions, scale=0.125,
-                    logit_softcap=softcap, window=w,
+                    logit_softcap=softcap, window=w, force_kernel=True,
+                    pages_per_block=g,
                 )
-            ref = refs[(softcap, win)]
-            t0 = time.monotonic()
-            out = paged_attention_decode(
-                q, kp, vp, pts, positions, scale=0.125,
-                logit_softcap=softcap, window=w, force_kernel=True,
-                pages_per_block=g,
-            )
-            out.block_until_ready()
-            err = float(jnp.max(jnp.abs(
-                ref.astype(jnp.float32) - out.astype(jnp.float32))))
-            print(f"paged {label} softcap={softcap} win={win} G={g or 'auto'}: "
-                  f"err={err:.2e} ({time.monotonic() - t0:.1f}s inc. compile)")
-            assert err < tol, f"paged kernel mismatch ({label}): {err}"
+                out.block_until_ready()
+                err = float(jnp.max(jnp.abs(
+                    ref.astype(jnp.float32) - out.astype(jnp.float32))))
+                print(f"paged {label} softcap={softcap} win={win} "
+                      f"G={g or 'auto'}: err={err:.2e} "
+                      f"({time.monotonic() - t0:.1f}s inc. compile)")
+                assert err < tol, f"paged kernel mismatch ({label}): {err}"
 
-        # Timed steady-state kernel vs gather per geometry — the tok/s-
-        # relevant delta (attention is the decode bandwidth bound).
-        timed = {}
-        for name, fn in [
-            ("kernel", lambda: paged_attention_decode(
-                q, kp, vp, pts, positions, scale=0.125, force_kernel=True)),
-            ("gather", lambda: paged_attention(
-                q, kp, vp, pts, positions, scale=0.125)),
-        ]:
-            fn()[0].block_until_ready()
-            t0 = time.monotonic()
-            for _ in range(20):
-                out = fn()
-            out.block_until_ready()
-            timed[name] = (time.monotonic() - t0) / 20 * 1e3
-        print(f"{label} per-call: kernel {timed['kernel']:.2f} ms, "
-              f"gather {timed['gather']:.2f} ms "
-              f"({timed['gather'] / max(timed['kernel'], 1e-9):.2f}x)")
+            # Timed steady-state kernel vs gather per geometry — the
+            # tok/s-relevant delta (attention is the decode bandwidth
+            # bound).
+            timed = {}
+            for name, fn in [
+                ("kernel", lambda: paged_attention_decode(
+                    q, kp, vp, pts, positions, scale=0.125,
+                    force_kernel=True)),
+                ("gather", lambda: paged_attention(
+                    q, kp, vp, pts, positions, scale=0.125)),
+            ]:
+                fn()[0].block_until_ready()
+                t0 = time.monotonic()
+                for _ in range(20):
+                    out = fn()
+                out.block_until_ready()
+                timed[name] = (time.monotonic() - t0) / 20 * 1e3
+            print(f"{label} per-call: kernel {timed['kernel']:.2f} ms, "
+                  f"gather {timed['gather']:.2f} ms "
+                  f"({timed['gather'] / max(timed['kernel'], 1e-9):.2f}x)")
+        except Exception as e:
+            print(f"paged {label} FAILED: {type(e).__name__}: {e}")
+            failures.append(f"paged {label}: {e}")
+        finally:
+            del q, kp, vp  # free the case's pools before the next one
+    if failures:
+        raise AssertionError("; ".join(failures))
 
 
 def check_flash() -> None:
@@ -116,30 +132,37 @@ def check_flash() -> None:
         ("2k-bf16", 2, 2048, jnp.bfloat16, 8e-2, None, None),
         ("2k-bf16-gemma", 2, 2048, jnp.bfloat16, 8e-2, 50.0, 1024),
     ]
+    failures: list[str] = []
     for label, B, T, dtype, tol, softcap, win in cases:
-        S, Hq, Hk, D = T, 32, 8, 128
-        key = jax.random.PRNGKey(1)
-        kq, kk, kv = jax.random.split(key, 3)
-        q = jax.random.normal(kq, (B, T, Hq, D), dtype)
-        k = jax.random.normal(kk, (B, S, Hk, D), dtype)
-        v = jax.random.normal(kv, (B, S, Hk, D), dtype)
-        qpos = jnp.broadcast_to(jnp.arange(T), (B, T))
-        w = None if win is None else jnp.int32(win)
-        ref = attention(
-            q, k, v, make_attention_mask(qpos, S, sliding_window=win),
-            scale=0.088, logit_softcap=softcap,
-        )
-        t0 = time.monotonic()
-        out = flash_attention(
-            q, k, v, qpos, scale=0.088, logit_softcap=softcap, window=w,
-            force_kernel=True,
-        )
-        out.block_until_ready()
-        err = float(jnp.max(jnp.abs(
-            ref.astype(jnp.float32) - out.astype(jnp.float32))))
-        print(f"flash {label}: err={err:.2e} "
-              f"({time.monotonic() - t0:.1f}s inc. compile)")
-        assert err < tol, f"flash kernel mismatch ({label}): {err}"
+        try:
+            S, Hq, Hk, D = T, 32, 8, 128
+            key = jax.random.PRNGKey(1)
+            kq, kk, kv = jax.random.split(key, 3)
+            q = jax.random.normal(kq, (B, T, Hq, D), dtype)
+            k = jax.random.normal(kk, (B, S, Hk, D), dtype)
+            v = jax.random.normal(kv, (B, S, Hk, D), dtype)
+            qpos = jnp.broadcast_to(jnp.arange(T), (B, T))
+            w = None if win is None else jnp.int32(win)
+            ref = attention(
+                q, k, v, make_attention_mask(qpos, S, sliding_window=win),
+                scale=0.088, logit_softcap=softcap,
+            )
+            t0 = time.monotonic()
+            out = flash_attention(
+                q, k, v, qpos, scale=0.088, logit_softcap=softcap, window=w,
+                force_kernel=True,
+            )
+            out.block_until_ready()
+            err = float(jnp.max(jnp.abs(
+                ref.astype(jnp.float32) - out.astype(jnp.float32))))
+            print(f"flash {label}: err={err:.2e} "
+                  f"({time.monotonic() - t0:.1f}s inc. compile)")
+            assert err < tol, f"flash kernel mismatch ({label}): {err}"
+        except Exception as e:
+            print(f"flash {label} FAILED: {type(e).__name__}: {e}")
+            failures.append(f"flash {label}: {e}")
+    if failures:
+        raise AssertionError("; ".join(failures))
 
 
 def main() -> int:
@@ -148,8 +171,15 @@ def main() -> int:
         print(f"not on TPU (platform={d.platform}); nothing to check")
         return 1
     print(f"device: {d.device_kind}")
-    check_paged_decode()
-    check_flash()
+    errs = []
+    for check in (check_paged_decode, check_flash):
+        try:
+            check()
+        except Exception as e:       # keep the other family's evidence
+            errs.append(f"{check.__name__}: {e}")
+    if errs:
+        print(f"TPU KERNEL CHECK FAILED: {'; '.join(errs)}")
+        return 1
     print("TPU KERNEL CHECK OK")
     return 0
 

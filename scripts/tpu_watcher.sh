@@ -26,6 +26,16 @@ while true; do
         echo "perf/kernel_check_${ts}.txt" > perf/kernel_check_ok
       fi
     fi
+    if [ ! -f "perf/tunnel_probe_ok" ]; then
+      timeout 300 python scripts/probe_tunnel.py > "perf/tunnel_probe_${ts}.txt" 2>&1
+      probe_rc=$?
+      # Only latch a REAL TPU profile: a mid-run tunnel drop makes the
+      # probe fall back to CPU while still exiting 0.
+      if [ "$probe_rc" -eq 0 ] && grep -q "(tpu)" "perf/tunnel_probe_${ts}.txt"; then
+        echo "perf/tunnel_probe_${ts}.txt" > perf/tunnel_probe_ok
+      fi
+      echo "$(date -Is) tunnel-probe rc=${probe_rc} -> perf/tunnel_probe_${ts}.txt"
+    fi
     BENCH_TRIES=$((BENCH_TRIES + 1))
     POLYKEY_BENCH_PROBE_TRIES=1 timeout 7200 python bench.py \
       > "perf/bench_watcher_${ts}.json" 2> "perf/bench_watcher_${ts}.log"
