@@ -228,3 +228,19 @@ def test_hf_tokenizer_roundtrip(tmp_path):
         deltas = [det.push(i) for i in ids]
         streamed = "".join(d for d in deltas if d) + det.flush()
         assert streamed == ht.decode(ids)
+
+
+def test_tied_llama_matches_hf(tmp_path):
+    """llama-3.2-1b's shape: tie_word_embeddings=True means HF writes NO
+    lm_head tensor and import_safetensors must skip it — the tied-llama
+    import path is distinct from both untied llama and gemma."""
+    cfg = dataclasses.replace(
+        TINY_LLAMA, name="tiny-llama-tied", tie_embeddings=True
+    )
+    model, params = _export_hf(cfg, tmp_path, seed=4)
+    assert "lm_head" not in params
+    tokens = _tokens(cfg, seed=5)
+    ours = _our_logits(params, cfg, tokens)
+    theirs = _hf_logits(model, tokens)
+    np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-3)
+    assert (ours.argmax(-1) == theirs.argmax(-1)).all()
