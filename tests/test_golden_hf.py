@@ -153,7 +153,7 @@ def test_gemma_sliding_window_is_exercised(tmp_path):
 
 
 @pytest.mark.parametrize(
-    "cfg", [TINY_LLAMA, TINY_GEMMA], ids=lambda c: c.name
+    "cfg", [TINY_LLAMA, TINY_MIXTRAL, TINY_GEMMA], ids=lambda c: c.name
 )
 def test_serving_cache_path_matches_hf(cfg, tmp_path):
     """The SERVING path (forward with KV cache: prefill then one-token
