@@ -1,0 +1,117 @@
+"""Interpret a bench artifact against the targets and a prior run.
+
+Reads the one-line JSON bench.py emits and prints a target scorecard
+(BASELINE.md north star: >= 2,000 tok/s/chip and p50 TTFT < 150 ms at
+8B), a per-phase table, step-cost diagnostics, and — when a prior
+artifact is given — per-phase deltas. Built for the moment a watcher
+bench lands: the analysis should be one command, not artifact
+spelunking.
+
+Usage:
+    python scripts/compare_bench.py NEW.json [OLD.json]
+    python scripts/compare_bench.py perf/bench_watcher_*.json \
+        perf/bench_2026-07-30_prepipeline_tpu.json
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+TARGET_TOK_S = 2000.0
+TARGET_TTFT_MS = 150.0
+
+PHASES = [
+    ("gateway_echo", "0  gateway echo"),
+    ("engine_1b", "A  1B engine"),
+    ("engine_8b_int8", "B  8B int8"),
+    ("engine_8b_int4", "B2 8B int4"),
+    ("engine_ttft_tokenized", "A-tok real-BPE TTFT"),
+    ("prefix_cache", "A2 prefix cache"),
+    ("engine_longctx", "D  long context"),
+    ("engine_spec", "C  spec ceiling"),
+    ("engine_gemma_spec", "C2 gemma spec"),
+]
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def _phase_line(name: str, d: dict, old: dict | None) -> str:
+    if not isinstance(d, dict):
+        return f"{name:24s} ?"
+    if "error" in d:
+        return f"{name:24s} ERROR: {d['error'][:80]}"
+    if "excluded" in d:
+        return f"{name:24s} excluded: {d['excluded'][:70]}"
+    bits = []
+    for key, fmt in (("tok_s", "{:.1f} tok/s"), ("p50_ttft_ms", "ttft {:.1f}ms"),
+                     ("p50_ms", "p50 {:.3f}ms"), ("p95_ms", "p95 {:.3f}ms"),
+                     ("cold_ttft_ms", "cold {:.1f}ms"),
+                     ("p50_warm_ttft_ms", "warm {:.1f}ms"),
+                     ("host_encode_ms", "encode {:.2f}ms"),
+                     ("spec_acceptance", "acc {:.2f}")):
+        if key in d:
+            bits.append(fmt.format(d[key]))
+    sc = d.get("step_costs", {})
+    if sc:
+        bits.append(f"[block {sc.get('block_ms', '?')}ms/K={sc.get('block_steps', '?')}"
+                    f" rt {sc.get('roundtrip_ms', '?')}ms"
+                    f" solo {sc.get('solo_tok_s', '?')} tok/s]")
+    if old and isinstance(old, dict) and "tok_s" in d and "tok_s" in old:
+        ratio = d["tok_s"] / old["tok_s"] if old["tok_s"] else float("inf")
+        bits.append(f"({ratio:.2f}x prior)")
+    return f"{name:24s} " + "  ".join(bits)
+
+
+def main() -> int:
+    if len(sys.argv) < 2 or len(sys.argv) > 3:
+        # >3 usually means a shell glob matched several NEW artifacts and
+        # the intended OLD baseline silently became argv[3+] — refuse
+        # rather than diff the wrong pair.
+        print(__doc__)
+        if len(sys.argv) > 3:
+            print(f"error: expected NEW [OLD], got {len(sys.argv) - 1} "
+                  "arguments (unquoted glob?)", file=sys.stderr)
+        return 2
+    new = _load(sys.argv[1])
+    old = _load(sys.argv[2]) if len(sys.argv) > 2 else {}
+    nd, od = new.get("details", {}), old.get("details", {})
+
+    print(f"platform: {nd.get('platform', '?')}"
+          + (f"   (prior: {od.get('platform', '?')})" if old else ""))
+    if "kernels_disabled" in nd:
+        print(f"!! Pallas kernels were DISABLED: {nd['kernels_disabled'][:90]}")
+
+    v, ttft = new.get("value"), new.get("p50_ttft_ms")
+    print(f"\nheadline: {new.get('metric')} = {v} {new.get('unit')}")
+    if new.get("vs_baseline") is None:
+        # bench.py nulls vs_baseline when the 8B phase didn't run (CPU
+        # fallback / skip) — a 1B or tiny number is not target-comparable.
+        print("  (not target-comparable: vs_baseline is null)")
+    else:
+        if isinstance(v, (int, float)):
+            verdict = "MET" if v >= TARGET_TOK_S else "missed"
+            print(f"  tok/s target {TARGET_TOK_S:.0f}: "
+                  f"{v / TARGET_TOK_S:.2f}x -> {verdict}")
+        if isinstance(ttft, (int, float)):
+            verdict = "MET" if ttft < TARGET_TTFT_MS else "missed"
+            print(f"  TTFT target <{TARGET_TTFT_MS:.0f}ms: {ttft:.1f}ms "
+                  f"-> {verdict}")
+
+    print("\nphases:")
+    for key, label in PHASES:
+        if key in nd:
+            print("  " + _phase_line(label, nd[key], od.get(key)))
+
+    pc = nd.get("prefix_cache", {})
+    if {"cold_ttft_ms", "p50_warm_ttft_ms"} <= pc.keys():
+        ok = pc["p50_warm_ttft_ms"] < pc["cold_ttft_ms"]
+        print(f"\nprefix cache warm<cold: {'yes' if ok else 'NO (regression)'}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
