@@ -144,6 +144,8 @@ class EngineConfig:
     # way. Also enables top_p<1 requests on the SPECULATIVE path
     # (truncated rejection sampling — sampling.truncated_dist); with
     # 0, spec engines route top_p<1 batches through the plain step.
+    # With the prefilter on, a request's top_k clamps to this width
+    # (the sampled paths only ever see the top-C logits).
     top_p_candidates: int = 0
 
     # Speculative decoding (engine/spec_decode.py): a draft model name turns

@@ -73,6 +73,7 @@ class GenRequest:
     max_new_tokens: int = 64
     temperature: float = 0.0
     top_p: float = 1.0
+    top_k: int = 0             # <= 0 → disabled
     # Reproducibility root: on a plain (non-speculative) engine, identical
     # (prompt, seed, params, sampling) yields an identical stream
     # regardless of batch composition or scheduling — every sampled draw
@@ -121,7 +122,7 @@ class _Slot:
 
 def _prefill_fn(
     params, cfg: ModelConfig, paged: PagedKV,
-    tokens, start, last_rel, page_table, seeds, temperature, top_p,
+    tokens, start, last_rel, page_table, seeds, temperature, top_p, top_k,
     *, greedy: bool, candidates: int = 0, mesh=None,
 ):
     """Prefill N windows (tokens [N, T]) at absolute positions
@@ -149,7 +150,7 @@ def _prefill_fn(
     last = hidden[jnp.arange(N), last_rel]                 # [N, H]
     logits = unembed(params, cfg, last)                    # [N, V]
     token = sample_tail(
-        logits, seeds, start + last_rel + 1, temperature, top_p,
+        logits, seeds, start + last_rel + 1, temperature, top_p, top_k,
         greedy, candidates,
     )
     return token, paged
@@ -158,7 +159,7 @@ def _prefill_fn(
 def _decode_fn(
     params, cfg: ModelConfig, paged: PagedKV,
     last_tokens, seq_lens, page_tables, active, caps, seeds, temperature,
-    top_p,
+    top_p, top_k,
     *, greedy: bool, steps: int, eos_id: int, candidates: int = 0, mesh=None,
 ):
     """`steps` decode steps for the whole slot batch in ONE dispatch.
@@ -192,7 +193,7 @@ def _decode_fn(
         logits = unembed(params, cfg, hidden[:, 0])        # [B, V]
         # The new token lands at index seq → that position keys its draw.
         tokens = sample_tail(
-            logits, seeds, seq, temperature, top_p, greedy, candidates
+            logits, seeds, seq, temperature, top_p, top_k, greedy, candidates
         )
         tokens = jnp.where(act, tokens, 0)
         new_seq = seq + act.astype(jnp.int32)
@@ -209,8 +210,8 @@ def _decode_fn(
 
 def _merge_lane_fn(
     last_tokens, seq_lens, page_tables, active, caps, temperature, top_p,
-    seeds, tokens_vec, row, slot, seq_len, cap, temp, tp, table_row,
-    seed_row,
+    top_k, seeds, tokens_vec, row, slot, seq_len, cap, temp, tp, tk,
+    table_row, seed_row,
     *, eos_id: int,
 ):
     """Activate ONE decode lane entirely on device: splice the prefill's
@@ -234,6 +235,7 @@ def _merge_lane_fn(
         caps.at[slot].set(cap),
         temperature.at[slot].set(temp),
         top_p.at[slot].set(tp),
+        top_k.at[slot].set(tk),
         seeds.at[slot].set(seed_row),
     )
 
@@ -378,7 +380,8 @@ class InferenceEngine:
         # the chain keeps stable layouts).
         lane_out = (
             self._dp_vec, self._dp_vec, self._dp_mat, self._dp_vec,
-            self._dp_vec, self._dp_vec, self._dp_vec, self._dp_mat,
+            self._dp_vec, self._dp_vec, self._dp_vec, self._dp_vec,
+            self._dp_mat,
         )
         self._jit_merge = jax.jit(
             _merge_lane_fn, static_argnames=("eos_id",),
@@ -544,6 +547,7 @@ class InferenceEngine:
         self._caps = np.zeros((B,), dtype=np.int32)
         self._temperature = np.zeros((B,), dtype=np.float32)
         self._top_p = np.ones((B,), dtype=np.float32)
+        self._top_k = np.zeros((B,), dtype=np.int32)
         self._seeds = np.zeros((B, 2), dtype=np.int32)
         self._slots: list[Optional[_Slot]] = [None] * B
         self._dev: dict = {}
@@ -878,6 +882,7 @@ class InferenceEngine:
         tables = np.zeros((n_pad, cfg.pages_per_seq), dtype=np.int32)
         temp = np.zeros((n_pad,), dtype=np.float32)
         top_p = np.ones((n_pad,), dtype=np.float32)
+        top_k = np.zeros((n_pad,), dtype=np.int32)
         seeds = np.zeros((n_pad, 2), dtype=np.int32)
         for r, (slot_idx, slot, ids, start) in enumerate(group):
             tokens[r, : len(ids)] = ids
@@ -886,6 +891,7 @@ class InferenceEngine:
             tables[r] = slot.table[0]
             temp[r] = slot.request.temperature
             top_p[r] = slot.request.top_p
+            top_k[r] = self._eff_top_k(slot.request)
             seeds[r] = slot.seed_row
         greedy = bool(np.all(temp == 0.0))
 
@@ -893,7 +899,7 @@ class InferenceEngine:
         common = (
             jax.device_put(tokens, self._prefill_tok),
             put(starts), put(last_rel), put(tables), put(seeds),
-            put(temp), put(top_p),
+            put(temp), put(top_p), put(top_k),
         )
         try:
             with jax.profiler.TraceAnnotation("polykey/prefill"):
@@ -958,6 +964,7 @@ class InferenceEngine:
                     put(np.zeros((n, 2), np.int32)),
                     put(np.zeros((n,), np.float32)),
                     put(np.ones((n,), np.float32)),
+                    put(np.zeros((n,), np.int32)),
                 )
                 # greedy is a static argname keyed on the BATCH (all-greedy
                 # vs any-sampled), so both variants occur at serving time —
@@ -991,10 +998,12 @@ class InferenceEngine:
                     self._jit_merge(
                         dev["last_tokens"], dev["seq_lens"],
                         dev["page_tables"], dev["active"], dev["caps"],
-                        dev["temperature"], dev["top_p"], dev["seeds"],
+                        dev["temperature"], dev["top_p"], dev["top_k"],
+                        dev["seeds"],
                         toks_dev, np.int32(0), np.int32(0),
                         np.int32(1), np.int32(2), np.float32(0.0),
-                        np.float32(1.0), zrow, np.zeros((2,), np.int32),
+                        np.float32(1.0), np.int32(0), zrow,
+                        np.zeros((2,), np.int32),
                         eos_id=self.tokenizer.eos_id,
                     )
         if self._spec:
@@ -1017,7 +1026,8 @@ class InferenceEngine:
                         self.paged, self.d_paged,
                         dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
                         dev["active"], dev["caps"], dev["seeds"],
-                        dev["temperature"], dev["top_p"], gamma=gamma,
+                        dev["temperature"], dev["top_p"], dev["top_k"],
+                        gamma=gamma,
                         eos_id=self.tokenizer.eos_id,
                         candidates=cand, mesh=self.mesh,
                     )
@@ -1035,7 +1045,7 @@ class InferenceEngine:
                         self.params, self.model_cfg, self.paged,
                         dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
                         dev["active"], dev["caps"], dev["seeds"],
-                        dev["temperature"], dev["top_p"],
+                        dev["temperature"], dev["top_p"], dev["top_k"],
                         greedy=False, steps=steps,
                         eos_id=self.tokenizer.eos_id,
                         candidates=0, mesh=self.mesh,
@@ -1051,7 +1061,7 @@ class InferenceEngine:
                         self.params, self.model_cfg, self.paged,
                         dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
                         dev["active"], dev["caps"], dev["seeds"],
-                        dev["temperature"], dev["top_p"],
+                        dev["temperature"], dev["top_p"], dev["top_k"],
                         greedy=greedy, steps=steps,
                         eos_id=self.tokenizer.eos_id,
                         candidates=self.config.top_p_candidates, mesh=self.mesh,
@@ -1086,6 +1096,7 @@ class InferenceEngine:
         sampling = (
             put(np.asarray([request.temperature], dtype=np.float32)),
             put(np.asarray([request.top_p], dtype=np.float32)),
+            put(np.asarray([self._eff_top_k(request)], dtype=np.int32)),
         )
         with jax.profiler.TraceAnnotation("polykey/prefill"):
             if self._spec:
@@ -1127,14 +1138,15 @@ class InferenceEngine:
             (
                 dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
                 dev["active"], dev["caps"], dev["temperature"], dev["top_p"],
-                dev["seeds"],
+                dev["top_k"], dev["seeds"],
             ) = self._jit_merge(
                 dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
                 dev["active"], dev["caps"], dev["temperature"], dev["top_p"],
-                dev["seeds"],
+                dev["top_k"], dev["seeds"],
                 toks_dev, np.int32(row), np.int32(slot_idx),
                 np.int32(slot.prompt_len + 1), np.int32(slot.position_cap),
                 np.float32(request.temperature), np.float32(request.top_p),
+                np.int32(self._eff_top_k(request)),
                 slot.table[0], slot.seed_row,
                 eos_id=self.tokenizer.eos_id,
             )
@@ -1158,6 +1170,7 @@ class InferenceEngine:
         self._caps[slot_idx] = slot.position_cap
         self._temperature[slot_idx] = request.temperature
         self._top_p[slot_idx] = request.top_p
+        self._top_k[slot_idx] = self._eff_top_k(request)
         self._seeds[slot_idx] = slot.seed_row
 
     def _resolve_prefills(self, block: bool = False) -> None:
@@ -1248,6 +1261,7 @@ class InferenceEngine:
             "caps": jax.device_put(self._caps, self._dp_vec),
             "temperature": jax.device_put(self._temperature, self._dp_vec),
             "top_p": jax.device_put(self._top_p, self._dp_vec),
+            "top_k": jax.device_put(self._top_k, self._dp_vec),
             "seeds": jax.device_put(self._seeds, self._dp_mat),
         }
         self._dev_dirty = False
@@ -1277,7 +1291,8 @@ class InferenceEngine:
         # only SAMPLED rows with top_p<1 require the truncated variant.
         act = self._active
         all_untruncated = bool(np.all(
-            (self._top_p[act] >= 1.0) | (self._temperature[act] == 0.0)
+            ((self._top_p[act] >= 1.0) & (self._top_k[act] <= 0))
+            | (self._temperature[act] == 0.0)
         ))
         if self._spec and (
             self.config.top_p_candidates > 0 or all_untruncated
@@ -1318,6 +1333,7 @@ class InferenceEngine:
                 dev["seeds"],
                 dev["temperature"],
                 dev["top_p"],
+                dev["top_k"],
                 greedy=greedy,
                 steps=steps,
                 eos_id=self.tokenizer.eos_id,
@@ -1337,6 +1353,17 @@ class InferenceEngine:
         except Exception:
             pass
         return ("plain", packed_dev, self._snapshot_requests())
+
+    def _eff_top_k(self, request: GenRequest) -> int:
+        """Effective per-request top_k: with the top-k prefilter enabled
+        (top_p_candidates = C > 0) every sampled path sees only the top-C
+        logits, so a wider top_k clamps to C — applied at admission so
+        the narrowing is a visible, documented contract
+        (engine/config.py top_p_candidates) rather than a silent property
+        of the sampler."""
+        k = request.top_k
+        C = self.config.top_p_candidates
+        return min(k, C) if (C > 0 and k > 0) else k
 
     def _snapshot_requests(self):
         """Per-slot request identities at dispatch time: with cross-block
@@ -1407,7 +1434,8 @@ class InferenceEngine:
                 self.paged, self.d_paged,
                 dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
                 dev["active"], dev["caps"], dev["seeds"],
-                dev["temperature"], dev["top_p"], gamma=self._gamma,
+                dev["temperature"], dev["top_p"], dev["top_k"],
+                gamma=self._gamma,
                 eos_id=self.tokenizer.eos_id,
                 candidates=candidates, mesh=self.mesh,
             )

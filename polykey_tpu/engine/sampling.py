@@ -51,10 +51,40 @@ def _prefix_keep_mask(desc_probs: jax.Array, p) -> jax.Array:
     return keep.at[..., 0].set(True)
 
 
+def _rank_keep_mask(width: int, top_k) -> jax.Array:
+    """[..., width] keep mask for per-row top-k over DESCENDING-ordered
+    entries (rank < k); top_k <= 0 disables. THE top-k rule for every
+    candidates-prefiltered path (exact paths use the k-th-value threshold
+    instead — ties there keep all equal values, consistently between the
+    plain sampler and the speculative truncated dists)."""
+    r = jnp.arange(width)
+    k = jnp.where(top_k > 0, top_k, width)
+    return r < k[..., None]
+
+
+def _trunc_thresholds(scaled: jax.Array, top_p, top_k):
+    """THE exact-path truncation thresholds, from one descending sort:
+    (thr_p, thr_k) such that keeping `scaled >= thr_p` realizes the
+    shared top-p keep rule and `scaled >= thr_k` keeps the k largest
+    (ties keep all equal values). One implementation for the plain
+    sampler AND the speculative truncated dists — they must agree
+    token-for-token, so the rule lives in exactly one place."""
+    V = scaled.shape[-1]
+    sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+    keep_p = _top_p_keep_mask(sorted_desc, top_p)
+    thr_p = jnp.min(
+        jnp.where(keep_p, sorted_desc, jnp.inf), axis=-1, keepdims=True
+    )
+    kidx = jnp.clip(jnp.where(top_k > 0, top_k, V) - 1, 0, V - 1)
+    thr_k = jnp.take_along_axis(sorted_desc, kidx[..., None], axis=-1)
+    return thr_p, thr_k
+
+
 def truncated_dist(
     logits: jax.Array,        # [..., V]
     temp: jax.Array,          # [...] (>0; callers handle greedy rows)
     top_p: jax.Array,         # [...]
+    top_k: jax.Array,         # [...] int32; <= 0 → disabled
     candidates: int,          # static top-k prefilter width; 0 → exact
 ) -> jax.Array:
     """Per-row top-p-truncated, renormalized sampling distribution
@@ -71,19 +101,24 @@ def truncated_dist(
         vals, idx = jax.lax.top_k(scaled, candidates)      # desc [..., C]
         lse = jax.scipy.special.logsumexp(scaled, axis=-1, keepdims=True)
         p_c = jnp.exp(vals - lse)             # true full-vocab probabilities
-        kept = jnp.where(_prefix_keep_mask(p_c, top_p[..., None]), p_c, 0.0)
+        keep = _prefix_keep_mask(p_c, top_p[..., None])
+        keep &= _rank_keep_mask(candidates, top_k)
+        kept = jnp.where(keep, p_c, 0.0)
         trunc = jnp.put_along_axis(
             jnp.zeros_like(probs), idx, kept, axis=-1, inplace=False
         )
     else:
         # Exact full-vocab truncation (candidates disabled OR wider than
         # the vocabulary — never silently skip the requested nucleus).
-        threshold = _top_p_threshold(scaled, top_p[..., None])
-        trunc = jnp.where(scaled >= threshold, probs, 0.0)
+        thr_p, thr_k = _trunc_thresholds(scaled, top_p[..., None], top_k)
+        trunc = jnp.where(
+            (scaled >= thr_p) & (scaled >= thr_k), probs, 0.0
+        )
     trunc = trunc / jnp.maximum(
         jnp.sum(trunc, axis=-1, keepdims=True), 1e-20
     )
-    return jnp.where(top_p[..., None] >= 1.0, probs, trunc)
+    no_trunc = (top_p >= 1.0) & (top_k <= 0)
+    return jnp.where(no_trunc[..., None], probs, trunc)
 
 
 def _top_p_threshold(scaled: jax.Array, p) -> jax.Array:
@@ -103,11 +138,12 @@ def _apply_top_p(logits: jax.Array, p: float) -> jax.Array:
     return jnp.where(logits < threshold, -jnp.inf, logits)
 
 
-def _masked_rows(logits, temp, top_p, candidates: int):
-    """Shared top-p masking for the dynamic samplers. Returns
+def _masked_rows(logits, temp, top_p, top_k, candidates: int):
+    """Shared top-p/top-k masking for the dynamic samplers. Returns
     (greedy [B], masked [B, C or V], idx [B, C] | None, scaled_full):
     categorical over `masked` (mapped through idx when present) realizes
-    the truncated distribution; `scaled_full` serves top_p >= 1 rows."""
+    the truncated distribution; `scaled_full` serves untruncated rows
+    (top_p >= 1 and top_k disabled)."""
     if candidates and candidates < logits.shape[-1]:
         scaled_full = logits / temp                       # [B, V]
         lse = jax.scipy.special.logsumexp(
@@ -117,12 +153,15 @@ def _masked_rows(logits, temp, top_p, candidates: int):
         greedy = idx[:, 0].astype(jnp.int32)
         probs = jnp.exp(vals - lse)       # true full-vocab probabilities
         keep = _prefix_keep_mask(probs, top_p[:, None])
+        keep &= _rank_keep_mask(candidates, top_k)
         return greedy, jnp.where(keep, vals, -jnp.inf), idx, scaled_full
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = logits / temp
-    # Per-row top-p on the scaled logits (shared sort + threshold rule).
-    threshold = _top_p_threshold(scaled, top_p[:, None])
-    masked = jnp.where(scaled < threshold, -jnp.inf, scaled)
+    # Per-row top-p/top-k on the scaled logits (one sort; shared rules).
+    thr_p, thr_k = _trunc_thresholds(scaled, top_p[:, None], top_k)
+    masked = jnp.where(
+        (scaled < thr_p) | (scaled < thr_k), -jnp.inf, scaled
+    )
     return greedy, masked, None, scaled
 
 
@@ -131,6 +170,7 @@ def sample_dynamic(
     key: jax.Array,
     temperature: jax.Array,       # [B] — 0 → greedy for that row
     top_p: jax.Array,             # [B] — 1.0 → disabled for that row
+    top_k: jax.Array = None,      # [B] int32 — <= 0 → disabled
     candidates: int = 0,          # static: 0 → exact (full-vocab sort)
 ) -> jax.Array:
     """Per-row sampling with *data-dependent* temperature/top-p, one
@@ -152,9 +192,11 @@ def sample_dynamic(
     prefilter entirely (untruncated categorical needs no sort either).
     Pass candidates=0 for the exact full-vocab path.
     """
+    if top_k is None:
+        top_k = jnp.zeros(logits.shape[0], jnp.int32)
     temp = jnp.maximum(temperature, 1e-6)[:, None]
     greedy, masked, idx, scaled_full = _masked_rows(
-        logits, temp, top_p, candidates
+        logits, temp, top_p, top_k, candidates
     )
     if idx is not None:
         k_pre, k_full = jax.random.split(key)
@@ -162,11 +204,11 @@ def sample_dynamic(
         truncated = jnp.take_along_axis(
             idx, local[:, None], axis=-1
         )[:, 0].astype(jnp.int32)
-        # top_p >= 1: unrestricted sampling over the whole vocabulary.
+        # Untruncated rows: unrestricted sampling over the whole vocab.
         full = jax.random.categorical(
             k_full, scaled_full, axis=-1
         ).astype(jnp.int32)
-        sampled = jnp.where(top_p >= 1.0, full, truncated)
+        sampled = jnp.where((top_p >= 1.0) & (top_k <= 0), full, truncated)
     else:
         sampled = jax.random.categorical(
             key, masked, axis=-1
@@ -205,14 +247,17 @@ def sample_dynamic_rows(
     keys: jax.Array,              # [B, 2] uint32 — per-row keys
     temperature: jax.Array,       # [B]
     top_p: jax.Array,             # [B]
+    top_k: jax.Array = None,      # [B] int32 — <= 0 → disabled
     candidates: int = 0,
 ) -> jax.Array:
     """sample_dynamic with an independent RNG key per row — the engine's
     seeded path. Identical masking (shared _masked_rows); only the draw
     granularity differs."""
+    if top_k is None:
+        top_k = jnp.zeros(logits.shape[0], jnp.int32)
     temp = jnp.maximum(temperature, 1e-6)[:, None]
     greedy, masked, idx, scaled_full = _masked_rows(
-        logits, temp, top_p, candidates
+        logits, temp, top_p, top_k, candidates
     )
     if idx is not None:
         keys2 = jax.vmap(lambda k: jax.random.fold_in(k, 1))(keys)
@@ -221,7 +266,7 @@ def sample_dynamic_rows(
             idx, local[:, None], axis=-1
         )[:, 0].astype(jnp.int32)
         full = _row_categorical(keys2, scaled_full)
-        sampled = jnp.where(top_p >= 1.0, full, truncated)
+        sampled = jnp.where((top_p >= 1.0) & (top_k <= 0), full, truncated)
     else:
         sampled = _row_categorical(keys, masked)
     return jnp.where(temperature == 0.0, greedy, sampled)
@@ -243,7 +288,7 @@ def sample(
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
-def sample_tail(logits, seeds, positions, temperature, top_p,
+def sample_tail(logits, seeds, positions, temperature, top_p, top_k,
                 greedy: bool, candidates: int = 0):
     """THE shared sampling tail for prefill and decode (plain and
     speculative paths — one implementation so key derivation cannot
@@ -253,4 +298,6 @@ def sample_tail(logits, seeds, positions, temperature, top_p,
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     base = lane_keys(seeds[:, 0], seeds[:, 1])
     keys = fold_positions(base, positions)
-    return sample_dynamic_rows(logits, keys, temperature, top_p, candidates)
+    return sample_dynamic_rows(
+        logits, keys, temperature, top_p, top_k, candidates
+    )

@@ -57,7 +57,7 @@ from .sampling import (
 def spec_prefill_fn(
     t_params, d_params, t_cfg: ModelConfig, d_cfg: ModelConfig,
     t_paged, d_paged,
-    tokens, start, last_rel, page_table, seeds, temperature, top_p,
+    tokens, start, last_rel, page_table, seeds, temperature, top_p, top_k,
     greedy: bool = False, candidates: int = 0, mesh=None,
 ):
     """Prefill BOTH caches for N windows; first tokens from the TARGET.
@@ -79,7 +79,7 @@ def spec_prefill_fn(
     last = hidden[jnp.arange(N), last_rel]                # [N, H]
     logits = unembed(t_params, t_cfg, last)               # [N, V]
     token = sample_tail(
-        logits, seeds, start + last_rel + 1, temperature, top_p,
+        logits, seeds, start + last_rel + 1, temperature, top_p, top_k,
         greedy, candidates,
     )
     return token, t_paged, d_paged
@@ -89,7 +89,7 @@ def spec_decode_fn(
     t_params, d_params, t_cfg: ModelConfig, d_cfg: ModelConfig,
     t_paged, d_paged,
     last_tokens, seq_lens, page_tables, active, caps, seeds, temperature,
-    top_p, gamma: int, eos_id: int, candidates: int = 0, mesh=None,
+    top_p, top_k, gamma: int, eos_id: int, candidates: int = 0, mesh=None,
 ):
     """One draft/verify round for the whole slot batch.
 
@@ -136,6 +136,7 @@ def spec_decode_fn(
     # Greedy rows must see untruncated dists (their acceptance is argmax
     # equality; truncation is irrelevant and top_p may be any value).
     eff_top_p = jnp.where(greedy_row, 1.0, top_p)         # [B]
+    eff_top_k = jnp.where(greedy_row, 0, top_k)           # [B]
 
     # --- Draft gamma tokens autoregressively (bandwidth-light model). -----
     def draft_step(carry, _):
@@ -146,7 +147,7 @@ def spec_decode_fn(
         )
         logits = unembed(d_params, d_cfg, hidden[:, 0])   # [B, V]
         dist = (
-            truncated_dist(logits, temp, eff_top_p, candidates)
+            truncated_dist(logits, temp, eff_top_p, eff_top_k, candidates)
             if candidates
             else jax.nn.softmax(logits / temp[:, None], axis=-1)
         )
@@ -193,6 +194,7 @@ def spec_decode_fn(
             t_logits,
             jnp.broadcast_to(temp[:, None], t_logits.shape[:2]),
             jnp.broadcast_to(eff_top_p[:, None], t_logits.shape[:2]),
+            jnp.broadcast_to(eff_top_k[:, None], t_logits.shape[:2]),
             candidates,
         )
     else:

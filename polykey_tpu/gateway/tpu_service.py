@@ -7,7 +7,7 @@ serving engine instead of proxying to an external API. Zero external calls.
 
 Tools:
 - ``llm_generate`` (alias ``generate``) — params: prompt (string, required),
-  max_tokens, temperature, top_p, seed, stop (string or list of strings:
+  max_tokens, temperature, top_p, top_k, seed, stop (string or list of strings:
   generation cuts BEFORE the earliest match, which is never emitted; the
   engine request is cancelled so no further compute is spent). Unary
   returns the full completion as string_output; the streaming RPC emits
@@ -107,10 +107,22 @@ class TpuService(Service):
             # degenerate values (negative temp, top_p=0) reach the sampler.
             temperature=max(0.0, float(params.get("temperature", 0.0))),
             top_p=min(1.0, max(0.0, float(params.get("top_p", 1.0)))),
+            # top_k <= 0 disables; fractional values are client bugs.
+            top_k=self._parse_top_k(params),
             # Reproducible sampling: same (prompt, seed, sampling) → same
             # stream regardless of batch composition (engine.GenRequest).
             seed=self._parse_seed(params),
         )
+
+    @staticmethod
+    def _parse_top_k(params: dict) -> int:
+        kv = params.get("top_k", 0)
+        if isinstance(kv, float) and (not math.isfinite(kv) or kv != int(kv)):
+            raise ValueError("'top_k' must be a non-negative integer")
+        k = int(kv)
+        if k < 0:
+            raise ValueError("'top_k' must be a non-negative integer")
+        return k
 
     @staticmethod
     def _parse_seed(params: dict):

@@ -164,8 +164,10 @@ def main():
 
     def run_block(paged):
         seeds = jnp.zeros((B, 2), jnp.int32)
+        topk0 = jnp.zeros((B,), jnp.int32)
         return step(params, cfg, paged, last, seq, page_tables, active,
-                    caps, seeds, temp0, topp1, greedy=True, steps=K, eos_id=-1)
+                    caps, seeds, temp0, topp1, topk0,
+                    greedy=True, steps=K, eos_id=-1)
 
     t0 = time.monotonic()
     outs = run_block(paged)

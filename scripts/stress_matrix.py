@@ -64,6 +64,8 @@ def main() -> None:
                 if wrng.random() < 0.5:
                     d["top_p"] = wrng.uniform(0.3, 1.0)
                 if wrng.random() < 0.5:
+                    d["top_k"] = wrng.randrange(0, 12)
+                if wrng.random() < 0.5:
                     d["seed"] = wrng.randrange(1 << 40)
             if wrng.random() < 0.3:
                 d["stop"] = wrng.choice(["%", "ab", ["x", "%%"]])

@@ -112,9 +112,10 @@ def test_truncated_dist_wide_candidates_still_truncates():
     temp = jnp.array([1.0, 0.8, 1.2], jnp.float32)
     top_p = jnp.array([0.6, 0.9, 1.0], jnp.float32)
 
-    exact = truncated_dist(logits, temp, top_p, 0)
-    wide = truncated_dist(logits, temp, top_p, 64)     # > vocab
-    narrow = truncated_dist(logits, temp, top_p, 32)   # == vocab
+    tk = jnp.zeros((3,), jnp.int32)
+    exact = truncated_dist(logits, temp, top_p, tk, 0)
+    wide = truncated_dist(logits, temp, top_p, tk, 64)     # > vocab
+    narrow = truncated_dist(logits, temp, top_p, tk, 32)   # == vocab
     assert np.allclose(np.asarray(exact), np.asarray(wide), atol=1e-6)
     assert np.allclose(np.asarray(exact), np.asarray(narrow), atol=1e-6)
     # Row 0 (p=0.6) must have strictly truncated support; row 2 (p=1.0)
@@ -178,3 +179,43 @@ def test_oversize_max_tokens_clamped():
         assert done.prompt_tokens + done.completion_tokens <= config.max_seq_len
     finally:
         engine.shutdown()
+
+
+def test_top_k_masks_support_dynamic_paths():
+    """Per-row top_k: sampled tokens must come from the row's k largest
+    logits on BOTH dynamic paths (exact sort and candidates prefilter),
+    rows with k<=0 are unrestricted, and k=1 is exactly argmax."""
+    import numpy as np
+
+    logits = jax.random.normal(jax.random.PRNGKey(21), (4, 64)) * 3.0
+    temps = jnp.array([1.0, 1.0, 1.0, 1.0], jnp.float32)
+    top_ps = jnp.ones((4,), jnp.float32)
+    top_ks = jnp.array([1, 3, 8, 0], jnp.int32)
+
+    order = np.argsort(-np.asarray(logits), axis=-1)
+    keys = jax.random.split(jax.random.PRNGKey(22), 256)
+    for cand in (0, 32):
+        out = np.asarray(jax.vmap(
+            lambda k: sample_dynamic(
+                logits, k, temps, top_ps, top_ks, candidates=cand)
+        )(keys))
+        assert (out[:, 0] == order[0, 0]).all()              # k=1 → argmax
+        assert set(out[:, 1]) <= set(order[1, :3].tolist())
+        assert set(out[:, 2]) <= set(order[2, :8].tolist())
+        assert len(set(out[:, 3].tolist())) > 8              # unrestricted
+
+
+def test_top_k_composes_with_top_p():
+    """top_k ∧ top_p: the support is the INTERSECTION of both keep sets
+    (here p=0.999 keeps nearly everything, k=2 must still bind)."""
+    import numpy as np
+
+    logits = jnp.asarray(
+        np.log(np.array([[0.4, 0.3, 0.2, 0.05, 0.05]])), jnp.float32)
+    keys = jax.random.split(jax.random.PRNGKey(23), 256)
+    out = np.asarray(jax.vmap(
+        lambda k: sample_dynamic(
+            logits, k, jnp.array([1.0]), jnp.array([0.999]),
+            jnp.array([2], jnp.int32))
+    )(keys))[:, 0]
+    assert set(out.tolist()) <= {0, 1}

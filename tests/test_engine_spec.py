@@ -422,3 +422,28 @@ def test_spec_quantized_engine_greedy_matches_quantized_plain():
     )
     assert spec_q == plain_q
     assert snap["drafts_proposed"] > 0
+
+
+def test_spec_top_k_one_is_greedy_end_to_end():
+    """top_k=1 on the SPECULATIVE truncated path (top_p_candidates>0):
+    draft and verify dists both collapse to the argmax, so the stream
+    must equal the plain engine's greedy stream — a sharp check that the
+    rank mask is applied identically on both sides of the rejection
+    sampler."""
+    plain, _ = _run_prompts(BASE_CONFIG)
+    cfg = dataclasses.replace(SPEC_CONFIG, top_p_candidates=32)
+    eng = InferenceEngine(cfg)
+    try:
+        outs = []
+        for p in PROMPTS:
+            r = GenRequest(prompt=p, max_new_tokens=8,
+                           temperature=1.0, top_k=1, seed=5)
+            eng.submit(r)
+            tokens, done, error = _collect(r)
+            assert error is None and done is not None
+            outs.append(tokens)
+        snap = eng.metrics.snapshot()
+        assert snap.get("drafts_proposed", 0) > 0   # really speculative
+        assert outs == plain
+    finally:
+        eng.shutdown()
