@@ -10,8 +10,11 @@ Run: python scripts/tpu_kernel_check.py  (needs the TPU reachable)
 
 from __future__ import annotations
 
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
