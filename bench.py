@@ -48,6 +48,11 @@ POLYKEY_BENCH_SKIP_8B_INT4=1, POLYKEY_BENCH_8B_INT4_SLOTS,
 POLYKEY_BENCH_TOKENIZER, POLYKEY_BENCH_PROBE_TRIES,
 POLYKEY_BENCH_PROBE_TIMEOUT.
 
+POLYKEY_BENCH_HEADLINE_ONLY=1 is the tunnel-flap rescue mode: phase 0 +
+phase B (8B int8) only — the minimum wall-clock that still lands a
+target-comparable number. On the CPU fallback it is ignored for phase A
+(otherwise the artifact would carry no engine evidence at all).
+
 All progress chatter goes to stderr; stdout carries only the JSON line.
 """
 
@@ -63,6 +68,47 @@ import time
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+class _PhaseSkipped(Exception):
+    """Control-flow sentinel: a phase opted out before doing any work."""
+
+
+def _with_compile_rescue(phase: str, result: dict, on_tpu: bool, run):
+    """Run a phase body; on a compile-shaped failure, disable the Pallas
+    kernels for this and all later phases and retry once.
+
+    Match compile-specific markers only: a broad 'XlaRuntimeError' marker
+    would also cover runtime faults like an HBM RESOURCE_EXHAUSTED, which
+    the jnp fallback would not survive either. A VMEM exhaustion DURING
+    Mosaic compilation still matches (the message names mosaic/pallas).
+    'compil' (not 'compilation') also catches XLA's "compile permanent
+    error" phrasing for compile-time VMEM exhaustion.
+
+    Phase B carries the headline, so it gets the same self-rescue as A —
+    in headline-only rescue mode it is the FIRST engine phase and would
+    otherwise have no kernel-disable fallback at all.
+    """
+    try:
+        return run()
+    except Exception as e:
+        msg = f"{type(e).__name__}: {e}".lower()
+        compile_shaped = any(
+            s in msg for s in ("mosaic", "pallas", "lowering", "compil")
+        )
+        if not (on_tpu and compile_shaped):
+            raise
+        # Self-rescue: a Mosaic compile regression in the Pallas kernels
+        # must not zero out the round's evidence — the jnp paths serve
+        # every geometry. Later phases inherit the env (scoped to
+        # compile-shaped failures so a transient engine error doesn't
+        # silently demote the headline phase to the fallback path).
+        log(f"phase {phase} failed ({e}); retrying with Pallas kernels "
+            "disabled (POLYKEY_DISABLE_PAGED_KERNEL/FLASH)")
+        os.environ["POLYKEY_DISABLE_PAGED_KERNEL"] = "1"
+        os.environ["POLYKEY_DISABLE_FLASH"] = "1"
+        result["kernels_disabled"] = str(e)
+        return run()
 
 
 def probe_backend() -> str | None:
@@ -286,6 +332,9 @@ def main() -> None:
     from polykey_tpu.engine.config import EngineConfig
 
     on_tpu = platform == "tpu"
+    # Rescue mode for short tunnel bursts: only the phases the headline
+    # needs. CPU fallback ignores it for phase A (sole evidence there).
+    headline_only = os.environ.get("POLYKEY_BENCH_HEADLINE_ONLY", "") == "1"
     n_req = int(os.environ.get(
         "POLYKEY_BENCH_REQUESTS", "64" if on_tpu else "6"))
     prompt_len = int(os.environ.get("POLYKEY_BENCH_PROMPT", "128"))
@@ -360,41 +409,23 @@ def main() -> None:
         # Greedy-only workload: skip the sampled-variant warmup compiles.
         warm_sampled_variants=False,
     )
+    if headline_only and on_tpu:
+        result["engine_1b"] = {"model": model_a,
+                               "skipped": "headline-only rescue mode"}
+        run_phase_a = False
+    else:
+        run_phase_a = True
     try:
+        if not run_phase_a:
+            raise _PhaseSkipped()
         log(f"--- phase A: engine bench, {model_a} (block={block}) ---")
-        try:
-            phase_a = bench_engine(
-                cfg_a, None, n_req, prompt_len if on_tpu else 24, max_new)
-        except Exception as e:
-            # Match compile-specific markers only: the old extra
-            # 'XlaRuntimeError' marker also covered runtime faults like an
-            # HBM RESOURCE_EXHAUSTED, which the jnp fallback would not
-            # survive either. A VMEM exhaustion DURING Mosaic compilation
-            # still matches (the message names mosaic/pallas) — that one
-            # the fallback does survive, since the jnp paths use no
-            # kernel scratch.
-            # 'compil' (not 'compilation') also catches XLA's "compile
-            # permanent error" phrasing for compile-time VMEM exhaustion.
-            msg = f"{type(e).__name__}: {e}".lower()
-            compile_shaped = any(
-                s in msg for s in ("mosaic", "pallas", "lowering", "compil")
-            )
-            if not (on_tpu and compile_shaped):
-                raise
-            # Self-rescue: a Mosaic compile regression in the Pallas
-            # kernels must not zero out the round's evidence — the jnp
-            # paths serve every geometry. Later phases inherit the env
-            # (scoped to compile-shaped failures so a transient engine
-            # error doesn't silently demote the headline phase to the
-            # fallback path).
-            log(f"phase A failed ({e}); retrying with Pallas kernels "
-                "disabled (POLYKEY_DISABLE_PAGED_KERNEL/FLASH)")
-            os.environ["POLYKEY_DISABLE_PAGED_KERNEL"] = "1"
-            os.environ["POLYKEY_DISABLE_FLASH"] = "1"
-            result["kernels_disabled"] = str(e)
-            phase_a = bench_engine(
-                cfg_a, None, n_req, prompt_len if on_tpu else 24, max_new)
+        phase_a = _with_compile_rescue(
+            "A", result, on_tpu,
+            lambda: bench_engine(
+                cfg_a, None, n_req, prompt_len if on_tpu else 24, max_new))
         result["engine_1b"] = {"model": model_a, **phase_a}
+    except _PhaseSkipped:
+        log("phase A skipped (POLYKEY_BENCH_HEADLINE_ONLY=1)")
     except Exception as e:
         log(f"phase A failed: {e}")
         result["engine_1b"] = {"model": model_a, "error": str(e)}
@@ -431,9 +462,10 @@ def main() -> None:
                 compile_warmup=True,
                 warm_sampled_variants=False,
             )
-            phase_b = bench_engine(
-                cfg_b, params8, max(2 * slots8, 32), prompt_len, max_new
-            )
+            phase_b = _with_compile_rescue(
+                "B", result, on_tpu,
+                lambda: bench_engine(
+                    cfg_b, params8, max(2 * slots8, 32), prompt_len, max_new))
             result["engine_8b_int8"] = phase_b
             # Free the ~8.5 GiB host tree (and let any lingering engine
             # device buffers drop) before later phases allocate.
@@ -451,6 +483,7 @@ def main() -> None:
     # the better of B/B2. ---
     phase_b2 = None
     if (on_tpu
+            and not headline_only
             and os.environ.get("POLYKEY_BENCH_SKIP_8B", "") != "1"
             and os.environ.get("POLYKEY_BENCH_SKIP_8B_INT4", "") != "1"):
         try:
@@ -506,7 +539,10 @@ def main() -> None:
         os.path.dirname(os.path.abspath(__file__)),
         "assets", "bench_tokenizer",
     )
-    if not os.path.exists(os.path.join(tok_dir, "tokenizer.json")):
+    if headline_only and on_tpu:
+        result["engine_ttft_tokenized"] = {
+            "skipped": "headline-only rescue mode"}
+    elif not os.path.exists(os.path.join(tok_dir, "tokenizer.json")):
         result["engine_ttft_tokenized"] = {
             "excluded": "no tokenizer asset; TTFT numbers exclude host "
                         "encode (build with scripts/build_bench_tokenizer.py)"
@@ -557,6 +593,9 @@ def main() -> None:
     # prefill only their suffix; p50 TTFT of the cached requests is the
     # feature's measurable win. ---
     try:
+        if headline_only and on_tpu:
+            result["prefix_cache"] = {"skipped": "headline-only rescue mode"}
+            raise _PhaseSkipped()
         log("--- phase A2: prefix-cache TTFT ---")
         import dataclasses as _dc
 
@@ -596,6 +635,8 @@ def main() -> None:
             log(f"prefix cache: {result['prefix_cache']}")
         finally:
             engine2.shutdown()
+    except _PhaseSkipped:
+        log("phase A2 skipped (POLYKEY_BENCH_HEADLINE_ONLY=1)")
     except Exception as e:
         log(f"phase A2 failed: {e}")
         result["prefix_cache"] = {"error": str(e)}
@@ -603,7 +644,8 @@ def main() -> None:
     # --- Phase D: long-context serving — 2k-token prompts decoding at 4k
     # positions through chunked prefill + the paged kernel's grouped page
     # streaming (SURVEY §5 long-context; engine defaults are 4k). ---
-    if on_tpu and os.environ.get("POLYKEY_BENCH_SKIP_LONGCTX", "") != "1":
+    if (on_tpu and not headline_only
+            and os.environ.get("POLYKEY_BENCH_SKIP_LONGCTX", "") != "1"):
         try:
             log("--- phase D: long-context engine bench (2k prompt / 4k positions) ---")
             cfg_d = EngineConfig(
@@ -635,7 +677,8 @@ def main() -> None:
     # steps + one wide verify, pipelined like plain blocks. A real draft's
     # gain interpolates between this and the plain-engine number by its
     # acceptance rate. ---
-    if on_tpu and os.environ.get("POLYKEY_BENCH_SKIP_SPEC", "") != "1":
+    if (on_tpu and not headline_only
+            and os.environ.get("POLYKEY_BENCH_SKIP_SPEC", "") != "1"):
         try:
             log("--- phase C: spec-decode engine bench (draft == target) ---")
             import dataclasses as _dc
@@ -673,7 +716,8 @@ def main() -> None:
     # weights mean acceptance is noise, so the adaptive-gamma dial is
     # left ON and its collapse to the low rung is itself the evidence;
     # throughput here is a floor, not the spec win. ---
-    if on_tpu and os.environ.get("POLYKEY_BENCH_SKIP_GEMMA_SPEC", "") != "1":
+    if (on_tpu and not headline_only
+            and os.environ.get("POLYKEY_BENCH_SKIP_GEMMA_SPEC", "") != "1"):
         try:
             log("--- phase C2: gemma-2-9b int8 + gemma-2-2b draft ---")
             from polykey_tpu.models.config import get_config

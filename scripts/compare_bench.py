@@ -46,6 +46,8 @@ def _phase_line(name: str, d: dict, old: dict | None) -> str:
         return f"{name:24s} ERROR: {d['error'][:80]}"
     if "excluded" in d:
         return f"{name:24s} excluded: {d['excluded'][:70]}"
+    if "skipped" in d:
+        return f"{name:24s} skipped: {d['skipped'][:70]}"
     bits = []
     for key, fmt in (("tok_s", "{:.1f} tok/s"), ("p50_ttft_ms", "ttft {:.1f}ms"),
                      ("p50_ms", "p50 {:.3f}ms"), ("p95_ms", "p95 {:.3f}ms"),

@@ -37,21 +37,43 @@ while true; do
       echo "$(date -Is) tunnel-probe rc=${probe_rc} -> perf/tunnel_probe_${ts}.txt"
     fi
     BENCH_TRIES=$((BENCH_TRIES + 1))
-    POLYKEY_BENCH_PROBE_TRIES=1 timeout 7200 python bench.py \
+    # First two attempts run the full phase set; later attempts assume the
+    # tunnel bursts are shorter than a full bench and drop to the rescue
+    # mode (phase 0 + the 8B-int8 headline only).
+    HEADLINE_ONLY=""
+    if [ "$BENCH_TRIES" -gt 2 ]; then
+      HEADLINE_ONLY=1
+      echo "$(date -Is) escalating to POLYKEY_BENCH_HEADLINE_ONLY=1"
+    fi
+    POLYKEY_BENCH_PROBE_TRIES=1 POLYKEY_BENCH_HEADLINE_ONLY=$HEADLINE_ONLY \
+      timeout 7200 python bench.py \
       > "perf/bench_watcher_${ts}.json" 2> "perf/bench_watcher_${ts}.log"
     bench_rc=$?
     echo "$(date -Is) bench attempt ${BENCH_TRIES}/${MAX_BENCH_TRIES} rc=${bench_rc} -> perf/bench_watcher_${ts}.json"
-    # Only stop once a real TPU artifact landed: a tunnel flap mid-run
-    # makes bench fall back to CPU (rc=0, "platform": "cpu").
-    if grep -q '"platform": "tpu"' "perf/bench_watcher_${ts}.json"; then
+    # Only stop once a real TPU artifact with an actual throughput number
+    # landed: a tunnel flap mid-run makes bench fall back to CPU (rc=0,
+    # "platform": "cpu"), and a TPU-stamped run whose every engine phase
+    # failed composes metric=bench_failed — neither is terminal success.
+    if grep -q '"platform": "tpu"' "perf/bench_watcher_${ts}.json" \
+        && ! grep -q '"metric": "bench_failed"' "perf/bench_watcher_${ts}.json"; then
       break
     fi
-    rm -f "perf/bench_watcher_${ts}.json" "perf/bench_watcher_${ts}.log"
+    if grep -q '"platform": "tpu"' "perf/bench_watcher_${ts}.json"; then
+      # TPU-backed but every engine phase failed: that artifact + stderr
+      # log are the only diagnostics of a real engine regression — keep
+      # them under a 'failed_' name instead of deleting the evidence.
+      mv "perf/bench_watcher_${ts}.json" "perf/bench_failed_${ts}.json"
+      mv "perf/bench_watcher_${ts}.log" "perf/bench_failed_${ts}.log" 2>/dev/null
+      echo "$(date -Is) tpu-backed bench_failed artifact kept as perf/bench_failed_${ts}.json"
+    else
+      rm -f "perf/bench_watcher_${ts}.json" "perf/bench_watcher_${ts}.log"
+      echo "$(date -Is) bench artifact was not tpu-backed (removed)"
+    fi
     if [ "$BENCH_TRIES" -ge "$MAX_BENCH_TRIES" ]; then
       echo "$(date -Is) bench retry budget spent; stopping"
       break
     fi
-    echo "$(date -Is) bench artifact was not tpu-backed (removed); backing off 300s"
+    echo "$(date -Is) backing off 300s before next bench attempt"
     sleep 300
   else
     echo "$(date -Is) tunnel down"
