@@ -98,6 +98,8 @@ def _with_compile_rescue(phase: str, result: dict, on_tpu: bool, run):
         )
         if not (on_tpu and compile_shaped):
             raise
+        if os.environ.get("POLYKEY_DISABLE_PAGED_KERNEL") == "1":
+            raise  # kernels already off — a retry would be identical
         # Self-rescue: a Mosaic compile regression in the Pallas kernels
         # must not zero out the round's evidence — the jnp paths serve
         # every geometry. Later phases inherit the env (scoped to
@@ -108,7 +110,15 @@ def _with_compile_rescue(phase: str, result: dict, on_tpu: bool, run):
         os.environ["POLYKEY_DISABLE_PAGED_KERNEL"] = "1"
         os.environ["POLYKEY_DISABLE_FLASH"] = "1"
         result["kernels_disabled"] = str(e)
-        return run()
+    # Retry OUTSIDE the handler: while the except block runs, the
+    # exception's traceback pins the failed engine's frames — and with
+    # them its device-resident params (~8.5 GiB for phase B). Dropping
+    # the traceback and collecting first lets the retry's allocation
+    # reuse that HBM instead of RESOURCE_EXHAUSTED-ing.
+    import gc
+
+    gc.collect()
+    return run()
 
 
 def probe_backend() -> str | None:
@@ -409,14 +419,10 @@ def main() -> None:
         # Greedy-only workload: skip the sampled-variant warmup compiles.
         warm_sampled_variants=False,
     )
-    if headline_only and on_tpu:
-        result["engine_1b"] = {"model": model_a,
-                               "skipped": "headline-only rescue mode"}
-        run_phase_a = False
-    else:
-        run_phase_a = True
     try:
-        if not run_phase_a:
+        if headline_only and on_tpu:
+            result["engine_1b"] = {"model": model_a,
+                                   "skipped": "headline-only rescue mode"}
             raise _PhaseSkipped()
         log(f"--- phase A: engine bench, {model_a} (block={block}) ---")
         phase_a = _with_compile_rescue(

@@ -31,6 +31,41 @@ def _env_bool(name: str, extra: tuple[str, ...] = ()) -> bool:
     return os.environ.get(name, "").lower() in ("1", "true", *extra)
 
 
+_compile_cache_dir: Optional[str] = None
+
+
+def enable_persistent_compile_cache() -> Optional[str]:
+    """Point JAX's persistent compilation cache at a durable directory.
+
+    TPU compiles of the serving step run 20-40 s each; a server restart,
+    a benchmark retry after a tunnel flap, or the driver's end-of-round
+    bench would otherwise pay them all again. The cache keys on program
+    HLO + compiler flags + platform, so reuse is exact. Opt out with
+    POLYKEY_COMPILE_CACHE=0; relocate with POLYKEY_COMPILE_CACHE_DIR.
+    Returns the cache dir in use (None when disabled or unavailable).
+    """
+    global _compile_cache_dir
+    if os.environ.get("POLYKEY_COMPILE_CACHE", "1") == "0":
+        return None
+    if _compile_cache_dir is not None:
+        return _compile_cache_dir
+    cache_dir = os.environ.get("POLYKEY_COMPILE_CACHE_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "polykey_tpu_xla")
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs",
+            _env_float("POLYKEY_COMPILE_CACHE_MIN_SECS", 1.0),
+        )
+    except Exception:
+        return None       # cache is an optimization, never a failure
+    _compile_cache_dir = cache_dir
+    return cache_dir
+
+
 @dataclass(frozen=True)
 class EngineConfig:
     model: str = "tiny-llama"

@@ -169,6 +169,11 @@ def check_flash() -> None:
 
 
 def main() -> int:
+    from polykey_tpu.engine.config import enable_persistent_compile_cache
+
+    cache = enable_persistent_compile_cache()
+    if cache:
+        print(f"compile cache: {cache}")
     d = jax.devices()[0]
     if d.platform != "tpu":
         print(f"not on TPU (platform={d.platform}); nothing to check")

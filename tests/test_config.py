@@ -172,3 +172,40 @@ def test_engine_config_int4_env(monkeypatch):
     cfg = EngineConfig.from_env()
     assert cfg.quantize and cfg.quantize_bits == 4
     cfg.validate()
+
+
+def test_persistent_compile_cache(monkeypatch, tmp_path):
+    """enable_persistent_compile_cache points JAX's durable cache at the
+    configured directory and populates it (min-compile-time forced to 0 so
+    even a trivial CPU jit writes an entry). Restarts and bench retries
+    after a tunnel flap reuse these entries instead of recompiling."""
+    import polykey_tpu.engine.config as ec
+
+    cache_dir = tmp_path / "xla_cache"
+    monkeypatch.setenv("POLYKEY_COMPILE_CACHE_DIR", str(cache_dir))
+    monkeypatch.setenv("POLYKEY_COMPILE_CACHE_MIN_SECS", "0")
+    monkeypatch.setattr(ec, "_compile_cache_dir", None)
+    got = ec.enable_persistent_compile_cache()
+    assert got == str(cache_dir)
+
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        # A fresh shape so the in-memory jit cache can't satisfy it.
+        jax.jit(lambda x: (x * 3 + 1).sum())(jnp.arange(1237.0)).block_until_ready()
+        assert any(cache_dir.iterdir()), "compile cache wrote no entries"
+    finally:
+        # Detach the global cache dir so later tests don't write into the
+        # (deleted) tmp_path.
+        jax.config.update("jax_compilation_cache_dir", None)
+        monkeypatch.setattr(ec, "_compile_cache_dir", None)
+
+
+def test_persistent_compile_cache_opt_out(monkeypatch):
+    """POLYKEY_COMPILE_CACHE=0 disables the cache entirely."""
+    import polykey_tpu.engine.config as ec
+
+    monkeypatch.setenv("POLYKEY_COMPILE_CACHE", "0")
+    monkeypatch.setattr(ec, "_compile_cache_dir", None)
+    assert ec.enable_persistent_compile_cache() is None
