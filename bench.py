@@ -74,6 +74,14 @@ class _PhaseSkipped(Exception):
     """Control-flow sentinel: a phase opted out before doing any work."""
 
 
+def _drop_tree_cache(cache_dir: str) -> None:
+    """Delete a stale/corrupt tree-cache key dir (footprint stays bounded
+    to live keys; best-effort — refabrication overwrites anyway)."""
+    import shutil
+
+    shutil.rmtree(cache_dir, ignore_errors=True)
+
+
 def _with_compile_rescue(phase: str, result: dict, on_tpu: bool, run):
     """Run a phase body; on a compile-shaped failure, disable the Pallas
     kernels for this and all later phases and retry once.
@@ -98,8 +106,11 @@ def _with_compile_rescue(phase: str, result: dict, on_tpu: bool, run):
         )
         if not (on_tpu and compile_shaped):
             raise
-        if os.environ.get("POLYKEY_DISABLE_PAGED_KERNEL") == "1":
-            raise  # kernels already off — a retry would be identical
+        def _off(var: str) -> bool:   # same parsing the kernels use
+            return os.environ.get(var, "").lower() in ("1", "true")
+
+        if _off("POLYKEY_DISABLE_PAGED_KERNEL") and _off("POLYKEY_DISABLE_FLASH"):
+            raise  # both kernels already off — a retry would be identical
         # Self-rescue: a Mosaic compile regression in the Pallas kernels
         # must not zero out the round's evidence — the jnp paths serve
         # every geometry. Later phases inherit the env (scoped to
@@ -154,7 +165,16 @@ def fabricate_params(cfg, dtype, quantize: bool, bits: int = 8):
     """Random params with real shapes/dtypes, built leaf-by-leaf on the host
     so an 8B tree never materializes at fp32 on device (or at all): int8
     leaves are filled directly — the engine's throughput doesn't depend on
-    weight values, only on shapes, dtypes, and placement."""
+    weight values, only on shapes, dtypes, and placement.
+
+    Trees are cached on disk (~71 s to fabricate an 8B tree vs ~0 s to
+    mmap it back) so bench retries after a tunnel flap spend their burst
+    window on the TPU, not on host memcpy. POLYKEY_BENCH_TREE_CACHE=0
+    disables; the cache lives under POLYKEY_BENCH_TREE_CACHE_DIR
+    (default ~/.cache/polykey_bench_trees — NOT /tmp, which is often a
+    RAM-backed tmpfs where an 8.5 GiB tree would double host RAM use),
+    keyed by model/dtype/bits; a stale key's dir is deleted before
+    refabrication so the footprint tracks live keys only."""
     import jax
     import ml_dtypes
     import numpy as np
@@ -167,6 +187,37 @@ def fabricate_params(cfg, dtype, quantize: bool, bits: int = 8):
         return quantize_params(p, cfg, bits=bits) if quantize else p
 
     tree = jax.eval_shape(build)
+    flat, treedef = jax.tree.flatten(tree)
+
+    cache_dir = None
+    if os.environ.get("POLYKEY_BENCH_TREE_CACHE", "1") != "0":
+        root = os.environ.get("POLYKEY_BENCH_TREE_CACHE_DIR") or os.path.join(
+            os.path.expanduser("~"), ".cache", "polykey_bench_trees")
+        key = f"{cfg.name}-{dtype}-{'q' + str(bits) if quantize else 'full'}"
+        cache_dir = os.path.join(root, key)
+        # Raw bytes + a JSON sidecar, not .npy: np.save round-trips the
+        # ml_dtypes extension dtypes (bfloat16, int4) as structured void
+        # arrays, silently losing the dtype.
+        meta_path = os.path.join(cache_dir, "META.json")
+        if os.path.exists(meta_path):
+            try:
+                with open(meta_path) as f:
+                    meta = json.load(f)
+                want = [[list(sd.shape), str(sd.dtype)] for sd in flat]
+                if meta == want:
+                    leaves = [
+                        np.memmap(os.path.join(cache_dir, f"{i}.bin"),
+                                  dtype=np.uint8, mode="r")
+                        .view(np.dtype(dt)).reshape(shape)
+                        for i, (shape, dt) in enumerate(meta)
+                    ]
+                    return jax.tree.unflatten(treedef, leaves)
+                log(f"tree cache {key}: stale shapes/dtypes; refabricating")
+                _drop_tree_cache(cache_dir)
+            except Exception as e:
+                log(f"tree cache {key} unreadable ({e}); refabricating")
+                _drop_tree_cache(cache_dir)
+
     rng = np.random.default_rng(0)
     # Tile a fixed random pool instead of generating fresh randomness per
     # element: throughput depends on shapes/dtypes only, and np.resize is
@@ -186,7 +237,20 @@ def fabricate_params(cfg, dtype, quantize: bool, bits: int = 8):
             return np.resize(pool_f32, sd.shape)
         return np.resize(pool_bf16, sd.shape)
 
-    return jax.tree.map(make, tree)
+    leaves = [make(sd) for sd in flat]
+    if cache_dir is not None:
+        try:
+            os.makedirs(cache_dir, exist_ok=True)
+            for i, leaf in enumerate(leaves):
+                np.ascontiguousarray(leaf).view(np.uint8).tofile(
+                    os.path.join(cache_dir, f"{i}.bin"))
+            # META.json written last = commit marker; a crash mid-write
+            # leaves no META and the next run refabricates.
+            with open(os.path.join(cache_dir, "META.json"), "w") as f:
+                json.dump([[list(l.shape), str(l.dtype)] for l in leaves], f)
+        except Exception as e:     # disk-full etc. — cache is optional
+            log(f"tree cache write failed ({e}); continuing uncached")
+    return jax.tree.unflatten(treedef, leaves)
 
 
 def _probe_step_costs(engine, max_new: int) -> dict:
@@ -339,7 +403,16 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
         result["error"] = "tpu backend unavailable; cpu fallback"
 
-    from polykey_tpu.engine.config import EngineConfig
+    from polykey_tpu.engine.config import (
+        EngineConfig,
+        enable_persistent_compile_cache,
+    )
+
+    # Durable XLA compile cache: a retry after a tunnel flap (and the
+    # driver's end-of-round run) reuses this run's 20-40 s TPU compiles.
+    cache_dir = enable_persistent_compile_cache()
+    if cache_dir:
+        log(f"compile cache: {cache_dir}")
 
     on_tpu = platform == "tpu"
     # Rescue mode for short tunnel bursts: only the phases the headline

@@ -54,7 +54,7 @@ from ..models.config import ModelConfig, get_config
 from ..models.transformer import forward_paged, init_params, unembed
 from ..parallel.mesh import MeshConfig, create_mesh
 from ..parallel.sharding import paged_kv_sharding, shard_params
-from .config import EngineConfig, enable_persistent_compile_cache
+from .config import EngineConfig
 from .kv_cache import AllocationError, BlockAllocator, PagedKV, init_paged_kv
 from .metrics import EngineMetrics, RequestTimings
 from .sampling import sample_tail
@@ -275,9 +275,6 @@ class InferenceEngine:
         draft_params: Optional[dict] = None,
     ):
         config.validate()
-        # Durable XLA compile cache: restarts and bench retries skip the
-        # 20-40 s/step TPU recompiles (POLYKEY_COMPILE_CACHE=0 opts out).
-        enable_persistent_compile_cache()
         self.config = config
         self.model_cfg = get_config(config.model)
         self.tokenizer = load_tokenizer(config.tokenizer)

@@ -26,7 +26,7 @@ import math
 import queue
 from typing import Iterator, Optional
 
-from ..engine.config import EngineConfig
+from ..engine.config import EngineConfig, enable_persistent_compile_cache
 from ..engine.engine import GenRequest, InferenceEngine
 from ..engine.tokenizer import ByteTokenizer, IncrementalDetokenizer
 from ..engine.watchdog import Watchdog
@@ -59,6 +59,11 @@ class TpuService(Service):
         from .security import SecretStore
 
         config = EngineConfig.from_env()
+        # Durable XLA compile cache at the SERVER entrypoint (not in the
+        # engine constructor: embedders and tests shouldn't have global
+        # jax config mutated under them). Restarts skip the 20-40 s/step
+        # TPU recompiles; POLYKEY_COMPILE_CACHE=0 opts out.
+        enable_persistent_compile_cache()
         engine = InferenceEngine(config, health=health, logger=logger)
         watchdog = Watchdog(engine, health=health, logger=logger)
         watchdog.start()
