@@ -34,7 +34,7 @@ draft == target (the acceptance-1.0 ceiling).
 A compile-shaped phase-A failure on TPU retries once with the Pallas
 kill-switches set (kernels_disabled recorded in the artifact).
 
-Run order is 0, A, B, B2, A-tok, A2, D, C, C2 — the headline phases
+Run order is 0, A, B, B2, A-tok, A2, D, E, C, C2 — the headline phases
 (B int8, B2 int4; the JSON line takes the better) run as early as
 possible so a tunnel flap mid-bench still leaves a target-comparable
 number in the artifact. POLYKEY_BENCH_SKIP_8B_INT4=1 skips B2.
@@ -43,10 +43,13 @@ Knobs (env): POLYKEY_BENCH_MODEL, POLYKEY_BENCH_REQUESTS,
 POLYKEY_BENCH_PROMPT, POLYKEY_BENCH_NEW_TOKENS, POLYKEY_BENCH_BLOCK,
 POLYKEY_BENCH_LOOKAHEAD, POLYKEY_BENCH_8B_SLOTS, POLYKEY_BENCH_SKIP_8B=1,
 POLYKEY_BENCH_SKIP_SPEC=1, POLYKEY_BENCH_SKIP_LONGCTX=1,
+POLYKEY_BENCH_SKIP_MOE=1, POLYKEY_BENCH_MOE_SLOTS,
 POLYKEY_BENCH_SKIP_GEMMA_SPEC=1, POLYKEY_BENCH_GEMMA_SLOTS,
 POLYKEY_BENCH_SKIP_8B_INT4=1, POLYKEY_BENCH_8B_INT4_SLOTS,
 POLYKEY_BENCH_TOKENIZER, POLYKEY_BENCH_PROBE_TRIES,
-POLYKEY_BENCH_PROBE_TIMEOUT.
+POLYKEY_BENCH_PROBE_TIMEOUT, POLYKEY_BENCH_TREE_CACHE=0 (disable the
+fabricated-tree disk cache — it writes multi-GiB trees),
+POLYKEY_BENCH_TREE_CACHE_DIR (default ~/.cache/polykey_bench_trees).
 
 POLYKEY_BENCH_HEADLINE_ONLY=1 is the tunnel-flap rescue mode: phase 0 +
 phase B (8B int8) only — the minimum wall-clock that still lands a
@@ -749,6 +752,52 @@ def main() -> None:
         except Exception as e:
             log(f"phase D failed: {e}")
             result["engine_longctx"] = {"error": str(e)}
+
+    # --- Phase E: MoE serving — measurement config 4's mechanism on one
+    # chip. mixtral-bench keeps the 8x7B architecture (8 experts, top-2,
+    # dispatch routing) at ~4.7 B params so the int8 tree fits next to KV
+    # in 16 GiB; at batch width every expert is hit each step, so decode
+    # pays the full expert-weight HBM read like the real model does.
+    # ep>1 (the all-to-all) is covered by the virtual-mesh dryrun; one
+    # chip exercises routing + grouped expert matmuls under Mosaic. ---
+    if (on_tpu and not headline_only
+            and os.environ.get("POLYKEY_BENCH_SKIP_MOE", "") != "1"):
+        try:
+            log("--- phase E: mixtral-bench int8 MoE engine bench ---")
+            from polykey_tpu.models.config import get_config
+
+            t0 = time.monotonic()
+            params_m = fabricate_params(
+                get_config("mixtral-bench"), "bfloat16", quantize=True)
+            log(f"fabricated mixtral-bench int8 tree in "
+                f"{time.monotonic() - t0:.1f}s")
+            slots_m = int(os.environ.get("POLYKEY_BENCH_MOE_SLOTS", "16"))
+            cfg_e = EngineConfig(
+                model="mixtral-bench",
+                dtype="bfloat16",
+                quantize=False,  # params arrive pre-quantized
+                max_decode_slots=slots_m,
+                page_size=16,
+                num_pages=slots_m * 32 + 64,
+                max_seq_len=512,
+                prefill_buckets=(prompt_len,),
+                max_new_tokens_cap=max_new,
+                decode_block_steps=block,
+                lookahead_blocks=lookahead,
+                compile_warmup=True,
+                warm_sampled_variants=False,
+            )
+            phase_e = _with_compile_rescue(
+                "E", result, on_tpu,
+                lambda: bench_engine(cfg_e, params_m, 2 * slots_m,
+                                     prompt_len, max_new))
+            result["engine_moe"] = {"model": "mixtral-bench", **phase_e}
+            del params_m
+            import gc
+            gc.collect()
+        except Exception as e:
+            log(f"phase E failed: {e}")
+            result["engine_moe"] = {"error": str(e)}
 
     # --- Phase C: speculative serving (config 5's mechanism on hardware).
     # Draft ≡ target (same tree), so greedy acceptance is exactly 1.0 and

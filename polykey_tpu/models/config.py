@@ -232,6 +232,21 @@ TINY_GEMMA = replace(
 # weights (v5e has 16 GiB HBM; 8B serves in int8 — see engine docs).
 LLAMA_1B_BENCH = replace(LLAMA32_1B, name="llama-1b-bench")
 
+# Mixtral ARCHITECTURE (8 experts, top-2, dispatch routing) scaled to
+# ~4.7 B params so the int8 tree (~4.7 GiB) + KV fits one v5e chip:
+# hardware evidence for measurement config 4's mechanism (MoE routing +
+# grouped expert matmuls) without 8x7B's 47 B params, which need tp>=4.
+MIXTRAL_BENCH = replace(
+    MIXTRAL_8X7B,
+    name="mixtral-bench",
+    hidden_size=2048,
+    intermediate_size=5632,
+    num_layers=16,
+    num_heads=32,
+    num_kv_heads=8,
+    head_dim=64,
+)
+
 MODEL_REGISTRY = {
     cfg.name: cfg
     for cfg in (
@@ -246,6 +261,7 @@ MODEL_REGISTRY = {
         TINY_MIXTRAL,
         TINY_GEMMA,
         LLAMA_1B_BENCH,
+        MIXTRAL_BENCH,
     )
 }
 

@@ -29,6 +29,7 @@ PHASES = [
     ("engine_ttft_tokenized", "A-tok real-BPE TTFT"),
     ("prefix_cache", "A2 prefix cache"),
     ("engine_longctx", "D  long context"),
+    ("engine_moe", "E  moe (mixtral-bench)"),
     ("engine_spec", "C  spec ceiling"),
     ("engine_gemma_spec", "C2 gemma spec"),
 ]

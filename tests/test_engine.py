@@ -754,3 +754,38 @@ def test_top_k_clamps_to_candidate_width():
         assert error is None and done is not None and tokens
     finally:
         eng.shutdown()
+
+
+def test_prequantized_moe_engine_serves():
+    """Bench phase E's exact path: a PRE-quantized int8 Mixtral-family
+    tree handed to the engine (quantize=False — params arrive quantized,
+    like the 8B/9B bench phases) serves greedily and matches the engine
+    that quantizes the same weights itself."""
+    import dataclasses
+
+    import jax
+
+    from polykey_tpu.models.config import get_config
+    from polykey_tpu.models.quant import quantize_params
+    from polykey_tpu.models.transformer import init_params
+
+    cfg = dataclasses.replace(TEST_CONFIG, model="tiny-mixtral")
+    mc = get_config("tiny-mixtral")
+    fp = init_params(jax.random.PRNGKey(3), mc, "float32")
+    pre = quantize_params(fp, mc, bits=8)
+
+    def serve(config, params):
+        eng = InferenceEngine(config, params=params)
+        try:
+            r = GenRequest(prompt="hello moe", max_new_tokens=8,
+                           temperature=0.0)
+            eng.submit(r)
+            toks, done, err = _collect(r)
+            assert err is None and done is not None
+            return toks
+        finally:
+            eng.shutdown()
+
+    got = serve(cfg, pre)
+    want = serve(dataclasses.replace(cfg, quantize=True), fp)
+    assert got == want and len(got) == 8

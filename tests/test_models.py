@@ -184,3 +184,29 @@ def test_sampling_modes():
         int(sample(logits, jax.random.PRNGKey(i), params)[0]) for i in range(20)
     }
     assert draws.issubset({0, 1, 2, 3}) and len(draws) > 1
+
+
+def test_mixtral_bench_fits_one_chip():
+    """mixtral-bench (bench phase E) must keep the 8x7B architecture —
+    8 experts, top-2, dispatch routing — while its int8 tree + KV fit a
+    16 GiB v5e chip; a config drift that silently fattens it would turn
+    the MoE hardware phase into an OOM."""
+    import jax
+
+    from polykey_tpu.models.config import MIXTRAL_8X7B, get_config
+    from polykey_tpu.models.quant import quantize_params
+    from polykey_tpu.models.transformer import init_params
+
+    cfg = get_config("mixtral-bench")
+    assert cfg.num_experts == MIXTRAL_8X7B.num_experts == 8
+    assert cfg.num_experts_per_tok == MIXTRAL_8X7B.num_experts_per_tok == 2
+    assert cfg.moe_dispatch and MIXTRAL_8X7B.moe_dispatch
+
+    tree = jax.eval_shape(
+        lambda: quantize_params(
+            init_params(jax.random.PRNGKey(0), cfg, "bfloat16"), cfg, bits=8))
+    total = sum(
+        x.size * x.dtype.itemsize for x in jax.tree.leaves(tree))
+    # int8 weights well under half the chip: leaves room for 16 slots of
+    # KV pages, activations, and the compiler's scratch.
+    assert total < 6 * 2**30, f"mixtral-bench int8 tree is {total/2**30:.1f} GiB"
