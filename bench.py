@@ -302,10 +302,21 @@ def bench_engine(
     engine_cfg, params, n_requests: int, prompt_len: int, max_new: int,
     draft_params=None, prompt_fn=None,
 ) -> dict:
-    """Closed-loop engine bench: in-flight capped at the slot count, so TTFT
-    reflects prefill + scheduling under steady load, not an artificial
-    all-at-once queue. `prompt_fn` overrides the default random-chars
-    prompts (the real-tokenizer phase passes text sized in TOKENS)."""
+    """Closed-loop engine bench + a light-load TTFT probe.
+
+    The closed loop keeps in-flight at 2x the slot count: done-delivery
+    lags the dispatch pipeline by `lookahead_blocks`, so a queue capped AT
+    the slot count leaves every retiring slot empty for several blocks
+    (measured 5/32 live lanes in r03) — a load-generator artifact, not an
+    engine property. The deeper queue keeps a waiting request ready the
+    iteration a slot frees, which is what a saturated server looks like.
+
+    TTFT under that saturation measures queue wait, not serving latency,
+    so `p50_ttft_ms` additionally comes from a separate light-load probe
+    (a few requests, in-flight 2) on the same warm engine; the saturated
+    number is kept as `saturated_ttft_ms`. `prompt_fn` overrides the
+    default random-chars prompts (the real-tokenizer phase passes text
+    sized in TOKENS)."""
     import threading
 
     import numpy as np
@@ -335,52 +346,82 @@ def bench_engine(
         log(f"warmup done in {time.monotonic() - t0:.1f}s")
 
         slots = engine_cfg.max_decode_slots
-        in_flight = threading.Semaphore(slots)
-        timings, errors, lock = [], [], threading.Lock()
+        lock = threading.Lock()
 
-        def drain(r: GenRequest) -> None:
-            try:
-                while True:
-                    kind, value = r.out.get(timeout=600.0)
-                    if kind == "done":
-                        with lock:
-                            timings.append(value)
-                        return
-                    if kind == "error":
-                        with lock:
-                            errors.append(value)
-                        return
-            except Exception as e:  # incl. queue.Empty: a hung request must
-                with lock:          # surface, not silently deflate tok/s
-                    errors.append(f"drain: {type(e).__name__}: {e}")
-            finally:
-                in_flight.release()
+        def run_closed_loop(n: int, depth: int, new_tokens: int,
+                            sink: list, errs: list) -> float:
+            """Submit n requests with in-flight capped at `depth`; drain
+            each on its own thread into `sink` (done timings) / `errs`.
+            One implementation serves both the saturated measurement and
+            the light-load TTFT probe."""
+            sem = threading.Semaphore(depth)
 
-        t0 = time.monotonic()
-        drainers = []
-        for _ in range(n_requests):
-            in_flight.acquire()
-            r = GenRequest(prompt=prompt(), max_new_tokens=max_new)
-            engine.submit(r)
-            th = threading.Thread(target=drain, args=(r,), daemon=True)
-            th.start()
-            drainers.append(th)
-        for th in drainers:
-            th.join(timeout=600.0)
-        elapsed = time.monotonic() - t0
+            def drain(r: GenRequest) -> None:
+                try:
+                    while True:
+                        kind, value = r.out.get(timeout=600.0)
+                        if kind == "done":
+                            with lock:
+                                sink.append(value)
+                            return
+                        if kind == "error":
+                            with lock:
+                                errs.append(value)
+                            return
+                except Exception as e:  # incl. queue.Empty: a hung request
+                    with lock:          # must surface, not deflate tok/s
+                        errs.append(f"drain: {type(e).__name__}: {e}")
+                finally:
+                    sem.release()
+
+            t0 = time.monotonic()
+            threads = []
+            for _ in range(n):
+                sem.acquire()
+                r = GenRequest(prompt=prompt(), max_new_tokens=new_tokens)
+                engine.submit(r)
+                th = threading.Thread(target=drain, args=(r,), daemon=True)
+                th.start()
+                threads.append(th)
+            for th in threads:
+                th.join(timeout=600.0)
+            return time.monotonic() - t0
+
+        # Saturated closed loop: in-flight at 2x slots (done-delivery lags
+        # the lookahead pipeline; a queue capped AT the slot count leaves
+        # retiring slots empty for several blocks — measured 5/32 lanes).
+        timings, errors = [], []
+        elapsed = run_closed_loop(
+            n_requests, slots * 2, max_new, timings, errors)
 
         if errors:
             raise RuntimeError(f"{len(errors)} requests failed: {errors[0]}")
         total_tokens = sum(t.completion_tokens for t in timings)
         tok_s = total_tokens / elapsed
-        p50_ttft = statistics.median(t.ttft_ms for t in timings)
+        sat_ttft = statistics.median(t.ttft_ms for t in timings)
         log(f"{len(timings)} requests, {total_tokens} tokens in "
-            f"{elapsed:.2f}s -> {tok_s:.1f} tok/s, p50 TTFT {p50_ttft:.1f} ms")
+            f"{elapsed:.2f}s -> {tok_s:.1f} tok/s, saturated p50 TTFT "
+            f"{sat_ttft:.1f} ms")
+
+        # Light-load TTFT probe: 6 requests, in-flight 2, short replies —
+        # prefill + first-token latency without saturation queue wait.
+        # Probe failures only cost the probe (fall back to the saturated
+        # number); they must not fail the whole phase.
+        probe_timings, probe_errors = [], []
+        run_closed_loop(6, 2, min(8, max_new), probe_timings, probe_errors)
+        p50_ttft = (
+            statistics.median(t.ttft_ms for t in probe_timings)
+            if probe_timings else sat_ttft
+        )
+        log(f"light-load p50 TTFT {p50_ttft:.1f} ms "
+            f"({len(probe_timings)} probe requests)")
+
         costs = _probe_step_costs(engine, max_new)
         log(f"step costs: {costs}")
         out = {
             "tok_s": round(tok_s, 1),
             "p50_ttft_ms": round(p50_ttft, 1),
+            "saturated_ttft_ms": round(sat_ttft, 1),
             "requests": len(timings),
             "total_tokens": total_tokens,
             "elapsed_s": round(elapsed, 2),

@@ -633,24 +633,53 @@ class InferenceEngine:
     # -- engine thread ------------------------------------------------------
 
     def _run(self) -> None:
+        # POLYKEY_LOOP_TRACE=1: accumulate wall time per loop phase and
+        # print a summary to stderr every 100 iterations — the tool that
+        # found the r03 host-side serialization (PERF.md). Near-zero cost
+        # when off (one getenv at thread start, no timers taken).
+        import os as _os
+
+        trace = _os.environ.get("POLYKEY_LOOP_TRACE", "") == "1"
+        tacc: dict = {"iters": 0}
+        self._trace_acc = tacc if trace else None
+
+        def _t() -> float:
+            return time.monotonic() if trace else 0.0
+
+        def _acc(key: str, t0: float) -> None:
+            if trace:
+                tacc[key] = tacc.get(key, 0.0) + (time.monotonic() - t0)
+
         try:
             while not self._stop.is_set():
+                if trace:
+                    tacc["iters"] += 1
+                    if tacc["iters"] % 100 == 0:
+                        import sys as _sys
+
+                        print(f"[loop-trace] {tacc}", file=_sys.stderr,
+                              flush=True)
                 if self.dead is not None:  # watchdog tripped while we were out
                     self._fail_all(self.dead)
                     return
-                # While streams are decoding, admit at most one prefill per
-                # step so running streams stall for ≤ one prefill bucket;
-                # long prompts advance one chunk per iteration for the same
-                # reason (chunked prefill — never more than one chunk of
-                # stall between decode steps). Admissions activate their
-                # lanes via on-device merges (no sync, no pipeline flush);
-                # the host only reads first tokens once their async copies
-                # land.
-                limit = 1 if self._active.any() else None
-                worked = self._admit(limit)
+                # Admit every waiting request a free slot can take, every
+                # iteration. Burst admissions cost one batched prefill
+                # dispatch per bucket group (_dispatch_prefill_group), so
+                # the decode stall is bounded by a few group prefills —
+                # NOT one per request. The old `limit=1 if active` policy
+                # equilibrated occupancy at ~max_new/K lanes (a request
+                # retires every K steps for every one admitted): measured
+                # 5/32 live lanes and 230 tok/s where full slots give
+                # ~2,000 (r03 loop-trace, PERF.md). Long prompts still
+                # advance one chunk per iteration (chunked prefill).
+                t0 = _t()
+                worked = self._admit()
+                _acc("admit", t0)
                 chunk_slot = self._chunk_pending_slot()
                 if chunk_slot is not None:
+                    t0 = _t()
                     self._prefill_one_chunk(chunk_slot)
+                    _acc("chunk", t0)
                     worked = True
                 if self._dev_dirty and self._inflight_q:
                     # Rare full transition (init/recovery): a mirror upload
@@ -668,14 +697,30 @@ class InferenceEngine:
                 # stop, so both block kinds pipeline alike.
                 dispatched = False
                 if self._active.any():
+                    t0 = _t()
                     self._inflight_q.append(self._dispatch_step())
+                    _acc("dispatch", t0)
+                    if trace:
+                        tacc["blocks"] = tacc.get("blocks", 0) + 1
+                        tacc["disp_steps"] = (
+                            tacc.get("disp_steps", 0)
+                            + self._last_dispatch_steps
+                        )
+                        tacc["disp_lanes"] = (
+                            tacc.get("disp_lanes", 0)
+                            + int(self._active.sum())
+                        )
                     dispatched = True
                     worked = True
+                t0 = _t()
                 self._resolve_prefills()
+                _acc("resolve", t0)
                 target = self._depth_target if dispatched else 0
+                t0 = _t()
                 while len(self._inflight_q) > target:
                     self._process_step(self._inflight_q.popleft())
                     worked = True
+                _acc("process", t0)
                 if worked:
                     self.last_progress = time.monotonic()
                 else:
@@ -715,6 +760,7 @@ class InferenceEngine:
         register for chunked prefill."""
         admitted = False
         count = 0
+        trace = getattr(self, "_trace_acc", None)
         groups: dict[int, list] = {}    # bucket → [(slot_idx, slot, ids)]
         try:
             while limit is None or count < limit:
@@ -722,10 +768,14 @@ class InferenceEngine:
                     i for i, s in enumerate(self._slots) if s is None
                 ]
                 if not free_slots:
+                    if trace is not None:
+                        trace["adm_noslot"] = trace.get("adm_noslot", 0) + 1
                     return admitted
                 try:
                     request = self._submit.get_nowait()
                 except queue.Empty:
+                    if trace is not None:
+                        trace["adm_empty"] = trace.get("adm_empty", 0) + 1
                     return admitted
                 if request.cancelled.is_set():
                     continue
@@ -733,6 +783,8 @@ class InferenceEngine:
                     prep = self._prepare_request(free_slots[0], request)
                     admitted = True
                     count += 1
+                    if trace is not None:
+                        trace["adm_ok"] = trace.get("adm_ok", 0) + 1
                     if prep is not None:
                         bucket = prep[0]
                         groups.setdefault(bucket, []).append(prep[1:])
@@ -743,6 +795,8 @@ class InferenceEngine:
                 except AllocationError:
                     # Pool exhausted: put it back and let running requests
                     # finish. FIFO fairness over throughput.
+                    if trace is not None:
+                        trace["adm_alloc"] = trace.get("adm_alloc", 0) + 1
                     self._requeue_front(request)
                     return admitted
                 except Exception as e:

@@ -308,7 +308,7 @@ def forward_paged(
     decode = tokens.shape[1] == 1
 
     def attend(layer_idx, q, k, v, kc, vc):
-        kc, vc = paged_write(kc, vc, k, v, page_tables, positions)
+        kc, vc = paged_write(kc, vc, k, v, page_tables, positions, mesh=mesh)
         # Single-token steps take the DMA decode kernel (reads only valid
         # pages); prefill buckets take the gather path (wide T amortizes
         # the window materialization, and flash covers contiguous prefill).

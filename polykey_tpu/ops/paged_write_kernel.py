@@ -1,0 +1,161 @@
+"""Pallas paged-KV WRITE kernel (TPU) — the decode-step scatter, done as DMA.
+
+Why this exists: the XLA scatter in ops/paged_attention.paged_write
+(`k_pages.at[page_ids, offsets].set(k_new)`) lowers on TPU to a
+sequential per-row update loop — for a decode step that is
+2 (k,v) x num_layers x B tiny dynamic-update-slices, measured at ~10 ms
+of the ~21 ms step at 1B/B=32 geometry (scripts/profile_block_device.py,
+PERF.md). The write itself moves only B x Hk x D x 2 bytes per layer
+(~100 KB) — it is pure launch/serialization overhead.
+
+A row cannot be DMA'd directly into its page: pool pages are tiled
+(8, 128) in their last two dims, and DMA slices at arbitrary sublane
+offsets (the row's position within the page) are illegal. So the kernel
+does a two-wave page-granular read-modify-write, one program total:
+
+  wave 1: start ALL B page-read DMAs (pool page -> VMEM buffer) at once;
+  blend:  per lane (static unrolled loop), select the lane's row into
+          the buffered page at its offset — pure vector ops;
+  wave 2: start ALL B page write-back DMAs, wait.
+
+Every DMA in a wave is in flight concurrently, so the cost is ~two page
+DMA latencies + B small vector blends, independent of B's serialization.
+The pools are input_output_aliased — in place, no pool copy (the engine
+donates the pool through every dispatch).
+
+Garbage-page collisions are intended: inactive lanes all target page 0
+(engine convention, engine.py "Inactive slots"); several lanes then RMW
+page 0 concurrently and *some* full page wins — page 0 is never read
+unmasked. Active lanes never share a page (allocator invariant), so
+their full-page write-backs cannot clobber each other.
+
+Layout: pools fold heads into lanes [N, ps, Hk*D] exactly like the read
+kernel (ops/paged_attention_kernel.py) — Hk*D must be 128-aligned, the
+same `use_paged_kernel` gate. Off-TPU (and under
+POLYKEY_DISABLE_PAGED_KERNEL=1) callers keep the XLA scatter.
+
+Reference obligation: none — the reference has no KV cache at all
+(SURVEY.md §2b "Paged KV cache" is north-star-owed); this is the
+TPU-idiomatic half of that component.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _write_kernel(
+    # scalar prefetch
+    pids_ref,      # [B] int32 destination page per lane (SMEM)
+    offs_ref,      # [B] int32 destination row within the page (SMEM)
+    # inputs
+    knew_ref,      # [B, 1, HkD] VMEM — all lanes' new K rows (tiny)
+    vnew_ref,      # [B, 1, HkD] VMEM
+    kp_in,         # [N, ps, HkD] ANY (aliased with kp_out)
+    vp_in,
+    # outputs (aliased)
+    kp_out,        # [N, ps, HkD] ANY
+    vp_out,
+    # scratch
+    k_buf,         # [B, ps, HkD] VMEM — one buffered page per lane
+    v_buf,
+    kr_sems,       # [B] DMA semaphores (page reads)
+    vr_sems,
+    kw_sems,       # [B] DMA semaphores (page write-backs)
+    vw_sems,
+):
+    del kp_in, vp_in
+    B = k_buf.shape[0]
+    ps = k_buf.shape[1]
+
+    def read_dma(b, pages, buf, sems):
+        return pltpu.make_async_copy(
+            pages.at[pids_ref[b]], buf.at[b], sems.at[b]
+        )
+
+    def write_dma(b, buf, pages, sems):
+        return pltpu.make_async_copy(
+            buf.at[b], pages.at[pids_ref[b]], sems.at[b]
+        )
+
+    # Wave 1: every lane's page read goes out together.
+    for b in range(B):
+        read_dma(b, kp_out, k_buf, kr_sems).start()
+        read_dma(b, vp_out, v_buf, vr_sems).start()
+
+    rows = jax.lax.broadcasted_iota(jnp.int32, (ps, 1), 0)
+    for b in range(B):
+        read_dma(b, kp_out, k_buf, kr_sems).wait()
+        read_dma(b, vp_out, v_buf, vr_sems).wait()
+        sel = rows == offs_ref[b]                      # [ps, 1]
+        k_buf[b] = jnp.where(sel, knew_ref[b], k_buf[b])
+        v_buf[b] = jnp.where(sel, vnew_ref[b], v_buf[b])
+        # Wave 2 starts per lane as soon as its blend lands.
+        write_dma(b, k_buf, kp_out, kw_sems).start()
+        write_dma(b, v_buf, vp_out, vw_sems).start()
+
+    for b in range(B):
+        write_dma(b, k_buf, kp_out, kw_sems).wait()
+        write_dma(b, v_buf, vp_out, vw_sems).wait()
+
+
+def paged_write_decode_kernel(
+    k_pages: jax.Array,       # [N, ps, Hk, D]
+    v_pages: jax.Array,
+    k_new: jax.Array,         # [B, 1, Hk, D] — single decode token per lane
+    v_new: jax.Array,
+    page_ids: jax.Array,      # [B] int32
+    offsets: jax.Array,       # [B] int32
+    *,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """In-place decode-step KV write; returns the (aliased) pools."""
+    N, ps, Hk, D = k_pages.shape
+    B = k_new.shape[0]
+    HkD = Hk * D
+
+    kp = k_pages.reshape(N, ps, HkD)
+    vp = v_pages.reshape(N, ps, HkD)
+    kn = k_new.reshape(B, 1, HkD)
+    vn = v_new.reshape(B, 1, HkD)
+
+    any_spec = pl.BlockSpec(memory_space=pltpu.ANY)
+    row_spec = pl.BlockSpec(
+        (B, 1, HkD), lambda *_: (0, 0, 0), memory_space=pltpu.VMEM
+    )
+    kp, vp = pl.pallas_call(
+        _write_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct(kp.shape, kp.dtype),
+            jax.ShapeDtypeStruct(vp.shape, vp.dtype),
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(),
+            in_specs=[row_spec, row_spec, any_spec, any_spec],
+            out_specs=[any_spec, any_spec],
+            scratch_shapes=[
+                pltpu.VMEM((B, ps, HkD), kp.dtype),
+                pltpu.VMEM((B, ps, HkD), vp.dtype),
+                pltpu.SemaphoreType.DMA((B,)),
+                pltpu.SemaphoreType.DMA((B,)),
+                pltpu.SemaphoreType.DMA((B,)),
+                pltpu.SemaphoreType.DMA((B,)),
+            ],
+        ),
+        # Flattened input positions incl. the 2 scalar-prefetch args:
+        # pids=0 offs=1 k_new=2 v_new=3 k_pages=4 v_pages=5.
+        input_output_aliases={4: 0, 5: 1},
+        interpret=interpret,
+    )(
+        page_ids.astype(jnp.int32),
+        offsets.astype(jnp.int32),
+        kn.astype(kp.dtype),
+        vn.astype(vp.dtype),
+        kp,
+        vp,
+    )
+    return kp.reshape(N, ps, Hk, D), vp.reshape(N, ps, Hk, D)

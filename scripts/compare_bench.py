@@ -50,6 +50,15 @@ def _phase_line(name: str, d: dict, old: dict | None) -> str:
     if "skipped" in d:
         return f"{name:24s} skipped: {d['skipped'][:70]}"
     bits = []
+    # Key semantics changed mid-r03: p50_ttft_ms was the SATURATED
+    # closed-loop median until the light-load probe landed; artifacts
+    # that carry saturated_ttft_ms use the new split. Label the TTFT so
+    # cross-era comparisons can't read a load-model change as an engine
+    # win; the saturated figure prints alongside for the honest line-up.
+    if "saturated_ttft_ms" in d:
+        bits.append("ttft(light) {:.1f}ms  ttft(sat) {:.1f}ms".format(
+            d["p50_ttft_ms"], d["saturated_ttft_ms"]))
+        d = {k: v for k, v in d.items() if k != "p50_ttft_ms"}
     for key, fmt in (("tok_s", "{:.1f} tok/s"), ("p50_ttft_ms", "ttft {:.1f}ms"),
                      ("p50_ms", "p50 {:.3f}ms"), ("p95_ms", "p95 {:.3f}ms"),
                      ("cold_ttft_ms", "cold {:.1f}ms"),
@@ -100,9 +109,15 @@ def main() -> int:
             print(f"  tok/s target {TARGET_TOK_S:.0f}: "
                   f"{v / TARGET_TOK_S:.2f}x -> {verdict}")
         if isinstance(ttft, (int, float)):
+            # Light-load probe when the artifact carries the split keys
+            # (post-r03), saturated closed-loop median before that.
+            era = ("light-load"
+                   if "saturated_ttft_ms" in nd.get("engine_8b_int8", {})
+                   or "saturated_ttft_ms" in nd.get("engine_8b_int4", {})
+                   else "pre-split/saturated")
             verdict = "MET" if ttft < TARGET_TTFT_MS else "missed"
             print(f"  TTFT target <{TARGET_TTFT_MS:.0f}ms: {ttft:.1f}ms "
-                  f"-> {verdict}")
+                  f"({era}) -> {verdict}")
 
     print("\nphases:")
     for key, label in PHASES:

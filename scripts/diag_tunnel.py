@@ -1,0 +1,93 @@
+"""Diagnose the axon tunnel's dispatch behavior.
+
+Answers three questions the r03 bench raised (perf/bench_run_r03.log):
+1. What is the current sync roundtrip (host->device->host)?
+2. Is dispatch ASYNC through the tunnel? (issue N jitted calls without
+   syncing: if wall time ~ N * roundtrip, dispatch itself blocks and the
+   engine's lookahead pipeline cannot hide latency; if ~0, dispatch is
+   fire-and-forget and something else serializes.)
+3. Does an int4 weight matmul (the phase-B2 kill) raise UNIMPLEMENTED,
+   and does the error wedge the backend for later, unrelated dispatches?
+
+Run standalone (fresh process, owns the chip): python scripts/diag_tunnel.py
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    dev = jax.devices()[0]
+    log(f"device: {dev.device_kind} platform={dev.platform}")
+
+    # 1. sync roundtrip
+    for trial in range(3):
+        t0 = time.monotonic()
+        for _ in range(5):
+            np.asarray(jax.device_put(np.zeros((1,), np.int32)))
+        log(f"roundtrip trial {trial}: {(time.monotonic()-t0)/5*1000:.1f} ms")
+
+    # 2. dispatch asynchronicity on a compute-heavy jitted fn
+    @jax.jit
+    def step(x):
+        def body(c, _):
+            return c @ c * 1e-3 + c, None
+        y, _ = jax.lax.scan(body, x, None, length=8)
+        return y
+
+    x = jnp.ones((1024, 1024), jnp.bfloat16)
+    step(x).block_until_ready()  # compile
+    t0 = time.monotonic()
+    y = x
+    for _ in range(10):
+        y = step(y)
+    t_dispatch = time.monotonic() - t0
+    y.block_until_ready()
+    t_total = time.monotonic() - t0
+    log(f"10 chained dispatches: issue={t_dispatch*1000:.1f} ms, "
+        f"complete={t_total*1000:.1f} ms")
+
+    # unchained (independent) dispatches
+    t0 = time.monotonic()
+    outs = [step(x) for _ in range(10)]
+    t_dispatch = time.monotonic() - t0
+    for o in outs:
+        o.block_until_ready()
+    t_total = time.monotonic() - t0
+    log(f"10 independent dispatches: issue={t_dispatch*1000:.1f} ms, "
+        f"complete={t_total*1000:.1f} ms")
+
+    # tiny-result D2H: what a per-block token fetch costs
+    small = jax.jit(lambda x: x.sum())(x)
+    small.block_until_ready()
+    t0 = time.monotonic()
+    for _ in range(5):
+        np.asarray(jax.jit(lambda x: x.sum())(x))
+    log(f"small-result fetch: {(time.monotonic()-t0)/5*1000:.1f} ms")
+
+    # 3. int4 probe last (may wedge the backend)
+    try:
+        w4 = jnp.ones((256, 256), jnp.int4)
+        xb = jnp.ones((8, 256), jnp.bfloat16)
+        out = jax.jit(lambda a, b: a @ b.astype(jnp.bfloat16))(xb, w4)
+        out.block_until_ready()
+        log("int4 astype matmul: OK")
+    except Exception as e:  # noqa: BLE001
+        log(f"int4 astype matmul FAILED: {type(e).__name__}: {str(e)[:200]}")
+    # does the backend still work after the failure?
+    try:
+        np.asarray(jax.device_put(np.ones((2,), np.float32)) * 2)
+        log("post-int4 dispatch: backend still alive")
+    except Exception as e:  # noqa: BLE001
+        log(f"post-int4 dispatch FAILED (backend wedged): {str(e)[:200]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,129 @@
+"""Time the engine's REAL decode-block function on device, in isolation.
+
+profile_step_device.py measures bare components (its scan discards the
+updated KV pool, so paged_write may be dead-code-eliminated); this script
+times `_decode_fn` exactly as the engine dispatches it — same jit wrapper,
+same donation, pool chained block-to-block — via the backpressure slope:
+dispatch M blocks chained, sync once on the final packed tokens, and
+report (wall_2M - wall_M) / M per block. block_until_ready is a no-op on
+axon, so the sync is np.asarray of the small [K, B] output.
+
+Variants: kernel vs gather attention path, K=16 vs K=1 (fixed-vs-marginal
+split), donation on vs off (pool-copy cost).
+
+Usage: python scripts/profile_block_device.py [model] [batch] [ctx] [K]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    model = sys.argv[1] if len(sys.argv) > 1 else "llama-1b-bench"
+    B = int(sys.argv[2]) if len(sys.argv) > 2 else 32
+    ctx = int(sys.argv[3]) if len(sys.argv) > 3 else 512
+    K = int(sys.argv[4]) if len(sys.argv) > 4 else 16
+
+    from polykey_tpu.engine.engine import _decode_fn
+    from polykey_tpu.engine.kv_cache import init_paged_kv
+    from polykey_tpu.models.config import get_config
+    from polykey_tpu.models.transformer import init_params
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} {dev.device_kind}; {model} B={B} ctx={ctx} K={K}")
+
+    cfg = get_config(model)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+
+    page_size = 16
+    pages_per_seq = (ctx + 256 + page_size - 1) // page_size  # headroom to decode into
+    total_pages = B * pages_per_seq + 1
+    paged = init_paged_kv(cfg, total_pages, page_size, dtype=jnp.bfloat16)
+    pool_gb = 2 * np.prod(paged.k.shape) * 2 / 1e9
+    log(f"pool: {pool_gb:.2f} GB")
+
+    pt = np.zeros((B, pages_per_seq), np.int32)
+    for b in range(B):
+        pt[b] = np.arange(pages_per_seq, dtype=np.int32) + 1 + b * pages_per_seq
+    page_tables = jnp.asarray(pt)
+
+    def fresh_state():
+        return dict(
+            last_tokens=jnp.ones((B,), jnp.int32),
+            seq_lens=jnp.full((B,), ctx, jnp.int32),
+            active=jnp.ones((B,), bool),
+            caps=jnp.full((B,), ctx + 250, jnp.int32),
+            seeds=jnp.zeros((B, 2), jnp.uint32),
+            temperature=jnp.zeros((B,), jnp.float32),
+            top_p=jnp.ones((B,), jnp.float32),
+            top_k=jnp.zeros((B,), jnp.int32),
+        )
+
+    results = {"model": model, "batch": B, "ctx": ctx, "K": K,
+               "platform": dev.platform, "pool_gb": round(pool_gb, 2)}
+
+    def run_variant(name, steps, donate, kernel):
+        if kernel:
+            os.environ.pop("POLYKEY_DISABLE_PAGED_KERNEL", None)
+        else:
+            os.environ["POLYKEY_DISABLE_PAGED_KERNEL"] = "1"
+        jit_kw = dict(static_argnames=(
+            "cfg", "greedy", "steps", "eos_id", "candidates", "mesh"))
+        if donate:
+            jit_kw["donate_argnames"] = ("paged",)
+        fn = jax.jit(_decode_fn, **jit_kw)
+
+        def run(M, pool):
+            st = fresh_state()
+            seq = st.pop("seq_lens")
+            last = st.pop("last_tokens")
+            act = st.pop("active")
+            packed = None
+            t0 = time.monotonic()
+            for _ in range(M):
+                packed, last, seq, act, pool = fn(
+                    params, cfg, pool, last, seq, page_tables, act,
+                    st["caps"], st["seeds"], st["temperature"],
+                    st["top_p"], st["top_k"],
+                    greedy=True, steps=steps, eos_id=2, candidates=0,
+                    mesh=None,
+                )
+            np.asarray(packed)
+            return time.monotonic() - t0, pool
+
+        pool = paged
+        _, pool = run(1, pool)      # compile
+        w4, pool = run(4, pool)
+        w8, pool = run(8, pool)
+        per_block = (w8 - w4) / 4 * 1000
+        log(f"{name}: {per_block:.1f} ms/block -> {per_block/steps:.2f} ms/step "
+            f"(wall M4={w4*1000:.0f} M8={w8*1000:.0f})")
+        return round(per_block, 1), pool
+
+    results["block_kernel_ms"], paged = run_variant(
+        f"K={K} kernel donate", K, True, True)
+    results["block_gather_ms"], paged = run_variant(
+        f"K={K} gather donate", K, True, False)
+    results["block_k1_kernel_ms"], paged = run_variant(
+        "K=1 kernel donate", 1, True, True)
+    results["block_nodonate_ms"], paged = run_variant(
+        f"K={K} kernel NO-donate", K, False, True)
+
+    print(json.dumps(results), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+"""CPU reproduction of the engine occupancy equilibrium.
+
+Runs the bench's closed-loop load (in-flight = slot count) against the
+tiny CPU model with POLYKEY_LOOP_TRACE counters and prints the final
+occupancy stats: disp_lanes / blocks is the average live-lane count per
+dispatched block — the number that was 5/32 on TPU (r03 loop-trace).
+"""
+import os
+import sys
+import threading
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"   # the image pins axon; force CPU
+os.environ["POLYKEY_LOOP_TRACE"] = "1"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# The image pre-registers the axon plugin; the env var alone is not
+# enough (tests/conftest.py has the same workaround).
+jax.config.update("jax_platforms", "cpu")
+
+from polykey_tpu.engine.config import EngineConfig
+from polykey_tpu.engine.engine import GenRequest, InferenceEngine
+
+
+def main():
+    slots = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    n_req = int(sys.argv[2]) if len(sys.argv) > 2 else 32
+    max_new = int(sys.argv[3]) if len(sys.argv) > 3 else 32
+
+    cfg = EngineConfig(
+        model="tiny-llama",
+        dtype="float32",
+        max_decode_slots=slots,
+        page_size=16,
+        num_pages=1024,
+        max_seq_len=128,
+        prefill_buckets=(32,),
+        max_new_tokens_cap=max_new,
+        decode_block_steps=8,
+        lookahead_blocks=2,
+        compile_warmup=False,
+    )
+    engine = InferenceEngine(cfg)
+    try:
+        in_flight = threading.Semaphore(slots)
+        done = []
+        lock = threading.Lock()
+
+        def drain(r):
+            try:
+                while True:
+                    kind, v = r.out.get(timeout=300.0)
+                    if kind in ("done", "error"):
+                        with lock:
+                            done.append((kind, v))
+                        return
+            finally:
+                in_flight.release()
+
+        t0 = time.monotonic()
+        threads = []
+        for i in range(n_req):
+            in_flight.acquire()
+            r = GenRequest(prompt="x" * 20, max_new_tokens=max_new)
+            engine.submit(r)
+            th = threading.Thread(target=drain, args=(r,), daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=300.0)
+        dt = time.monotonic() - t0
+        acc = engine._trace_acc or {}
+        blocks = max(1, acc.get("blocks", 0))
+        print(f"requests={len(done)} wall={dt:.1f}s  blocks={blocks} "
+              f"avg_lanes={acc.get('disp_lanes', 0)/blocks:.2f}/{slots} "
+              f"avg_steps={acc.get('disp_steps', 0)/blocks:.1f} "
+              f"adm_ok={acc.get('adm_ok')} adm_empty={acc.get('adm_empty')} "
+              f"adm_noslot={acc.get('adm_noslot')} "
+              f"adm_alloc={acc.get('adm_alloc')}")
+    finally:
+        engine.shutdown()
+
+
+if __name__ == "__main__":
+    main()

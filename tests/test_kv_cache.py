@@ -137,3 +137,80 @@ def test_forward_paged_incremental_decode():
         np.asarray(hidden_ref[:, -1]), np.asarray(hidden[:, 0]),
         rtol=2e-4, atol=2e-4,
     )
+
+
+def _scatter_reference(k_pages, v_pages, k_new, v_new, page_tables, positions):
+    """The original per-token XLA scatter, kept as the oracle for the
+    faster write paths (page-granular cond path + Pallas DMA kernel)."""
+    ps = k_pages.shape[1]
+    bi = jnp.arange(page_tables.shape[0], dtype=jnp.int32)[:, None]
+    page_ids = page_tables[bi, positions // ps]
+    offsets = positions % ps
+    return (
+        k_pages.at[page_ids, offsets].set(k_new),
+        v_pages.at[page_ids, offsets].set(v_new),
+    )
+
+
+def _write_fixture(B, T, P, start, seed=0):
+    cfg = TINY_LLAMA
+    ps = 16
+    rng = np.random.default_rng(seed)
+    pools = init_paged_kv(cfg, num_pages=1 + B * P, page_size=ps)
+    kp = jnp.asarray(
+        rng.normal(size=pools.k[0].shape).astype(np.float32), jnp.bfloat16
+    )
+    vp = kp * 2
+    k_new = jnp.asarray(
+        rng.normal(size=(B, T, cfg.num_kv_heads, cfg.head_dim)), jnp.bfloat16
+    )
+    v_new = k_new + 1
+    pt = np.zeros((B, P), np.int32)
+    for b in range(B):
+        pt[b] = np.arange(P) + 1 + b * P
+    positions = start[:, None] + np.arange(T)[None, :]
+    return kp, vp, k_new, v_new, jnp.asarray(pt), jnp.asarray(positions, jnp.int32)
+
+
+def test_paged_write_aligned_prefill_matches_scatter():
+    """The page-granular cond path (aligned, consecutive rows — every
+    engine prefill chunk) must be byte-identical to the token scatter."""
+    B, T, P = 3, 32, 4
+    start = np.array([0, 16, 32])          # all page-aligned
+    kp, vp, kn, vn, pt, pos = _write_fixture(B, T, P, start)
+    got_k, got_v = paged_write(kp, vp, kn, vn, pt, pos)
+    want_k, want_v = _scatter_reference(kp, vp, kn, vn, pt, pos)
+    np.testing.assert_array_equal(np.asarray(got_k), np.asarray(want_k))
+    np.testing.assert_array_equal(np.asarray(got_v), np.asarray(want_v))
+
+
+def test_paged_write_unaligned_prefill_matches_scatter():
+    """Unaligned starts must fall back (runtime cond) to exact scatter."""
+    B, T, P = 3, 32, 4
+    start = np.array([0, 8, 17])           # rows 1, 2 unaligned
+    kp, vp, kn, vn, pt, pos = _write_fixture(B, T, P, start)
+    got_k, got_v = paged_write(kp, vp, kn, vn, pt, pos)
+    want_k, want_v = _scatter_reference(kp, vp, kn, vn, pt, pos)
+    np.testing.assert_array_equal(np.asarray(got_k), np.asarray(want_k))
+    np.testing.assert_array_equal(np.asarray(got_v), np.asarray(want_v))
+
+
+def test_paged_write_decode_kernel_interpret_matches_scatter():
+    """The Pallas DMA write kernel (interpret mode on CPU) must match the
+    scatter for a decode step, including the garbage-page-0 convention
+    (inactive lanes all target page 0 — any value may land there)."""
+    from polykey_tpu.ops.paged_write_kernel import paged_write_decode_kernel
+
+    B, P = 4, 3
+    start = np.array([5, 16, 31, 47])
+    kp, vp, kn, vn, pt, pos = _write_fixture(B, 1, P, start)
+    ps = kp.shape[1]
+    bi = jnp.arange(B, dtype=jnp.int32)[:, None]
+    page_ids = pt[bi, pos // ps][:, 0]
+    offsets = (pos % ps)[:, 0]
+    got_k, got_v = paged_write_decode_kernel(
+        kp, vp, kn, vn, page_ids, offsets, interpret=True
+    )
+    want_k, want_v = _scatter_reference(kp, vp, kn, vn, pt, pos)
+    np.testing.assert_array_equal(np.asarray(got_k), np.asarray(want_k))
+    np.testing.assert_array_equal(np.asarray(got_v), np.asarray(want_v))
