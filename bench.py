@@ -199,7 +199,7 @@ def fabricate_params(cfg, dtype, quantize: bool, bits: int = 8):
         key = f"{cfg.name}-{dtype}-{'q' + str(bits) if quantize else 'full'}"
         cache_dir = os.path.join(root, key)
         # Raw bytes + a JSON sidecar, not .npy: np.save round-trips the
-        # ml_dtypes extension dtypes (bfloat16, int4) as structured void
+        # ml_dtypes extension dtypes (bfloat16) as structured void
         # arrays, silently losing the dtype.
         meta_path = os.path.join(cache_dir, "META.json")
         if os.path.exists(meta_path):
@@ -229,13 +229,16 @@ def fabricate_params(cfg, dtype, quantize: bool, bits: int = 8):
     pool_f32 = (rng.standard_normal(1 << 20, np.float32) * 0.02)
     pool_bf16 = pool_f32.astype(ml_dtypes.bfloat16)
 
-    pool_i4 = rng.integers(-7, 8, 1 << 20).astype(ml_dtypes.int4)
+    # int4 leaves are nibble-packed uint8 (models/quant.py); random bytes
+    # are valid packed pairs (nibble 0x8 decodes to -8 — harmless for
+    # fabricated weights, throughput depends on shapes/dtypes only).
+    pool_u8 = rng.integers(0, 256, 1 << 20, dtype=np.uint8)
 
     def make(sd):
         if sd.dtype == np.int8:
             return np.resize(pool_i8, sd.shape)
-        if sd.dtype == ml_dtypes.int4:
-            return np.resize(pool_i4, sd.shape)
+        if sd.dtype == np.uint8:
+            return np.resize(pool_u8, sd.shape)
         if sd.dtype == np.float32:
             return np.resize(pool_f32, sd.shape)
         return np.resize(pool_bf16, sd.shape)
@@ -330,6 +333,10 @@ def bench_engine(
             return prompt_fn()
         return "".join(chr(c) for c in rng.integers(97, 123, prompt_len))
 
+    # Loop-trace counters are cheap and make occupancy visible in the
+    # artifact (avg live lanes per dispatched block — the number that
+    # caught the admission-policy bug).
+    os.environ.setdefault("POLYKEY_LOOP_TRACE", "1")
     engine = InferenceEngine(engine_cfg, params=params, draft_params=draft_params)
     try:
         # Shape compiles happen in __init__ (compile_warmup=True); this
@@ -390,9 +397,15 @@ def bench_engine(
         # Saturated closed loop: in-flight at 2x slots (done-delivery lags
         # the lookahead pipeline; a queue capped AT the slot count leaves
         # retiring slots empty for several blocks — measured 5/32 lanes).
+        # Snapshot the trace counters around JUST this loop so avg_lanes
+        # reflects the saturated run, not warmup/probe blocks.
+        acc0 = dict(getattr(engine, "_trace_acc", None) or {})
         timings, errors = [], []
         elapsed = run_closed_loop(
             n_requests, slots * 2, max_new, timings, errors)
+        acc1 = dict(getattr(engine, "_trace_acc", None) or {})
+        sat_blocks = acc1.get("blocks", 0) - acc0.get("blocks", 0)
+        sat_lanes = acc1.get("disp_lanes", 0) - acc0.get("disp_lanes", 0)
 
         if errors:
             raise RuntimeError(f"{len(errors)} requests failed: {errors[0]}")
@@ -417,6 +430,9 @@ def bench_engine(
             f"({len(probe_timings)} probe requests)")
 
         costs = _probe_step_costs(engine, max_new)
+        if sat_blocks > 0:
+            costs["avg_lanes"] = round(sat_lanes / sat_blocks, 2)
+            costs["blocks"] = sat_blocks
         log(f"step costs: {costs}")
         out = {
             "tok_s": round(tok_s, 1),
@@ -433,6 +449,131 @@ def bench_engine(
         return out
     finally:
         engine.shutdown()
+
+
+def _compose_line(result: dict) -> dict:
+    """Compose the single JSON line. Headline = the target-comparable
+    number when it exists (8B-class engine tok/s — the best valid of
+    int8/int4: both are "Llama-3-8B greedy decode on one chip";
+    quantization width is an implementation choice the target doesn't
+    constrain), else the phase-A number with vs_baseline null (ADVICE r1:
+    no apples-to-oranges ratio)."""
+    baseline = 2000.0  # BASELINE.md: tok/s/chip, 8B-class greedy on v5e
+
+    def valid(key):
+        d = result.get(key)
+        return d if isinstance(d, dict) and "tok_s" in d else None
+
+    candidates_8b = [
+        ("int8", valid("engine_8b_int8")), ("int4", valid("engine_8b_int4"))
+    ]
+    best = max(
+        (c for c in candidates_8b if c[1] is not None),
+        key=lambda c: c[1]["tok_s"], default=None,
+    )
+    if best is not None:
+        qname, phase_best = best
+        return {
+            "metric": f"llama3_8b_{qname}_engine_tok_s_per_chip",
+            "value": phase_best["tok_s"],
+            "unit": "tok/s",
+            "vs_baseline": round(phase_best["tok_s"] / baseline, 3),
+            "p50_ttft_ms": phase_best["p50_ttft_ms"],
+            "details": result,
+        }
+    if "tok_s" in result.get("engine_1b", {}):
+        a = result["engine_1b"]
+        return {
+            "metric": "{}_engine_tok_s_per_chip".format(a["model"]),
+            "value": a["tok_s"],
+            "unit": "tok/s",
+            "vs_baseline": None,
+            "p50_ttft_ms": a["p50_ttft_ms"],
+            "details": result,
+        }
+    return {
+        "metric": "bench_failed",
+        "value": 0.0,
+        "unit": "tok/s",
+        "vs_baseline": None,
+        "details": result,
+    }
+
+
+_PHASE_KEYS = (
+    ("0", "gateway_echo"),
+    ("A", "engine_1b"),
+    ("B", "engine_8b_int8"),
+    ("B2", "engine_8b_int4"),
+    ("A-tok", "engine_ttft_tokenized"),
+    ("A2", "prefix_cache"),
+    ("D", "engine_longctx"),
+    ("E", "engine_moe"),
+    ("C", "engine_spec"),
+    ("C2", "engine_gemma_spec"),
+)
+
+
+def _run_isolated(result: dict, headline_only: bool) -> None:
+    """Run each phase in its own subprocess (POLYKEY_BENCH_PHASES=<name>)
+    and merge their details into one artifact. A wedged backend client
+    (the r03 failure: one UNIMPLEMENTED dispatch poisoned the in-process
+    runtime and every later phase died with it), a crash, or a hang then
+    costs only its own phase. Children share the fabricated-tree disk
+    cache and the persistent XLA compile cache, so per-child setup is
+    mmap + cache hits; child stderr streams through live."""
+    phases = [p for p, _ in _PHASE_KEYS]
+    if headline_only:
+        phases = ["0", "B"]
+    keys = dict(_PHASE_KEYS)
+    timeout = float(os.environ.get("POLYKEY_BENCH_PHASE_TIMEOUT", "2400"))
+    for ph in phases:
+        key = keys[ph]
+        env = dict(os.environ)
+        env["POLYKEY_BENCH_PHASES"] = ph
+        env["POLYKEY_BENCH_ISOLATE"] = "0"
+        # Bound each child's backend probe: the parent already proved the
+        # platform once; a mid-run tunnel flap should cost minutes, not
+        # 3x180 s per remaining phase.
+        env.setdefault("POLYKEY_BENCH_PROBE_TRIES", "2")
+        env.setdefault("POLYKEY_BENCH_PROBE_TIMEOUT", "120")
+        t0 = time.monotonic()
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, stdout=subprocess.PIPE, timeout=timeout,
+            )
+            lines = proc.stdout.decode(errors="replace").strip().splitlines()
+            child = json.loads(lines[-1]) if lines else {}
+            det = child.get("details", {})
+            if key in det:
+                entry = det[key]
+                if (isinstance(entry, dict)
+                        and det.get("platform") != result.get("platform")):
+                    # A flap mid-run can demote one child to the CPU
+                    # fallback — mark it so the artifact stays honest.
+                    entry.setdefault("platform", det.get("platform"))
+                result[key] = entry
+            elif proc.returncode != 0:
+                result[key] = {
+                    "error": f"phase subprocess rc={proc.returncode}"}
+            elif result.get("platform") == "tpu":
+                # TPU-only phase produced nothing: the child was demoted
+                # to the CPU fallback by a mid-run flap (its rc is 0, its
+                # details just lack the key). Record WHY the entry is
+                # absent instead of silently dropping the phase.
+                result[key] = {
+                    "error": "phase produced no entry (child platform="
+                             f"{det.get('platform', '?')} — tunnel flap?)"}
+            if "kernels_disabled" in det:
+                result["kernels_disabled"] = det["kernels_disabled"]
+        except subprocess.TimeoutExpired:
+            result[key] = {
+                "error": f"phase subprocess timed out after {timeout:.0f}s"}
+        except Exception as e:
+            result[key] = {"error": f"phase subprocess failed: {e}"}
+        log(f"[isolate] phase {ph} finished in {time.monotonic() - t0:.0f}s")
+    print(json.dumps(_compose_line(result)), flush=True)
 
 
 def main() -> None:
@@ -462,8 +603,29 @@ def main() -> None:
     # Rescue mode for short tunnel bursts: only the phases the headline
     # needs. CPU fallback ignores it for phase A (sole evidence there).
     headline_only = os.environ.get("POLYKEY_BENCH_HEADLINE_ONLY", "") == "1"
+
+    # Phase selection (POLYKEY_BENCH_PHASES="B,B2") + subprocess isolation
+    # (POLYKEY_BENCH_ISOLATE, default on for TPU): the r03 run lost every
+    # phase after B2 to one wedged backend client (an UNIMPLEMENTED error
+    # poisoned the in-process runtime) — isolation caps the blast radius
+    # of a wedge, crash, or hang at its own phase.
+    sel_env = os.environ.get("POLYKEY_BENCH_PHASES", "").strip()
+    selected = (
+        {p.strip() for p in sel_env.split(",") if p.strip()}
+        if sel_env else None
+    )
+
+    def phase_on(name: str) -> bool:
+        return selected is None or name in selected
+
+    if (selected is None and os.environ.get(
+            "POLYKEY_BENCH_ISOLATE", "1" if on_tpu else "0") == "1"):
+        _run_isolated(result, headline_only)
+        return
+    # 128 requests ≈ 16k tokens: enough steady-state that ramp/tail don't
+    # dominate a 32-slot run (64 was ~16 full-occupancy blocks total).
     n_req = int(os.environ.get(
-        "POLYKEY_BENCH_REQUESTS", "64" if on_tpu else "6"))
+        "POLYKEY_BENCH_REQUESTS", "128" if on_tpu else "6"))
     prompt_len = int(os.environ.get("POLYKEY_BENCH_PROMPT", "128"))
     max_new = int(os.environ.get(
         "POLYKEY_BENCH_NEW_TOKENS", "128" if on_tpu else "16"))
@@ -478,6 +640,8 @@ def main() -> None:
     # example_tool over real gRPC against the mock service; pure CPU, so
     # it lands even when the TPU is unreachable). ---
     try:
+        if not phase_on("0"):
+            raise _PhaseSkipped()
         import io
 
         import grpc
@@ -514,6 +678,8 @@ def main() -> None:
                 log(f"phase 0 gateway echo: {result['gateway_echo']}")
         finally:
             srv.stop(0)
+    except _PhaseSkipped:
+        pass
     except Exception as e:
         log(f"phase 0 failed: {e}")
         result["gateway_echo"] = {"error": str(e)}
@@ -537,6 +703,8 @@ def main() -> None:
         warm_sampled_variants=False,
     )
     try:
+        if not phase_on("A"):
+            raise _PhaseSkipped()
         if headline_only and on_tpu:
             result["engine_1b"] = {"model": model_a,
                                    "skipped": "headline-only rescue mode"}
@@ -548,14 +716,15 @@ def main() -> None:
                 cfg_a, None, n_req, prompt_len if on_tpu else 24, max_new))
         result["engine_1b"] = {"model": model_a, **phase_a}
     except _PhaseSkipped:
-        log("phase A skipped (POLYKEY_BENCH_HEADLINE_ONLY=1)")
+        log("phase A skipped")
     except Exception as e:
         log(f"phase A failed: {e}")
         result["engine_1b"] = {"model": model_a, "error": str(e)}
 
     # --- Phase B: 8B-int8 — the config the 2,000 tok/s target names. ---
     phase_b = None
-    if on_tpu and os.environ.get("POLYKEY_BENCH_SKIP_8B", "") != "1":
+    if (on_tpu and phase_on("B")
+            and os.environ.get("POLYKEY_BENCH_SKIP_8B", "") != "1"):
         try:
             log("--- phase B: engine bench, llama-3-8b int8 ---")
             from polykey_tpu.models.config import get_config
@@ -605,7 +774,7 @@ def main() -> None:
     # same greedy workload — a valid 8B target number; the headline takes
     # the better of B/B2. ---
     phase_b2 = None
-    if (on_tpu
+    if (on_tpu and phase_on("B2")
             and not headline_only
             and os.environ.get("POLYKEY_BENCH_SKIP_8B", "") != "1"
             and os.environ.get("POLYKEY_BENCH_SKIP_8B_INT4", "") != "1"):
@@ -662,7 +831,9 @@ def main() -> None:
         os.path.dirname(os.path.abspath(__file__)),
         "assets", "bench_tokenizer",
     )
-    if headline_only and on_tpu:
+    if not phase_on("A-tok"):
+        pass
+    elif headline_only and on_tpu:
         result["engine_ttft_tokenized"] = {
             "skipped": "headline-only rescue mode"}
     elif not os.path.exists(os.path.join(tok_dir, "tokenizer.json")):
@@ -716,6 +887,8 @@ def main() -> None:
     # prefill only their suffix; p50 TTFT of the cached requests is the
     # feature's measurable win. ---
     try:
+        if not phase_on("A2"):
+            raise _PhaseSkipped()
         if headline_only and on_tpu:
             result["prefix_cache"] = {"skipped": "headline-only rescue mode"}
             raise _PhaseSkipped()
@@ -759,7 +932,7 @@ def main() -> None:
         finally:
             engine2.shutdown()
     except _PhaseSkipped:
-        log("phase A2 skipped (POLYKEY_BENCH_HEADLINE_ONLY=1)")
+        log("phase A2 skipped")
     except Exception as e:
         log(f"phase A2 failed: {e}")
         result["prefix_cache"] = {"error": str(e)}
@@ -767,7 +940,7 @@ def main() -> None:
     # --- Phase D: long-context serving — 2k-token prompts decoding at 4k
     # positions through chunked prefill + the paged kernel's grouped page
     # streaming (SURVEY §5 long-context; engine defaults are 4k). ---
-    if (on_tpu and not headline_only
+    if (on_tpu and not headline_only and phase_on("D")
             and os.environ.get("POLYKEY_BENCH_SKIP_LONGCTX", "") != "1"):
         try:
             log("--- phase D: long-context engine bench (2k prompt / 4k positions) ---")
@@ -801,7 +974,7 @@ def main() -> None:
     # pays the full expert-weight HBM read like the real model does.
     # ep>1 (the all-to-all) is covered by the virtual-mesh dryrun; one
     # chip exercises routing + grouped expert matmuls under Mosaic. ---
-    if (on_tpu and not headline_only
+    if (on_tpu and not headline_only and phase_on("E")
             and os.environ.get("POLYKEY_BENCH_SKIP_MOE", "") != "1"):
         try:
             log("--- phase E: mixtral-bench int8 MoE engine bench ---")
@@ -846,7 +1019,7 @@ def main() -> None:
     # steps + one wide verify, pipelined like plain blocks. A real draft's
     # gain interpolates between this and the plain-engine number by its
     # acceptance rate. ---
-    if (on_tpu and not headline_only
+    if (on_tpu and not headline_only and phase_on("C")
             and os.environ.get("POLYKEY_BENCH_SKIP_SPEC", "") != "1"):
         try:
             log("--- phase C: spec-decode engine bench (draft == target) ---")
@@ -885,7 +1058,7 @@ def main() -> None:
     # weights mean acceptance is noise, so the adaptive-gamma dial is
     # left ON and its collapse to the low rung is itself the evidence;
     # throughput here is a floor, not the spec win. ---
-    if (on_tpu and not headline_only
+    if (on_tpu and not headline_only and phase_on("C2")
             and os.environ.get("POLYKEY_BENCH_SKIP_GEMMA_SPEC", "") != "1"):
         try:
             log("--- phase C2: gemma-2-9b int8 + gemma-2-2b draft ---")
@@ -926,49 +1099,7 @@ def main() -> None:
             log(f"phase C2 failed: {e}")
             result["engine_gemma_spec"] = {"error": str(e)}
 
-    # --- Compose the single line. Headline = the target-comparable number
-    # when it exists (8B-class engine tok/s), else the phase-A number with
-    # vs_baseline null (ADVICE r1: no apples-to-oranges ratio). ---
-    baseline = 2000.0  # BASELINE.md: tok/s/chip, 8B-class greedy on v5e
-    # Headline: the best valid 8B greedy number (int8 vs int4 — both are
-    # "Llama-3-8B greedy decode on one chip"; quantization width is an
-    # implementation choice the target doesn't constrain).
-    candidates_8b = [
-        ("int8", phase_b), ("int4", phase_b2)
-    ]
-    best = max(
-        (c for c in candidates_8b if c[1] is not None and "tok_s" in c[1]),
-        key=lambda c: c[1]["tok_s"], default=None,
-    )
-    if best is not None:
-        qname, phase_best = best
-        line = {
-            "metric": f"llama3_8b_{qname}_engine_tok_s_per_chip",
-            "value": phase_best["tok_s"],
-            "unit": "tok/s",
-            "vs_baseline": round(phase_best["tok_s"] / baseline, 3),
-            "p50_ttft_ms": phase_best["p50_ttft_ms"],
-            "details": result,
-        }
-    elif "tok_s" in result.get("engine_1b", {}):
-        a = result["engine_1b"]
-        line = {
-            "metric": f"{a['model']}_engine_tok_s_per_chip",
-            "value": a["tok_s"],
-            "unit": "tok/s",
-            "vs_baseline": None,
-            "p50_ttft_ms": a["p50_ttft_ms"],
-            "details": result,
-        }
-    else:
-        line = {
-            "metric": "bench_failed",
-            "value": 0.0,
-            "unit": "tok/s",
-            "vs_baseline": None,
-            "details": result,
-        }
-    print(json.dumps(line), flush=True)
+    print(json.dumps(_compose_line(result)), flush=True)
 
 
 if __name__ == "__main__":

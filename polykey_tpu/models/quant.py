@@ -11,12 +11,15 @@ int4 (POLYKEY_QUANTIZE=int4) halves weight traffic again — the lever for
 beating, not just meeting, the weight-bandwidth-bound throughput target.
 Because 4-bit symmetric ([-7, 7]) is too coarse for a whole contraction
 axis, int4 uses GROUP-WISE scales (group_size along the contraction axis,
-AWQ/GPTQ granularity): q is jnp.int4 (XLA packs 2/byte in HBM),
-s is [..., in/g, out], and dequantization happens on the weight side
-(`x @ (q·s)`), an elementwise producer XLA fuses into the dot's operand
-load. The embedding and lm_head stay int8: the embedding is a sparse
-gather (bandwidth-irrelevant, and int4 gathers lower poorly), and the
-unembed keeps its exact narrow-operand fp32-accumulate path.
+AWQ/GPTQ granularity): q stores two nibbles per uint8 byte, packed in
+PAIRS ALONG THE CONTRACTION AXIS ([..., in/2, out] — NOT jnp.int4, which
+the axon remote backend rejects at dispatch and which gains nothing: the
+manual unpack (mask/shift/sign-extend) is elementwise and fuses into the
+dot's operand load exactly like an s4→bf16 cast would). s is
+[..., in/g, out], and dequantization happens on the weight side
+(`x @ (q·s)`). The embedding and lm_head stay int8: the embedding is a
+sparse gather (bandwidth-irrelevant), and the unembed keeps its exact
+narrow-operand fp32-accumulate path.
 
 Representation: a `QuantizedTensor` pytree leaf-pair (int values + fp32
 scales) that flows through jit/sharding like any array pair. The matmul
@@ -45,7 +48,9 @@ from .config import ModelConfig
 class QuantizedTensor:
     """Int8/int4 weights with fp32 scales.
 
-    q: int8 [..., in, out] (bits=8) or int4 (bits=4), weight shape.
+    q: bits=8: int8 [..., in, out] (weight shape); bits=4: uint8
+       [..., in/2, out] — nibble pairs packed along the contraction axis
+       (row 2i in the low nibble, row 2i+1 in the high nibble).
     s: fp32 scales —
        bits=8: [..., out], per-output-channel over the contraction axis;
        bits=4: [..., in/group, out], group-wise along the contraction
@@ -63,6 +68,10 @@ class QuantizedTensor:
 
     @property
     def shape(self):
+        if self.bits == 4:
+            # Logical weight shape — the packed contraction axis unfolds.
+            return (*self.q.shape[:-2], self.q.shape[-2] * 2,
+                    self.q.shape[-1])
         return self.q.shape
 
     @property
@@ -88,6 +97,10 @@ def quantize(
     if bits != 4:
         raise ValueError(f"bits must be 4 or 8, got {bits}")
     cin = w.shape[-2]
+    if cin % 2:
+        raise ValueError(
+            f"int4 needs an even contraction axis to nibble-pack, got {cin}"
+        )
     g = group_size if cin % group_size == 0 else cin
     wf = w.astype(jnp.float32)
     grouped = wf.reshape(*w.shape[:-2], cin // g, g, w.shape[-1])
@@ -95,9 +108,16 @@ def quantize(
     scale = jnp.maximum(absmax, 1e-8) / 7.0
     q = jnp.clip(
         jnp.round(grouped / scale[..., None, :]), -7, 7
-    ).reshape(w.shape).astype(jnp.int4)
+    ).reshape(w.shape).astype(jnp.int8)
+    # Nibble-pack contraction-axis pairs: row 2i → low, row 2i+1 → high
+    # (two's-complement nibbles via the uint8 wrap).
+    pairs = q.reshape(*w.shape[:-2], cin // 2, 2, w.shape[-1])
+    packed = (
+        (pairs[..., 0, :].astype(jnp.uint8) & 0xF)
+        | ((pairs[..., 1, :].astype(jnp.uint8) & 0xF) << 4)
+    )
     return QuantizedTensor(
-        q=q, s=scale, act_dtype=jnp.dtype(w.dtype), bits=4
+        q=packed, s=scale, act_dtype=jnp.dtype(w.dtype), bits=4
     )
 
 
@@ -114,12 +134,21 @@ WeightLike = Union[jax.Array, QuantizedTensor]
 
 def _deq_weight(w: QuantizedTensor, dtype) -> jax.Array:
     """Weight-side group-wise dequantization in the activation dtype — an
-    elementwise producer XLA fuses into the consuming dot's operand load,
-    so HBM traffic stays int4 values + small scales."""
+    elementwise producer (unpack + scale) XLA fuses into the consuming
+    dot's operand load, so HBM traffic stays packed nibbles + small
+    scales."""
+    p = w.q                                       # [..., in/2, out] uint8
+    low = (p & 0xF).astype(jnp.int8)
+    high = (p >> 4).astype(jnp.int8)
+    low = jnp.where(low > 7, low - 16, low)       # sign-extend the nibble
+    high = jnp.where(high > 7, high - 16, high)
+    q = jnp.stack([low, high], axis=-2)           # [..., in/2, 2, out]
+    shape = w.shape                               # logical [..., in, out]
+    q = q.reshape(shape)
     G = w.s.shape[-2]
-    cin, cout = w.q.shape[-2], w.q.shape[-1]
-    grouped = w.q.reshape(*w.q.shape[:-2], G, cin // G, cout).astype(dtype)
-    return (grouped * w.s[..., None, :].astype(dtype)).reshape(w.q.shape)
+    cin, cout = shape[-2], shape[-1]
+    grouped = q.reshape(*shape[:-2], G, cin // G, cout).astype(dtype)
+    return (grouped * w.s[..., None, :].astype(dtype)).reshape(shape)
 
 
 def qdot(x: jax.Array, w: WeightLike) -> jax.Array:
@@ -236,14 +265,8 @@ def quantize_params(params: dict, cfg: ModelConfig, bits: int = 8) -> dict:
 
 def params_bytes(params) -> int:
     """Total parameter storage in bytes (quantized trees count q + s).
-
-    int4 counts 0.5 byte/element: XLA packs s4 two-per-byte in device
-    HBM (the number that matters for the bandwidth bound), even though
-    the host-side numpy representation is byte-per-element."""
-    total = 0
-    for leaf in jax.tree.leaves(params):
-        if leaf.dtype == jnp.int4:
-            total += (leaf.size + 1) // 2
-        else:
-            total += leaf.size * leaf.dtype.itemsize
-    return total
+    int4 leaves are packed uint8 (two nibbles per byte), so plain
+    size x itemsize is already the HBM truth."""
+    return sum(
+        leaf.size * leaf.dtype.itemsize for leaf in jax.tree.leaves(params)
+    )

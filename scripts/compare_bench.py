@@ -111,10 +111,13 @@ def main() -> int:
         if isinstance(ttft, (int, float)):
             # Light-load probe when the artifact carries the split keys
             # (post-r03), saturated closed-loop median before that.
-            era = ("light-load"
-                   if "saturated_ttft_ms" in nd.get("engine_8b_int8", {})
-                   or "saturated_ttft_ms" in nd.get("engine_8b_int4", {})
-                   else "pre-split/saturated")
+            # Post-split artifacts carry saturated_ttft_ms in whichever
+            # engine phase the headline came from (8B or the 1B
+            # fallback); any phase having it marks the new schema.
+            era = ("light-load" if any(
+                isinstance(d, dict) and "saturated_ttft_ms" in d
+                for d in nd.values()
+            ) else "pre-split/saturated")
             verdict = "MET" if ttft < TARGET_TTFT_MS else "missed"
             print(f"  TTFT target <{TARGET_TTFT_MS:.0f}ms: {ttft:.1f}ms "
                   f"({era}) -> {verdict}")

@@ -21,6 +21,20 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _slope_ms(run_ms, n: int = 20) -> float:
+    """Per-call ms via the two-length slope: (wall_2n - wall_n) / n.
+
+    block_until_ready is a NO-OP on the axon backend (PERF.md), so
+    `run_ms(m)` must execute m calls and sync via a small
+    materialization (np.asarray of a scalar slice); the slope cancels
+    the tunnel's constant dispatch+sync overhead, which is both large
+    and variable here."""
+    run_ms(2)                       # warm (compile already done by caller)
+    w1 = run_ms(n)
+    w2 = run_ms(2 * n)
+    return (w2 - w1) / n * 1e3
+
+
 def _paged_inputs(B, Hq, Hk, D, ps, P, dtype, seed=0):
     """Disjoint per-row page tables; row b's context grows with b up to
     the full P·ps window so partial last groups and full tables both
@@ -106,12 +120,14 @@ def check_paged_decode() -> None:
                 ("gather", lambda: paged_attention(
                     q, kp, vp, pts, positions, scale=0.125)),
             ]:
-                fn()[0].block_until_ready()
-                t0 = time.monotonic()
-                for _ in range(20):
-                    out = fn()
-                out.block_until_ready()
-                timed[name] = (time.monotonic() - t0) / 20 * 1e3
+                def run(m, fn=fn):
+                    t0 = time.monotonic()
+                    out = None
+                    for _ in range(m):
+                        out = fn()
+                    np.asarray(jnp.sum(out[0, 0, 0]))
+                    return time.monotonic() - t0
+                timed[name] = _slope_ms(run)
             print(f"{label} per-call: kernel {timed['kernel']:.2f} ms, "
                   f"gather {timed['gather']:.2f} ms "
                   f"({timed['gather'] / max(timed['kernel'], 1e-9):.2f}x)")
@@ -120,6 +136,91 @@ def check_paged_decode() -> None:
             failures.append(f"paged {label}: {e}")
         finally:
             del q, kp, vp  # free the case's pools before the next one
+    if failures:
+        raise AssertionError("; ".join(failures))
+
+
+def check_paged_write() -> None:
+    """The DMA write kernel (ops/paged_write_kernel.py) at every serving
+    head geometry: compiled-vs-scatter equality + a timed slope vs the
+    XLA scatter it replaces (the ~10 ms/step r03 bottleneck)."""
+    from polykey_tpu.ops.paged_write_kernel import paged_write_decode_kernel
+
+    cases = [
+        # (label, B, Hk, D) — ps=16, P=32 throughout
+        ("8b", 32, 8, 128),
+        ("gemma27b", 16, 16, 128),
+        ("gemma9b", 16, 8, 256),
+        ("1b-d64", 32, 8, 64),
+    ]
+    ps, P = 16, 32
+    failures: list[str] = []
+    for label, B, Hk, D in cases:
+        try:
+            N = B * P + 1
+            key = jax.random.PRNGKey(7)
+            k1, k2, k3 = jax.random.split(key, 3)
+            kp = jax.random.normal(k1, (N, ps, Hk, D), jnp.bfloat16)
+            vp = kp * 0.5
+            kn = jax.random.normal(k2, (B, 1, Hk, D), jnp.bfloat16)
+            vn = kn + 1
+            rng = np.random.default_rng(3)
+            # Distinct pages per lane (allocator invariant), arbitrary
+            # in-page offsets.
+            page_ids = jnp.asarray(
+                rng.permutation(N - 1)[:B].astype(np.int32) + 1)
+            offsets = jnp.asarray(
+                rng.integers(0, ps, B).astype(np.int32))
+
+            t0 = time.monotonic()
+            got_k, got_v = paged_write_decode_kernel(
+                kp, vp, kn, vn, page_ids, offsets)
+            want_k = kp.at[page_ids, offsets].set(kn[:, 0])
+            want_v = vp.at[page_ids, offsets].set(vn[:, 0])
+            ok = bool(
+                jnp.array_equal(got_k, want_k)
+                & jnp.array_equal(got_v, want_v)
+            )
+            print(f"write {label} B={B} Hk={Hk} D={D}: "
+                  f"{'equal' if ok else 'MISMATCH'} "
+                  f"({time.monotonic() - t0:.1f}s inc. compile)")
+            assert ok, f"write kernel mismatch ({label})"
+
+            # Timed: M chained in-place writes inside one jit (pool in
+            # the scan carry -> donation aliasing), slope of two lengths.
+            def timed_writes(write_step):
+                loops = {}
+
+                def run(m):
+                    if m not in loops:
+                        @jax.jit
+                        def f(kp0, vp0, m=m):
+                            def body(c, x):
+                                return write_step(c, x), None
+                            (kpc, vpc), _ = jax.lax.scan(
+                                body, (kp0, vp0),
+                                jnp.arange(m, dtype=jnp.bfloat16))
+                            return kpc[0, 0, 0, 0]
+                        np.asarray(f(kp, vp))        # compile
+                        loops[m] = f
+                    t0 = time.monotonic()
+                    np.asarray(loops[m](kp, vp))
+                    return time.monotonic() - t0
+
+                return _slope_ms(run)
+
+            per = timed_writes(lambda c, x: paged_write_decode_kernel(
+                c[0], c[1], kn + x, vn, page_ids, offsets))
+            scatter_per = timed_writes(lambda c, x: (
+                c[0].at[page_ids, offsets].set(kn[:, 0] + x),
+                c[1].at[page_ids, offsets].set(vn[:, 0]),
+            ))
+            print(f"write {label} per-call: kernel {per:.3f} ms, "
+                  f"scatter {scatter_per:.3f} ms "
+                  f"({scatter_per / max(per, 1e-9):.1f}x)")
+        except Exception as e:
+            print(f"write {label} FAILED: {type(e).__name__}: {e}")
+            failures.append(f"write {label}: {e}")
     if failures:
         raise AssertionError("; ".join(failures))
 
@@ -180,7 +281,7 @@ def main() -> int:
         return 1
     print(f"device: {d.device_kind}")
     errs = []
-    for check in (check_paged_decode, check_flash):
+    for check in (check_paged_decode, check_paged_write, check_flash):
         try:
             check()
         except Exception as e:       # keep the other family's evidence

@@ -1,0 +1,10 @@
+#!/bin/bash
+# Lightweight tunnel liveness log (one line/min) for manual bench driving.
+while true; do
+  if timeout 45 python -c "import jax,numpy as np,jax.numpy as jnp; jax.devices(); np.asarray(jnp.ones((4,)).sum())" >/dev/null 2>&1; then
+    echo "$(date -u +%H:%M:%S) UP" >> /root/repo/perf/tunnel_status.log
+  else
+    echo "$(date -u +%H:%M:%S) down" >> /root/repo/perf/tunnel_status.log
+  fi
+  sleep 60
+done

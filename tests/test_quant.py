@@ -96,7 +96,10 @@ def test_int4_roundtrip_error_bound():
     """Group-wise symmetric int4: per-entry error <= group scale / 2."""
     w = jax.random.normal(jax.random.PRNGKey(3), (256, 32), jnp.float32)
     qt = quantize(w, bits=4, group_size=128)
-    assert qt.q.dtype == jnp.int4 and qt.bits == 4
+    # Packed storage: uint8 nibble pairs along the contraction axis
+    # (jnp.int4 is rejected by the axon remote backend; quant.py).
+    assert qt.q.dtype == jnp.uint8 and qt.bits == 4
+    assert qt.q.shape == (128, 32) and qt.shape == (256, 32)
     assert qt.s.shape == (2, 32)
     back = dequantize(qt, jnp.float32)
     grouped = w.reshape(2, 128, 32)
@@ -128,8 +131,10 @@ def test_int4_tree_quarters_block_storage():
     params = init_params(jax.random.PRNGKey(0), cfg, jnp.bfloat16)
     q8 = quantize_params(params, cfg)
     q4 = quantize_params(params, cfg, bits=4)
-    assert q4["layers"]["attn"]["wq"].q.dtype == jnp.int4
-    assert q4["layers"]["mlp"]["down"].q.dtype == jnp.int4
+    assert q4["layers"]["attn"]["wq"].q.dtype == jnp.uint8
+    assert q4["layers"]["attn"]["wq"].bits == 4
+    assert q4["layers"]["mlp"]["down"].q.dtype == jnp.uint8
+    assert q4["layers"]["mlp"]["down"].bits == 4
     assert q4["embed"].q.dtype == jnp.int8
     if "lm_head" in q4:
         assert q4["lm_head"].q.dtype == jnp.int8
