@@ -526,9 +526,21 @@ def _run_isolated(result: dict, headline_only: bool) -> None:
     if headline_only:
         phases = ["0", "B"]
     keys = dict(_PHASE_KEYS)
+    # Operator skips (the child would honor these and produce no entry,
+    # which the no-entry branch below would misread as a tunnel flap):
+    # record the skip here and don't pay the child launch at all.
+    skip_envs = {"B": "POLYKEY_BENCH_SKIP_8B",
+                 "B2": "POLYKEY_BENCH_SKIP_8B_INT4",
+                 "D": "POLYKEY_BENCH_SKIP_LONGCTX",
+                 "E": "POLYKEY_BENCH_SKIP_MOE",
+                 "C": "POLYKEY_BENCH_SKIP_SPEC",
+                 "C2": "POLYKEY_BENCH_SKIP_GEMMA_SPEC"}
     timeout = float(os.environ.get("POLYKEY_BENCH_PHASE_TIMEOUT", "2400"))
     for ph in phases:
         key = keys[ph]
+        if os.environ.get(skip_envs.get(ph, ""), "") == "1":
+            result[key] = {"skipped": f"{skip_envs[ph]}=1"}
+            continue
         env = dict(os.environ)
         env["POLYKEY_BENCH_PHASES"] = ph
         env["POLYKEY_BENCH_ISOLATE"] = "0"

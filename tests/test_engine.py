@@ -789,3 +789,68 @@ def test_prequantized_moe_engine_serves():
     got = serve(cfg, pre)
     want = serve(dataclasses.replace(cfg, quantize=True), fp)
     assert got == want and len(got) == 8
+
+
+def test_admission_keeps_slots_occupied():
+    """Occupancy regression gate for the admission policy: under a
+    saturated closed loop (client queue deeper than the slot count) the
+    average live-lane count per dispatched block must approach the slot
+    count. The old one-admission-per-iteration policy equilibrated at
+    ~max_new/decode_block_steps lanes (measured 5/32 on hardware —
+    PERF.md r03); this pins the fix."""
+    import threading
+
+    cfg = EngineConfig(
+        model="tiny-llama",
+        tokenizer="byte",
+        dtype="float32",
+        max_decode_slots=8,
+        page_size=8,
+        num_pages=512,
+        max_seq_len=128,
+        prefill_buckets=(32,),
+        max_new_tokens_cap=64,
+        decode_block_steps=8,
+        lookahead_blocks=2,
+    )
+    import os as _os
+
+    _os.environ["POLYKEY_LOOP_TRACE"] = "1"
+    try:
+        engine = InferenceEngine(cfg)
+    finally:
+        _os.environ.pop("POLYKEY_LOOP_TRACE", None)
+    try:
+        sem = threading.Semaphore(cfg.max_decode_slots * 2)
+        done = threading.Semaphore(0)
+
+        def drain(r):
+            try:
+                while r.out.get(timeout=120.0)[0] == "token":
+                    pass
+            finally:
+                sem.release()
+                done.release()
+
+        n_req = 48
+        for _ in range(n_req):
+            sem.acquire()
+            r = GenRequest(prompt="occupancy", max_new_tokens=64)
+            engine.submit(r)
+            threading.Thread(target=drain, args=(r,), daemon=True).start()
+        for _ in range(n_req):
+            assert done.acquire(timeout=120.0)
+
+        acc = engine._trace_acc or {}
+        blocks = acc.get("blocks", 0)
+        assert blocks > 0
+        avg_lanes = acc.get("disp_lanes", 0) / blocks
+        # Ramp/tail blocks drag the average below the slot count; 60% is
+        # comfortably above the broken policy's ~max_new/K = 8... which
+        # equals the slot count here, so ALSO bound total blocks: the
+        # broken policy needs ~n_req extra admission-starved blocks.
+        assert avg_lanes >= cfg.max_decode_slots * 0.6, avg_lanes
+        ideal = n_req * 64 / cfg.max_decode_slots / cfg.decode_block_steps
+        assert blocks <= ideal * 2.5, (blocks, ideal)
+    finally:
+        engine.shutdown()

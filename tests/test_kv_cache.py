@@ -214,3 +214,36 @@ def test_paged_write_decode_kernel_interpret_matches_scatter():
     want_k, want_v = _scatter_reference(kp, vp, kn, vn, pt, pos)
     np.testing.assert_array_equal(np.asarray(got_k), np.asarray(want_k))
     np.testing.assert_array_equal(np.asarray(got_v), np.asarray(want_v))
+
+
+def test_paged_write_mesh_kernel_path_matches_scatter(monkeypatch):
+    """The shard_map dispatch of the write kernel (dp all-gather of lane
+    rows + tp head sharding) against the scatter oracle, on the virtual
+    CPU mesh in interpret mode. On hardware this is the path every
+    dp/tp-meshed decode step takes; nothing else exercises its
+    collective wiring pre-hardware."""
+    from functools import partial
+
+    import polykey_tpu.ops.paged_attention as pa
+    from polykey_tpu.ops import paged_write_kernel as pwk
+    from polykey_tpu.parallel.mesh import MeshConfig, create_mesh
+
+    mesh = create_mesh(MeshConfig(tp=2, dp=2, sp=2))
+    B, P = 4, 3
+    start = np.array([5, 16, 31, 40])
+    kp, vp, kn, vn, pt, pos = _write_fixture(B, 1, P, start)
+    ps = kp.shape[1]
+    bi = jnp.arange(B, dtype=jnp.int32)[:, None]
+    page_ids = pt[bi, pos // ps][:, 0]
+    offsets = (pos % ps)[:, 0]
+
+    monkeypatch.setattr(
+        pwk, "paged_write_decode_kernel",
+        partial(pwk.paged_write_decode_kernel, interpret=True),
+    )
+    got_k, got_v = pa._write_decode_kernel(
+        kp, vp, kn, vn, page_ids, offsets, mesh
+    )
+    want_k, want_v = _scatter_reference(kp, vp, kn, vn, pt, pos)
+    np.testing.assert_array_equal(np.asarray(got_k), np.asarray(want_k))
+    np.testing.assert_array_equal(np.asarray(got_v), np.asarray(want_v))
