@@ -46,7 +46,8 @@ POLYKEY_BENCH_SKIP_SPEC=1, POLYKEY_BENCH_SKIP_LONGCTX=1,
 POLYKEY_BENCH_SKIP_MOE=1, POLYKEY_BENCH_MOE_SLOTS,
 POLYKEY_BENCH_SKIP_GEMMA_SPEC=1, POLYKEY_BENCH_GEMMA_SLOTS,
 POLYKEY_BENCH_SKIP_8B_INT4=1, POLYKEY_BENCH_8B_INT4_SLOTS,
-POLYKEY_BENCH_TOKENIZER, POLYKEY_BENCH_PROBE_TRIES,
+POLYKEY_BENCH_KV_DTYPE (int8 → quantized KV pools for phases B/B2/D —
+the slot-count lever), POLYKEY_BENCH_TOKENIZER, POLYKEY_BENCH_PROBE_TRIES,
 POLYKEY_BENCH_PROBE_TIMEOUT, POLYKEY_BENCH_TREE_CACHE=0 (disable the
 fabricated-tree disk cache — it writes multi-GiB trees),
 POLYKEY_BENCH_TREE_CACHE_DIR (default ~/.cache/polykey_bench_trees).
@@ -643,6 +644,9 @@ def main() -> None:
         "POLYKEY_BENCH_NEW_TOKENS", "128" if on_tpu else "16"))
 
     block = int(os.environ.get("POLYKEY_BENCH_BLOCK", "16" if on_tpu else "4"))
+    # KV-cache dtype for the engine phases ("" = follow dtype; "int8"
+    # halves pool HBM — the slot-count lever; engine/config.py kv_dtype).
+    kv_dtype = os.environ.get("POLYKEY_BENCH_KV_DTYPE", "")
     # Pipeline depth: the device stays busy only if in-flight blocks cover
     # the sync roundtrip (~100 ms through the tunnel vs ~40 ms of 1B block
     # compute → depth 4; the 8B block is compute-heavier, 3 suffices).
@@ -752,6 +756,7 @@ def main() -> None:
             # weight-bandwidth-bound.
             slots8 = int(os.environ.get("POLYKEY_BENCH_8B_SLOTS", "32"))
             cfg_b = EngineConfig(
+                kv_dtype=kv_dtype,
                 model="llama-3-8b",
                 dtype="bfloat16",
                 quantize=False,  # params arrive pre-quantized
@@ -808,6 +813,7 @@ def main() -> None:
                 os.environ.get("POLYKEY_BENCH_8B_SLOTS", "48"),
             ))
             cfg_b2 = EngineConfig(
+                kv_dtype=kv_dtype,
                 model="llama-3-8b",
                 dtype="bfloat16",
                 quantize=False,  # params arrive pre-quantized
@@ -957,6 +963,7 @@ def main() -> None:
         try:
             log("--- phase D: long-context engine bench (2k prompt / 4k positions) ---")
             cfg_d = EngineConfig(
+                kv_dtype=kv_dtype,
                 model=model_a,
                 dtype="bfloat16",
                 max_decode_slots=8,

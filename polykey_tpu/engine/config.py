@@ -77,6 +77,13 @@ class EngineConfig:
     # halves weight HBM traffic again; embed/lm_head stay int8).
     # POLYKEY_QUANTIZE=int4 selects 4.
     quantize_bits: int = 8
+    # KV-cache dtype: "bfloat16"/"float32" full precision, or "int8" —
+    # per-(token, head) symmetric quantization at write time
+    # (ops/paged_attention.quantize_kv_rows). Halves pool HBM, which is
+    # the decode-slot budget on a 16 GiB chip; decode attention takes
+    # the (int8) gather path until the DMA read kernel grows a dequant
+    # stage. POLYKEY_KV_DTYPE=int8 selects it.
+    kv_dtype: str = ""                   # "" → follow `dtype`
 
     # Decode-batch geometry (static shapes; compile-time constants).
     # Defaults target real serving lengths (VERDICT r1 #5): 4k positions
@@ -218,6 +225,7 @@ class EngineConfig:
             dtype=os.environ.get("POLYKEY_DTYPE", cls.dtype),
             checkpoint_path=os.environ.get("POLYKEY_CHECKPOINT") or None,
             quantize=_env_bool("POLYKEY_QUANTIZE", extra=("int8", "int4")),
+            kv_dtype=os.environ.get("POLYKEY_KV_DTYPE", cls.kv_dtype),
             quantize_bits=(
                 4 if os.environ.get("POLYKEY_QUANTIZE", "").lower() == "int4"
                 else cls.quantize_bits
@@ -302,6 +310,11 @@ class EngineConfig:
             raise ValueError("lookahead_blocks must be >= 1")
         if self.quantize_bits not in (4, 8):
             raise ValueError("quantize_bits must be 4 or 8")
+        if self.kv_dtype not in ("", "bfloat16", "float32", "int8"):
+            raise ValueError(
+                "kv_dtype must be '', bfloat16, float32, or int8; "
+                f"got {self.kv_dtype!r}"
+            )
         if self.top_p_candidates < 0:
             raise ValueError("top_p_candidates must be >= 0 (0 → exact)")
         for name in ("tp", "dp", "ep", "sp", "pp", "num_slices"):

@@ -345,7 +345,21 @@ class InferenceEngine:
         else:
             self.mesh = create_mesh(mesh_config, devices=devices[:n_devices])
         from jax.sharding import NamedSharding, PartitionSpec
-        self._pool_sharding = paged_kv_sharding(self.mesh)
+
+        # int8 KV (config.kv_dtype): quantized pools + scale pools. The
+        # pool sharding then becomes a PagedKV-shaped pytree (the scale
+        # pools are 4-D — one broadcast NamedSharding can't serve both).
+        self._kv_quantized = config.kv_dtype == "int8"
+        data_sh = paged_kv_sharding(self.mesh)
+        if self._kv_quantized:
+            scale_sh = NamedSharding(
+                self.mesh, PartitionSpec("pp", None, None, "tp")
+            )
+            self._pool_sharding = PagedKV(
+                k=data_sh, v=data_sh, ks=scale_sh, vs=scale_sh
+            )
+        else:
+            self._pool_sharding = PagedKV(k=data_sh, v=data_sh)
         self._repl = NamedSharding(self.mesh, PartitionSpec())
         # Sequence-parallel prefill: the window's token axis shards over
         # sp, spreading prefill compute across chips; the page pools are
@@ -417,9 +431,15 @@ class InferenceEngine:
         self.params = shard_params(params, self.model_cfg, self.mesh)
 
         B, P = config.max_decode_slots, config.pages_per_seq
+        pool_fp_dtype = (
+            jnp.dtype(config.kv_dtype)
+            if config.kv_dtype in ("bfloat16", "float32") else self._dtype
+        )
+        kv_q = jnp.int8 if self._kv_quantized else None
         self.paged = jax.device_put(
             init_paged_kv(
-                self.model_cfg, config.num_pages, config.page_size, self._dtype
+                self.model_cfg, config.num_pages, config.page_size,
+                pool_fp_dtype, kv_dtype=kv_q,
             ),
             self._pool_sharding,
         )
@@ -509,7 +529,7 @@ class InferenceEngine:
             self.d_paged = jax.device_put(
                 init_paged_kv(
                     self.draft_cfg, config.num_pages, config.page_size,
-                    self._dtype,
+                    pool_fp_dtype, kv_dtype=kv_q,
                 ),
                 self._pool_sharding,
             )

@@ -136,10 +136,21 @@ class BlockAllocator:
 
 @struct.dataclass
 class PagedKV:
-    """Device-side page pools: k/v [L, num_pages, page_size, Hk, D]."""
+    """Device-side page pools: k/v [L, num_pages, page_size, Hk, D].
+
+    With int8 KV (EngineConfig.kv_dtype="int8") k/v hold int8 values and
+    ks/vs hold per-(token, head) bf16 scales [L, num_pages, page_size, Hk]
+    — symmetric absmax over the head_dim axis, quantized at write time
+    (ops/paged_attention.paged_write) and dequantized at read time. The
+    scale overhead is 1/(2·D) of the bf16 pool (~0.4% at D=128); the pool
+    itself halves, which is the slot-count lever on a 16 GiB chip.
+    ks/vs are None for fp pools (an empty pytree subtree — the fp paths
+    never see extra buffers)."""
 
     k: jax.Array
     v: jax.Array
+    ks: Optional[jax.Array] = None
+    vs: Optional[jax.Array] = None
 
     @property
     def page_size(self) -> int:
@@ -149,16 +160,34 @@ class PagedKV:
     def num_pages(self) -> int:
         return self.k.shape[1]
 
+    @property
+    def quantized(self) -> bool:
+        return self.ks is not None
+
 
 def init_paged_kv(
-    cfg: ModelConfig, num_pages: int, page_size: int, dtype=jnp.bfloat16
+    cfg: ModelConfig, num_pages: int, page_size: int, dtype=jnp.bfloat16,
+    kv_dtype=None,
 ) -> PagedKV:
+    """`kv_dtype=jnp.int8` builds quantized pools (+ bf16 scale pools);
+    None keeps the full-precision layout in `dtype`."""
     shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads, cfg.head_dim)
+    if kv_dtype is not None and jnp.dtype(kv_dtype) == jnp.int8:
+        sshape = shape[:-1]
+        return PagedKV(
+            k=jnp.zeros(shape, jnp.int8), v=jnp.zeros(shape, jnp.int8),
+            ks=jnp.zeros(sshape, jnp.bfloat16),
+            vs=jnp.zeros(sshape, jnp.bfloat16),
+        )
     return PagedKV(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
 
 def kv_pool_bytes(
-    cfg: ModelConfig, num_pages: int, page_size: int, dtype=jnp.bfloat16
+    cfg: ModelConfig, num_pages: int, page_size: int, dtype=jnp.bfloat16,
+    kv_dtype=None,
 ) -> int:
-    per_slot = cfg.num_kv_heads * cfg.head_dim * jnp.dtype(dtype).itemsize
+    if kv_dtype is not None and jnp.dtype(kv_dtype) == jnp.int8:
+        per_slot = cfg.num_kv_heads * (cfg.head_dim * 1 + 2)  # values + scale
+    else:
+        per_slot = cfg.num_kv_heads * cfg.head_dim * jnp.dtype(dtype).itemsize
     return 2 * cfg.num_layers * num_pages * page_size * per_slot

@@ -322,6 +322,17 @@ def forward_paged(
         )
         return ctx, kc, vc
 
+    if paged.quantized:
+        # int8 KV: the per-layer cache operand is a (values, scales)
+        # pair; the write/read ops dispatch on the pair form and the
+        # scale pools ride the same scan/donation plumbing.
+        kv_scanned = ((paged.k, paged.ks), (paged.v, paged.vs))
+        x, new_k, new_v = _run_stack(
+            params, cfg, tokens, positions, kv_scanned, attend
+        )
+        return x, type(paged)(
+            k=new_k[0], v=new_v[0], ks=new_k[1], vs=new_v[1]
+        )
     x, new_k, new_v = _run_stack(
         params, cfg, tokens, positions, (paged.k, paged.v), attend
     )

@@ -59,27 +59,68 @@ def paged_attention(
     """
     from .flash_attention import flash_attention
 
-    k, v = paged_gather_kv(k_pages, v_pages, page_tables)
+    if isinstance(k_pages, tuple):
+        # int8 KV pools (values, scales): gather both, dequantize into
+        # the compute dtype — the dequant is an elementwise producer XLA
+        # fuses into the window consumers, and the pool-side HBM read
+        # stays int8.
+        (kq, ks_pool), (vq, vs_pool) = k_pages, v_pages
+        k, v = paged_gather_kv(kq, vq, page_tables)
+        B, P = page_tables.shape
+        ps, Hk = kq.shape[1], kq.shape[2]
+        ks = ks_pool[page_tables].reshape(B, P * ps, Hk)
+        vs = vs_pool[page_tables].reshape(B, P * ps, Hk)
+        k = dequantize_kv(k, ks, q.dtype)
+        v = dequantize_kv(v, vs, q.dtype)
+    else:
+        k, v = paged_gather_kv(k_pages, v_pages, page_tables)
     return flash_attention(
         q, k, v, q_positions,
         scale=scale, logit_softcap=logit_softcap, window=window, mesh=mesh,
     )
 
 
+def quantize_kv_rows(rows: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Symmetric per-(token, head) int8 quantization of KV rows
+    [..., Hk, D] → (int8 values, bf16 scales [..., Hk]).
+
+    Quantization divides by the bf16-ROUNDED scale — the value dequant
+    will actually multiply by — so the scale's own rounding adds no
+    systematic error (only the unavoidable LSB from the bf16 absmax
+    step, vs up to 127·|Δscale| if q were computed from the f32 scale)."""
+    absmax = jnp.max(jnp.abs(rows.astype(jnp.float32)), axis=-1)
+    scale = (jnp.maximum(absmax, 1e-8) / 127.0).astype(jnp.bfloat16)
+    q = jnp.clip(
+        jnp.round(rows.astype(jnp.float32) / scale[..., None].astype(jnp.float32)),
+        -127, 127,
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(values: jax.Array, scales: jax.Array, dtype) -> jax.Array:
+    """[..., Hk, D] int8 + [..., Hk] scales → dtype."""
+    return (values.astype(dtype) * scales[..., None].astype(dtype))
+
+
 def paged_write(
-    k_pages: jax.Array,       # [num_pages, page_size, Hk, D]
-    v_pages: jax.Array,
+    k_pages,                  # [num_pages, page_size, Hk, D], or a
+                              # (values, scales) pair for int8 KV pools
+    v_pages,
     k_new: jax.Array,         # [B, T, Hk, D]
     v_new: jax.Array,
     page_tables: jax.Array,   # [B, P]
     positions: jax.Array,     # [B, T] absolute position of each new token
     mesh=None,
-) -> tuple[jax.Array, jax.Array]:
+):
     """Write new KV into their pages at (page_table[pos // ps], pos % ps).
+
+    With int8 KV pools (`k_pages`/`v_pages` as (values, scales) pairs —
+    engine/kv_cache.py PagedKV.quantized) the rows quantize at write time
+    and the scale pools [N, ps, Hk] take the same write path as the data.
 
     Three paths, fastest applicable wins:
     - T == 1 on TPU: the Pallas DMA write kernel
-      (ops/paged_write_kernel.py) — per-lane row DMAs into the aliased
+      (ops/paged_write_kernel.py) — per-lane page RMW into the aliased
       pools. The XLA scatter here lowers to a sequential per-row update
       loop that measured ~10 ms/step of a ~21 ms 1B decode step
       (scripts/profile_block_device.py); the kernel makes it ~free.
@@ -90,31 +131,49 @@ def paged_write(
       (tests, non-bucket positions) still get exact semantics.
     - otherwise: the per-token XLA scatter.
     """
-    page_size = k_pages.shape[1]
+    quantized = isinstance(k_pages, tuple)
+    if quantized:
+        (kq, ks_pool), (vq, vs_pool) = k_pages, v_pages
+        k8, k_s = quantize_kv_rows(k_new)
+        v8, v_s = quantize_kv_rows(v_new)
+        # (pool, rows) pairs sharing one (page, offset) index layout.
+        writes = [(kq, k8), (vq, v8),
+                  (ks_pool, k_s.astype(ks_pool.dtype)),
+                  (vs_pool, v_s.astype(vs_pool.dtype))]
+        data_pool = kq
+    else:
+        writes = [(k_pages, k_new), (v_pages, v_new)]
+        data_pool = k_pages
+
+    page_size = data_pool.shape[1]
     B, T = positions.shape
     P = page_tables.shape[1]
     batch_idx = jnp.arange(B, dtype=jnp.int32)[:, None]
     page_ids = page_tables[batch_idx, positions // page_size]   # [B, T]
     offsets = positions % page_size                             # [B, T]
 
+    def repack(pools):
+        if quantized:
+            return (pools[0], pools[2]), (pools[1], pools[3])
+        return pools[0], pools[1]
+
     if T == 1:
         from .paged_attention_kernel import use_paged_kernel
 
-        Hk, D = k_pages.shape[2], k_pages.shape[3]
+        Hk, D = data_pool.shape[2], data_pool.shape[3]
         pp = mesh.shape.get("pp", 1) if mesh is not None else 1
         if use_paged_kernel(Hk, D) and pp == 1:
-            return _write_decode_kernel(
-                k_pages, v_pages, k_new, v_new,
-                page_ids[:, 0], offsets[:, 0], mesh,
-            )
+            return repack(_write_decode_kernel(
+                writes, page_ids[:, 0], offsets[:, 0], mesh,
+            ))
 
-    def token_scatter(ops):
-        kp, vp = ops
-        return (
-            kp.at[page_ids, offsets].set(k_new),
-            vp.at[page_ids, offsets].set(v_new),
+    def token_scatter(pools):
+        return tuple(
+            p.at[page_ids, offsets].set(r)
+            for p, (_, r) in zip(pools, writes)
         )
 
+    pools_in = tuple(p for p, _ in writes)
     if T > 1 and T % page_size == 0:
         n_pg = T // page_size
         consecutive = jnp.all(
@@ -122,43 +181,44 @@ def paged_write(
         )
         aligned = jnp.all(positions[:, 0] % page_size == 0) & consecutive
 
-        def page_scatter(ops):
-            kp, vp = ops
+        def page_scatter(pools):
             first = positions[:, 0] // page_size                 # [B]
             pg_idx = first[:, None] + jnp.arange(n_pg, dtype=jnp.int32)
             pg_ids = jnp.take_along_axis(
                 page_tables, jnp.clip(pg_idx, 0, P - 1), axis=1
             )                                                    # [B, n_pg]
-            Hk, D = kp.shape[2], kp.shape[3]
-            return (
-                kp.at[pg_ids].set(k_new.reshape(B, n_pg, page_size, Hk, D)),
-                vp.at[pg_ids].set(v_new.reshape(B, n_pg, page_size, Hk, D)),
+            return tuple(
+                p.at[pg_ids].set(
+                    r.reshape(B, n_pg, page_size, *r.shape[2:])
+                )
+                for p, (_, r) in zip(pools, writes)
             )
 
-        return jax.lax.cond(
-            aligned, page_scatter, token_scatter, (k_pages, v_pages)
-        )
+        return repack(jax.lax.cond(
+            aligned, page_scatter, token_scatter, pools_in
+        ))
 
-    return token_scatter((k_pages, v_pages))
+    return repack(token_scatter(pools_in))
 
 
-def _write_decode_kernel(
-    k_pages, v_pages, k_new, v_new, page_ids, offsets, mesh
-):
-    """Dispatch the Pallas write kernel, under shard_map when the mesh
-    shards batch (dp) or heads (tp). Pools are replicated over dp/sp, so
-    every replica must apply every lane's write: the dp-local updates
-    all-gather (tiny — B rows) before the kernel writes the full batch
-    into the local head shard. Mirrors paged_attention_decode's specs."""
-    from .paged_write_kernel import paged_write_decode_kernel
+def _write_decode_kernel(writes, page_ids, offsets, mesh):
+    """Dispatch the Pallas write kernel over (pool, rows) pairs, under
+    shard_map when the mesh shards batch (dp) or heads (tp). Pools are
+    replicated over dp/sp, so every replica must apply every lane's
+    write: the dp-local updates all-gather (tiny — B rows) before the
+    kernel writes the full batch into the local head shard. Mirrors
+    paged_attention_decode's specs. Data pools are [N, ps, Hk, D]; int8
+    KV adds scale pools [N, ps, Hk] — the head axis is last there, so
+    its tp spec sits on the final dim."""
+    from .paged_write_kernel import paged_write_rows_kernel
 
+    pools = [p for p, _ in writes]
+    rows = [r for _, r in writes]
     dp = mesh.shape.get("dp", 1) if mesh is not None else 1
     tp = mesh.shape.get("tp", 1) if mesh is not None else 1
     if dp <= 1 and tp <= 1:
-        return paged_write_decode_kernel(
-            k_pages, v_pages, k_new, v_new, page_ids, offsets
-        )
-    B, Hk = k_new.shape[0], k_new.shape[2]
+        return paged_write_rows_kernel(pools, rows, page_ids, offsets)
+    B, Hk = rows[0].shape[0], rows[0].shape[2]
     if B % dp or Hk % tp:
         # Same curated error as the read kernel (paged_attention_kernel
         # .py) — never let uneven sharding surface as an opaque shard_map
@@ -170,29 +230,35 @@ def _write_decode_kernel(
 
     from jax.sharding import PartitionSpec as Pspec
 
-    def inner(kp, vp, kn, vn, pid, off):
+    def pool_spec(p):
+        # head axis: dim 2 of [N, ps, Hk, D]; dim 2 (last) of [N, ps, Hk]
+        return (Pspec(None, None, "tp", None) if p.ndim == 4
+                else Pspec(None, None, "tp"))
+
+    def row_spec(r):
+        return (Pspec("dp", None, "tp", None) if r.ndim == 4
+                else Pspec("dp", None, "tp"))
+
+    def inner(pools_l, rows_l, pid, off):
         if dp > 1:
-            kn = jax.lax.all_gather(kn, "dp", axis=0, tiled=True)
-            vn = jax.lax.all_gather(vn, "dp", axis=0, tiled=True)
+            rows_l = [
+                jax.lax.all_gather(r, "dp", axis=0, tiled=True)
+                for r in rows_l
+            ]
             pid = jax.lax.all_gather(pid, "dp", axis=0, tiled=True)
             off = jax.lax.all_gather(off, "dp", axis=0, tiled=True)
-        return paged_write_decode_kernel(kp, vp, kn, vn, pid, off)
+        return paged_write_rows_kernel(pools_l, rows_l, pid, off)
 
     sm = jax.shard_map(
         inner,
         mesh=mesh,
         in_specs=(
-            Pspec(None, None, "tp", None),     # k_pages
-            Pspec(None, None, "tp", None),     # v_pages
-            Pspec("dp", None, "tp", None),     # k_new [B, 1, Hk, D]
-            Pspec("dp", None, "tp", None),     # v_new
-            Pspec("dp"),                       # page_ids
-            Pspec("dp"),                       # offsets
+            [pool_spec(p) for p in pools],
+            [row_spec(r) for r in rows],
+            Pspec("dp"),
+            Pspec("dp"),
         ),
-        out_specs=(
-            Pspec(None, None, "tp", None),
-            Pspec(None, None, "tp", None),
-        ),
+        out_specs=tuple(pool_spec(p) for p in pools),
         check_vma=False,
     )
-    return sm(k_pages, v_pages, k_new, v_new, page_ids, offsets)
+    return sm(pools, rows, page_ids, offsets)

@@ -356,6 +356,26 @@ def paged_attention_decode(
     online-softmax states merge via pmax/psum over sp. ep stays an
     unmentioned axis with replicated operands.
     """
+    if isinstance(k_pages, tuple):
+        # int8 KV pools: the DMA kernel reads raw pool bytes and has no
+        # dequant stage yet — quantized decode takes the gather path
+        # (which reads HALF the pool bytes of the bf16 gather, so the
+        # downgrade is mild; in-kernel dequant is the planned follow-up).
+        if force_kernel:
+            # A verification harness forcing the kernel must not be
+            # handed the gather path while believing the kernel ran.
+            raise ValueError(
+                "paged_attention_decode(force_kernel=True) has no DMA "
+                "kernel for quantized (int8 KV) pools yet"
+            )
+        from .paged_attention import paged_attention
+
+        return paged_attention(
+            q, k_pages, v_pages, page_tables, q_positions,
+            scale=scale, logit_softcap=logit_softcap, window=window,
+            mesh=mesh,
+        )
+
     B = q.shape[0]
     Hk, D = k_pages.shape[2], k_pages.shape[3]
 

@@ -13,15 +13,22 @@ A row cannot be DMA'd directly into its page: pool pages are tiled
 offsets (the row's position within the page) are illegal. So the kernel
 does a two-wave page-granular read-modify-write, one program total:
 
-  wave 1: start ALL B page-read DMAs (pool page -> VMEM buffer) at once;
+  wave 1: start ALL page-read DMAs (pool page -> VMEM buffer) at once,
+          across every pool and every lane;
   blend:  per lane (static unrolled loop), select the lane's row into
           the buffered page at its offset — pure vector ops;
-  wave 2: start ALL B page write-back DMAs, wait.
+  wave 2: start ALL page write-back DMAs, wait.
 
 Every DMA in a wave is in flight concurrently, so the cost is ~two page
 DMA latencies + B small vector blends, independent of B's serialization.
 The pools are input_output_aliased — in place, no pool copy (the engine
 donates the pool through every dispatch).
+
+The kernel is generic over a LIST of (pool, rows) writes sharing one
+(page, offset) index layout: the fp path writes [k, v] data pools
+([N, ps, Hk*D] folded — heads into lanes, exactly like the read kernel
+ops/paged_attention_kernel.py); the int8-KV path adds the bf16 scale
+pools [N, ps, Hk] in the same waves.
 
 Garbage-page collisions are intended: inactive lanes all target page 0
 (engine convention, engine.py "Inactive slots"); several lanes then RMW
@@ -29,9 +36,8 @@ page 0 concurrently and *some* full page wins — page 0 is never read
 unmasked. Active lanes never share a page (allocator invariant), so
 their full-page write-backs cannot clobber each other.
 
-Layout: pools fold heads into lanes [N, ps, Hk*D] exactly like the read
-kernel (ops/paged_attention_kernel.py) — Hk*D must be 128-aligned, the
-same `use_paged_kernel` gate. Off-TPU (and under
+Hk*D must be 128-aligned for the folded data-pool DMA — the same
+`use_paged_kernel` gate as the read kernel. Off-TPU (and under
 POLYKEY_DISABLE_PAGED_KERNEL=1) callers keep the XLA scatter.
 
 Reference obligation: none — the reference has no KV cache at all
@@ -47,59 +53,111 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _write_kernel(
-    # scalar prefetch
-    pids_ref,      # [B] int32 destination page per lane (SMEM)
-    offs_ref,      # [B] int32 destination row within the page (SMEM)
-    # inputs
-    knew_ref,      # [B, 1, HkD] VMEM — all lanes' new K rows (tiny)
-    vnew_ref,      # [B, 1, HkD] VMEM
-    kp_in,         # [N, ps, HkD] ANY (aliased with kp_out)
-    vp_in,
-    # outputs (aliased)
-    kp_out,        # [N, ps, HkD] ANY
-    vp_out,
-    # scratch
-    k_buf,         # [B, ps, HkD] VMEM — one buffered page per lane
-    v_buf,
-    kr_sems,       # [B] DMA semaphores (page reads)
-    vr_sems,
-    kw_sems,       # [B] DMA semaphores (page write-backs)
-    vw_sems,
-):
-    del kp_in, vp_in
-    B = k_buf.shape[0]
-    ps = k_buf.shape[1]
+def _make_kernel(n_pools: int, B: int, ps: int):
+    """Kernel body over `n_pools` (rows, pool_in, pool_out, buf, 2 sems)
+    groups; arity varies with the pool list, so the body is built here."""
 
-    def read_dma(b, pages, buf, sems):
-        return pltpu.make_async_copy(
-            pages.at[pids_ref[b]], buf.at[b], sems.at[b]
-        )
+    def kernel(*refs):
+        # Ref order: 2 scalar-prefetch, n rows, n pool inputs (aliased —
+        # unused), n pool outputs, then scratch.
+        pids_ref, offs_ref = refs[0], refs[1]
+        rows = refs[2:2 + n_pools]
+        outs = refs[2 + 2 * n_pools:2 + 3 * n_pools]
+        scratch = refs[2 + 3 * n_pools:]
+        bufs = scratch[:n_pools]
+        r_sems = scratch[n_pools:2 * n_pools]
+        w_sems = scratch[2 * n_pools:3 * n_pools]
 
-    def write_dma(b, buf, pages, sems):
-        return pltpu.make_async_copy(
-            buf.at[b], pages.at[pids_ref[b]], sems.at[b]
-        )
+        def read_dma(i, b):
+            return pltpu.make_async_copy(
+                outs[i].at[pids_ref[b]], bufs[i].at[b], r_sems[i].at[b]
+            )
 
-    # Wave 1: every lane's page read goes out together.
-    for b in range(B):
-        read_dma(b, kp_out, k_buf, kr_sems).start()
-        read_dma(b, vp_out, v_buf, vr_sems).start()
+        def write_dma(i, b):
+            return pltpu.make_async_copy(
+                bufs[i].at[b], outs[i].at[pids_ref[b]], w_sems[i].at[b]
+            )
 
-    rows = jax.lax.broadcasted_iota(jnp.int32, (ps, 1), 0)
-    for b in range(B):
-        read_dma(b, kp_out, k_buf, kr_sems).wait()
-        read_dma(b, vp_out, v_buf, vr_sems).wait()
-        sel = rows == offs_ref[b]                      # [ps, 1]
-        k_buf[b] = jnp.where(sel, knew_ref[b], k_buf[b])
-        v_buf[b] = jnp.where(sel, vnew_ref[b], v_buf[b])
-        # Wave 2 starts per lane as soon as its blend lands.
-        write_dma(b, k_buf, kp_out, kw_sems).start()
-        write_dma(b, v_buf, vp_out, vw_sems).start()
+        # Wave 1: every lane's page reads, all pools, all at once.
+        for b in range(B):
+            for i in range(n_pools):
+                read_dma(i, b).start()
 
-    for b in range(B):
-        write_dma(b, k_buf, kp_out, kw_sems).wait()
-        write_dma(b, v_buf, vp_out, vw_sems).wait()
+        sel = jax.lax.broadcasted_iota(jnp.int32, (ps, 1), 0)
+        for b in range(B):
+            for i in range(n_pools):
+                read_dma(i, b).wait()
+                bufs[i][b] = jnp.where(
+                    sel == offs_ref[b], rows[i][b], bufs[i][b]
+                )
+                # Wave 2 starts per lane as soon as its blend lands.
+                write_dma(i, b).start()
+
+        for b in range(B):
+            for i in range(n_pools):
+                write_dma(i, b).wait()
+
+    return kernel
+
+
+def paged_write_rows_kernel(
+    pools: list,              # data [N, ps, Hk, D] and/or scale [N, ps, Hk]
+    rows: list,               # matching [B, 1, Hk, D] / [B, 1, Hk]
+    page_ids: jax.Array,      # [B] int32
+    offsets: jax.Array,       # [B] int32
+    *,
+    interpret: bool = False,
+) -> tuple:
+    """In-place page RMW of each (pool, rows) pair at one shared
+    (page, offset) per lane; returns the (aliased) pools, same order."""
+    n = len(pools)
+    B = rows[0].shape[0]
+    ps = pools[0].shape[1]
+
+    folded_pools, folded_rows, shapes = [], [], []
+    for p, r in zip(pools, rows):
+        shapes.append(p.shape)
+        if p.ndim == 4:
+            N, _, Hk, D = p.shape
+            folded_pools.append(p.reshape(N, ps, Hk * D))
+            folded_rows.append(r.reshape(B, 1, Hk * D).astype(p.dtype))
+        else:
+            folded_pools.append(p)
+            folded_rows.append(r.reshape(B, 1, p.shape[2]).astype(p.dtype))
+
+    any_spec = pl.BlockSpec(memory_space=pltpu.ANY)
+    row_specs = [
+        pl.BlockSpec(fr.shape, lambda *_: (0, 0, 0),
+                     memory_space=pltpu.VMEM)
+        for fr in folded_rows
+    ]
+    outs = pl.pallas_call(
+        _make_kernel(n, B, ps),
+        out_shape=tuple(
+            jax.ShapeDtypeStruct(fp.shape, fp.dtype) for fp in folded_pools
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(),
+            in_specs=row_specs + [any_spec] * n,
+            out_specs=[any_spec] * n,
+            scratch_shapes=(
+                [pltpu.VMEM((B, ps, fp.shape[2]), fp.dtype)
+                 for fp in folded_pools]
+                + [pltpu.SemaphoreType.DMA((B,))] * (2 * n)
+            ),
+        ),
+        # Flattened input positions incl. the 2 scalar-prefetch args:
+        # pids=0 offs=1 rows=2..2+n-1 pools=2+n..2+2n-1.
+        input_output_aliases={2 + n + i: i for i in range(n)},
+        interpret=interpret,
+    )(
+        page_ids.astype(jnp.int32),
+        offsets.astype(jnp.int32),
+        *folded_rows,
+        *folded_pools,
+    )
+    return tuple(o.reshape(sh) for o, sh in zip(outs, shapes))
 
 
 def paged_write_decode_kernel(
@@ -112,50 +170,10 @@ def paged_write_decode_kernel(
     *,
     interpret: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
-    """In-place decode-step KV write; returns the (aliased) pools."""
-    N, ps, Hk, D = k_pages.shape
-    B = k_new.shape[0]
-    HkD = Hk * D
-
-    kp = k_pages.reshape(N, ps, HkD)
-    vp = v_pages.reshape(N, ps, HkD)
-    kn = k_new.reshape(B, 1, HkD)
-    vn = v_new.reshape(B, 1, HkD)
-
-    any_spec = pl.BlockSpec(memory_space=pltpu.ANY)
-    row_spec = pl.BlockSpec(
-        (B, 1, HkD), lambda *_: (0, 0, 0), memory_space=pltpu.VMEM
-    )
-    kp, vp = pl.pallas_call(
-        _write_kernel,
-        out_shape=(
-            jax.ShapeDtypeStruct(kp.shape, kp.dtype),
-            jax.ShapeDtypeStruct(vp.shape, vp.dtype),
-        ),
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
-            grid=(),
-            in_specs=[row_spec, row_spec, any_spec, any_spec],
-            out_specs=[any_spec, any_spec],
-            scratch_shapes=[
-                pltpu.VMEM((B, ps, HkD), kp.dtype),
-                pltpu.VMEM((B, ps, HkD), vp.dtype),
-                pltpu.SemaphoreType.DMA((B,)),
-                pltpu.SemaphoreType.DMA((B,)),
-                pltpu.SemaphoreType.DMA((B,)),
-                pltpu.SemaphoreType.DMA((B,)),
-            ],
-        ),
-        # Flattened input positions incl. the 2 scalar-prefetch args:
-        # pids=0 offs=1 k_new=2 v_new=3 k_pages=4 v_pages=5.
-        input_output_aliases={4: 0, 5: 1},
+    """The fp two-pool case (kept as the named entry point the kernel
+    check and tests exercise)."""
+    kp, vp = paged_write_rows_kernel(
+        [k_pages, v_pages], [k_new, v_new], page_ids, offsets,
         interpret=interpret,
-    )(
-        page_ids.astype(jnp.int32),
-        offsets.astype(jnp.int32),
-        kn.astype(kp.dtype),
-        vn.astype(vp.dtype),
-        kp,
-        vp,
     )
-    return kp.reshape(N, ps, Hk, D), vp.reshape(N, ps, Hk, D)
+    return kp, vp

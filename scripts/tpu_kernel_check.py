@@ -218,6 +218,41 @@ def check_paged_write() -> None:
             print(f"write {label} per-call: kernel {per:.3f} ms, "
                   f"scatter {scatter_per:.3f} ms "
                   f"({scatter_per / max(per, 1e-9):.1f}x)")
+
+            # int8-KV 4-pool variant: int8 data pools + bf16 scale pools
+            # ([N, ps, Hk] — tiny minor dims) through the same RMW waves.
+            # Interpret mode proves the math (tests); THIS proves the
+            # Mosaic lowering of the scale-page DMAs per geometry.
+            from polykey_tpu.ops.paged_write_kernel import (
+                paged_write_rows_kernel,
+            )
+
+            k8p = jnp.asarray(
+                np.random.default_rng(1).integers(
+                    -127, 128, (N, ps, Hk, D)), jnp.int8)
+            v8p = -k8p
+            ksp = jax.random.normal(k3, (N, ps, Hk), jnp.bfloat16)
+            vsp = ksp * 0.5
+            k8r = jnp.asarray(
+                np.random.default_rng(2).integers(
+                    -127, 128, (B, 1, Hk, D)), jnp.int8)
+            v8r = -k8r
+            ksr = jax.random.normal(k2, (B, 1, Hk), jnp.bfloat16)
+            vsr = ksr + 1
+            t0 = time.monotonic()
+            outs = paged_write_rows_kernel(
+                [k8p, v8p, ksp, vsp], [k8r, v8r, ksr, vsr],
+                page_ids, offsets)
+            ok = True
+            for pool, rows_, got in zip(
+                    [k8p, v8p, ksp, vsp], [k8r, v8r, ksr, vsr], outs):
+                want = pool.at[page_ids, offsets].set(
+                    rows_.reshape(B, *rows_.shape[2:]))
+                ok &= bool(jnp.array_equal(got, want))
+            print(f"write {label} int8kv 4-pool: "
+                  f"{'equal' if ok else 'MISMATCH'} "
+                  f"({time.monotonic() - t0:.1f}s inc. compile)")
+            assert ok, f"int8kv write kernel mismatch ({label})"
         except Exception as e:
             print(f"write {label} FAILED: {type(e).__name__}: {e}")
             failures.append(f"write {label}: {e}")

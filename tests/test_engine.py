@@ -854,3 +854,45 @@ def test_admission_keeps_slots_occupied():
         assert blocks <= ideal * 2.5, (blocks, ideal)
     finally:
         engine.shutdown()
+
+
+def test_int8_kv_engine_serves():
+    """EngineConfig.kv_dtype='int8': quantized KV pools (+ bf16 scale
+    pools) through admission, batched prefill, blocked decode, and
+    retirement — all requests complete with the full token budget."""
+    cfg = EngineConfig(
+        model="tiny-llama",
+        tokenizer="byte",
+        dtype="float32",
+        kv_dtype="int8",
+        max_decode_slots=4,
+        page_size=8,
+        num_pages=128,
+        max_seq_len=64,
+        prefill_buckets=(16, 32),
+        max_new_tokens_cap=32,
+    )
+    import jax.numpy as jnp
+
+    engine = InferenceEngine(cfg)
+    try:
+        assert engine.paged.quantized
+        assert engine.paged.k.dtype == jnp.int8
+        assert engine.paged.ks.dtype == jnp.bfloat16
+        reqs = [GenRequest(prompt=f"int8 kv {i}", max_new_tokens=12)
+                for i in range(6)]
+        for r in reqs:
+            engine.submit(r)
+        for r in reqs:
+            tokens = []
+            while True:
+                kind, v = r.out.get(timeout=120.0)
+                if kind == "token":
+                    tokens.append(v)
+                elif kind == "done":
+                    break
+                else:
+                    raise AssertionError(f"request failed: {v}")
+            assert len(tokens) == 12
+    finally:
+        engine.shutdown()

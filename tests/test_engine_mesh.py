@@ -237,3 +237,16 @@ def test_tp2_int4_matches_int4(reference_outputs):
     assert _run_prompts_for(
         dataclasses.replace(cfg_q4, tp=2), PROMPTS
     ) == _run_prompts_for(cfg_q4, PROMPTS)
+
+
+def test_tp2_int8_kv_matches_single_device(reference_outputs):
+    """int8 KV pools shard through the PagedKV sharding pytree (data
+    pools head-sharded on dim 2, scale pools on their LAST dim) and the
+    quantized write/read paths run under GSPMD. Greedy equality vs the
+    single-device int8-KV engine (int8-KV logits differ from fp, so the
+    comparison is int8-KV vs int8-KV)."""
+    del reference_outputs
+    cfg_kv = dataclasses.replace(BASE_CONFIG, kv_dtype="int8")
+    assert _run_prompts_for(
+        dataclasses.replace(cfg_kv, tp=2), PROMPTS
+    ) == _run_prompts_for(cfg_kv, PROMPTS)

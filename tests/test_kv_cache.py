@@ -238,12 +238,96 @@ def test_paged_write_mesh_kernel_path_matches_scatter(monkeypatch):
     offsets = (pos % ps)[:, 0]
 
     monkeypatch.setattr(
-        pwk, "paged_write_decode_kernel",
-        partial(pwk.paged_write_decode_kernel, interpret=True),
+        pwk, "paged_write_rows_kernel",
+        partial(pwk.paged_write_rows_kernel, interpret=True),
     )
     got_k, got_v = pa._write_decode_kernel(
-        kp, vp, kn, vn, page_ids, offsets, mesh
+        [(kp, kn), (vp, vn)], page_ids, offsets, mesh
     )
     want_k, want_v = _scatter_reference(kp, vp, kn, vn, pt, pos)
     np.testing.assert_array_equal(np.asarray(got_k), np.asarray(want_k))
     np.testing.assert_array_equal(np.asarray(got_v), np.asarray(want_v))
+
+
+# ---- int8 KV cache ----
+
+
+def test_quantize_kv_rows_roundtrip():
+    from polykey_tpu.ops.paged_attention import (
+        dequantize_kv,
+        quantize_kv_rows,
+    )
+
+    rows = jax.random.normal(jax.random.PRNGKey(11), (3, 5, 4, 16))
+    q, s = quantize_kv_rows(rows)
+    assert q.dtype == jnp.int8 and s.shape == (3, 5, 4)
+    back = dequantize_kv(q, s, jnp.float32)
+    # q is computed against the bf16-ROUNDED scale (the one dequant
+    # multiplies by), so per-entry error <= stored_scale/2; the stored
+    # scale itself is within bf16 rounding of absmax/127.
+    stored = np.asarray(s.astype(jnp.float32))
+    err = np.asarray(jnp.abs(back - rows))
+    assert (err <= stored[..., None] * 0.51 + 1e-7).all()
+
+
+def test_forward_paged_int8_kv_tracks_fp():
+    """Prefill + decode through int8 KV pools stay within quantization
+    tolerance of the fp pools (the serving accuracy gate for
+    EngineConfig.kv_dtype='int8')."""
+    from polykey_tpu.models.transformer import forward_paged, init_params
+
+    cfg = TINY_LLAMA
+    params = init_params(jax.random.PRNGKey(0), cfg, jnp.float32)
+    B, T, P, ps = 2, 16, 4, 16
+    pt = np.zeros((B, P), np.int32)
+    for b in range(B):
+        pt[b] = np.arange(P) + 1 + b * P
+    pt = jnp.asarray(pt)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (B, T), 0, cfg.vocab_size)
+    positions = jnp.broadcast_to(jnp.arange(T), (B, T)).astype(jnp.int32)
+
+    pool_fp = init_paged_kv(cfg, 1 + B * P, ps, jnp.float32)
+    pool_q = init_paged_kv(cfg, 1 + B * P, ps, jnp.float32,
+                           kv_dtype=jnp.int8)
+    assert pool_q.quantized and pool_q.k.dtype == jnp.int8
+    h_fp, pool_fp = forward_paged(params, cfg, tokens, positions, pool_fp, pt)
+    h_q, pool_q = forward_paged(params, cfg, tokens, positions, pool_q, pt)
+    scale = float(jnp.max(jnp.abs(h_fp))) + 1e-6
+    assert float(jnp.max(jnp.abs(h_fp - h_q))) / scale < 0.05
+
+    last = tokens[:, -1:]
+    dpos = jnp.full((B, 1), T, jnp.int32)
+    d_fp, _ = forward_paged(params, cfg, last, dpos, pool_fp, pt)
+    d_q, _ = forward_paged(params, cfg, last, dpos, pool_q, pt)
+    scale = float(jnp.max(jnp.abs(d_fp))) + 1e-6
+    assert float(jnp.max(jnp.abs(d_fp - d_q))) / scale < 0.05
+
+
+def test_paged_write_rows_kernel_with_scale_pools():
+    """The generalized RMW kernel over four pools (int8 data + bf16
+    scales) matches per-pool scatter in interpret mode."""
+    from polykey_tpu.ops.paged_write_kernel import paged_write_rows_kernel
+
+    B, P, ps, Hk, D = 4, 3, 16, 4, 32
+    N = 1 + B * P
+    rng = np.random.default_rng(5)
+    kq = jnp.asarray(rng.integers(-127, 128, (N, ps, Hk, D)), jnp.int8)
+    vq = kq * -1
+    ks = jnp.asarray(rng.normal(size=(N, ps, Hk)), jnp.bfloat16)
+    vs = ks + 1
+    k8 = jnp.asarray(rng.integers(-127, 128, (B, 1, Hk, D)), jnp.int8)
+    v8 = -k8
+    ksr = jnp.asarray(rng.normal(size=(B, 1, Hk)), jnp.bfloat16)
+    vsr = ksr * 2
+    page_ids = jnp.asarray(rng.permutation(N - 1)[:B].astype(np.int32) + 1)
+    offsets = jnp.asarray(rng.integers(0, ps, B).astype(np.int32))
+
+    outs = paged_write_rows_kernel(
+        [kq, vq, ks, vs], [k8, v8, ksr, vsr], page_ids, offsets,
+        interpret=True,
+    )
+    for pool, rows, got in zip([kq, vq, ks, vs], [k8, v8, ksr, vsr], outs):
+        want = pool.at[page_ids, offsets].set(
+            rows.reshape(B, *rows.shape[2:]))
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
