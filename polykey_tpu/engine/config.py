@@ -80,9 +80,9 @@ class EngineConfig:
     # KV-cache dtype: "bfloat16"/"float32" full precision, or "int8" —
     # per-(token, head) symmetric quantization at write time
     # (ops/paged_attention.quantize_kv_rows). Halves pool HBM, which is
-    # the decode-slot budget on a 16 GiB chip; decode attention takes
-    # the (int8) gather path until the DMA read kernel grows a dequant
-    # stage. POLYKEY_KV_DTYPE=int8 selects it.
+    # the decode-slot budget on a 16 GiB chip; decode attention streams
+    # the int8 pages through the DMA read kernel's in-kernel dequant
+    # stage (half the bf16 bytes). POLYKEY_KV_DTYPE=int8 selects it.
     kv_dtype: str = ""                   # "" → follow `dtype`
 
     # Decode-batch geometry (static shapes; compile-time constants).

@@ -352,9 +352,9 @@ class InferenceEngine:
         self._kv_quantized = config.kv_dtype == "int8"
         data_sh = paged_kv_sharding(self.mesh)
         if self._kv_quantized:
-            scale_sh = NamedSharding(
-                self.mesh, PartitionSpec("pp", None, None, "tp")
-            )
+            from ..parallel.sharding import paged_kv_scale_sharding
+
+            scale_sh = paged_kv_scale_sharding(self.mesh)
             self._pool_sharding = PagedKV(
                 k=data_sh, v=data_sh, ks=scale_sh, vs=scale_sh
             )

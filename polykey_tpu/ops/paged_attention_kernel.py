@@ -55,28 +55,36 @@ def _kernel(
     pos_ref,       # [B] int32 decode position per sequence
     win_ref,       # [1] int32 sliding window (<=0 → global)
     rng_ref,       # [2] int32 page sub-range [rlo, rhi) — CP shard's slice
-    # inputs
-    q_ref,         # [1, Hq, D] VMEM block
-    k_pages_ref,   # [N, ps, Hk·D] HBM (heads folded into lanes; manual DMA)
-    v_pages_ref,   # [N, ps, Hk·D] HBM
-    # outputs (unnormalized online-softmax state — the wrapper normalizes,
-    # or merges across CP shards first: acc/l scale by exp(m - m_global))
-    acc_ref,       # [1, Hq, D] f32
-    m_ref,         # [1, Hq, MINOR] f32 (running max, lane-broadcast)
-    l_ref,         # [1, Hq, MINOR] f32 (denominator)
-    # scratch
-    k_buf,         # [2, G, ps, Hk·D] VMEM
-    v_buf,
-    k_sems,        # DMA semaphores (2, G)
-    v_sems,
-    *,
+    # then, positionally (arity varies with `quantized`):
+    # inputs: q [1, Hq, D] VMEM block; k/v pages [N, ps, Hk·D] HBM
+    #         (heads folded into lanes; manual DMA); quantized adds
+    #         ks/vs scale pages [N, ps, Hk] HBM (bf16)
+    # outputs: unnormalized online-softmax state — the wrapper
+    #         normalizes, or merges across CP shards first (acc/l scale
+    #         by exp(m - m_global)): acc [1, Hq, D] f32, m/l
+    #         [1, Hq, MINOR] f32
+    # scratch: k/v bufs [2, G, ps, Hk·D] VMEM (+ [2, G, ps, Hk] scale
+    #         bufs when quantized) and matching DMA semaphores (2, G)
+    *refs,
     scale: float,
     logit_softcap: Optional[float],
     page_size: int,
     num_tables: int,   # P — static max pages per sequence
     groups: int,       # Hq // Hk
     pages_per_block: int,   # G — pages per buffer slot (DMAs in flight)
+    quantized: bool = False,
 ):
+    if quantized:
+        (q_ref, k_pages_ref, v_pages_ref, ks_pages_ref, vs_pages_ref,
+         acc_ref, m_ref, l_ref,
+         k_buf, v_buf, ks_buf, vs_buf,
+         k_sems, v_sems, ks_sems, vs_sems) = refs
+    else:
+        (q_ref, k_pages_ref, v_pages_ref,
+         acc_ref, m_ref, l_ref,
+         k_buf, v_buf, k_sems, v_sems) = refs
+        ks_pages_ref = vs_pages_ref = None
+        ks_buf = vs_buf = ks_sems = vs_sems = None
     b = pl.program_id(0)
     q_pos = pos_ref[b]
     window = win_ref[0]
@@ -112,6 +120,11 @@ def _kernel(
             def _go(p=p, j=j):
                 page_dma(p, slot, j, k_pages_ref, k_buf, k_sems).start()
                 page_dma(p, slot, j, v_pages_ref, v_buf, v_sems).start()
+                if quantized:
+                    page_dma(p, slot, j, ks_pages_ref, ks_buf,
+                             ks_sems).start()
+                    page_dma(p, slot, j, vs_pages_ref, vs_buf,
+                             vs_sems).start()
 
     def wait_block(blk, slot):
         for j in range(G):
@@ -121,6 +134,11 @@ def _kernel(
             def _wait(p=p, j=j):
                 page_dma(p, slot, j, k_pages_ref, k_buf, k_sems).wait()
                 page_dma(p, slot, j, v_pages_ref, v_buf, v_sems).wait()
+                if quantized:
+                    page_dma(p, slot, j, ks_pages_ref, ks_buf,
+                             ks_sems).wait()
+                    page_dma(p, slot, j, vs_pages_ref, vs_buf,
+                             vs_sems).wait()
 
     @pl.when((lo < hi) & (blo < bhi))
     def _first():
@@ -150,6 +168,13 @@ def _kernel(
             v = v_buf[slot].reshape(W, -1)
             D = q.shape[1]
             num_kv = k.shape[1] // D
+            if quantized:
+                # Per-(position, head) dequant scales for this group —
+                # applied on the per-head slices below, so the int8
+                # pages stream at half the bf16 bytes and dequant rides
+                # the matmul operand load.
+                ks2 = ks_buf[slot].reshape(W, num_kv).astype(jnp.float32)
+                vs2 = vs_buf[slot].reshape(W, num_kv).astype(jnp.float32)
 
             kv_pos1 = blk * W + jax.lax.broadcasted_iota(
                 jnp.int32, (W, 1), dimension=0
@@ -158,14 +183,26 @@ def _kernel(
             # Rows of pages that were never DMA'd hold stale VMEM; zero V
             # there so masked-out weights cannot multiply NaN garbage.
             v = jnp.where(valid1, v.astype(jnp.float32), 0.0)
+            if quantized:
+                # The V-side matmul SUMS over rows, so stale scale rows
+                # must be zeroed like v itself — 0·NaN from a stale bf16
+                # pattern would poison every output. K-side NaNs stay
+                # confined to their own masked logit column.
+                vs2 = jnp.where(valid1, vs2, 0.0)
 
             # Mosaic lowers only plain 2D matmuls — unroll over kv heads
             # (q head h ↔ kv head h//groups, heads grouped contiguously).
+            def k_head(h):
+                kk = k[:, h * D:(h + 1) * D].astype(jnp.float32)
+                if quantized:
+                    kk = kk * ks2[:, h:h + 1]
+                return kk
+
             s = jnp.concatenate(
                 [
                     jax.lax.dot_general(
                         q[h * groups:(h + 1) * groups],       # [g, D]
-                        k[:, h * D:(h + 1) * D].astype(jnp.float32),
+                        k_head(h),
                         dimension_numbers=(((1,), (1,)), ((), ())),
                         preferred_element_type=jnp.float32,
                     )
@@ -189,11 +226,17 @@ def _kernel(
             pexp = jnp.where(mask, jnp.exp(s - m_new), 0.0)   # [Hq, W]
             corr = jnp.exp(m - m_new)
             l_new = corr * l + jnp.sum(pexp, axis=1, keepdims=True)
+            def v_head(h):
+                vv = v[:, h * D:(h + 1) * D]
+                if quantized:
+                    vv = vv * vs2[:, h:h + 1]
+                return vv
+
             pv = jnp.concatenate(
                 [
                     jax.lax.dot_general(
                         pexp[h * groups:(h + 1) * groups],    # [g, W]
-                        v[:, h * D:(h + 1) * D],
+                        v_head(h),
                         dimension_numbers=(((1,), (0,)), ((), ())),
                         preferred_element_type=jnp.float32,
                     )
@@ -228,8 +271,8 @@ _STAT_MINOR = 128   # lane width for the m/l stat outputs (tile-aligned)
 )
 def _decode_call(
     q: jax.Array,             # [B, Hq, D]
-    k_pages: jax.Array,       # [N, ps, Hk, D]
-    v_pages: jax.Array,
+    k_pages,                  # [N, ps, Hk, D], or (values, scales) pairs
+    v_pages,                  #   for int8 KV (scales [N, ps, Hk] bf16)
     page_tables: jax.Array,   # [B, P] int32
     positions: jax.Array,     # [B] int32
     window: jax.Array,        # [1] int32
@@ -244,6 +287,9 @@ def _decode_call(
     m [B,Hq,1], l [B,Hq,1]) over the pages in `page_range` — the caller
     normalizes, or first merges partial states across context-parallel
     shards (acc/l scale by exp(m - m_global))."""
+    quantized = isinstance(k_pages, tuple)
+    if quantized:
+        (k_pages, ks_pages), (v_pages, vs_pages) = k_pages, v_pages
     B, Hq, D = q.shape
     N, ps, Hk, _ = k_pages.shape
     P = page_tables.shape[1]
@@ -265,27 +311,39 @@ def _decode_call(
         num_tables=P,
         groups=Hq // Hk,
         pages_per_block=G,
+        quantized=quantized,
     )
     stat_spec = pl.BlockSpec((1, Hq, _STAT_MINOR), lambda b, *_: (b, 0, 0))
+    any_spec = pl.BlockSpec(memory_space=pl.ANY)
+    in_specs = [
+        pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
+        any_spec,
+        any_spec,
+    ]
+    scratch = [
+        pltpu.VMEM((2, G, ps, Hk * D), k_pages.dtype),
+        pltpu.VMEM((2, G, ps, Hk * D), k_pages.dtype),
+    ]
+    operands = [q, k_pages, v_pages]
+    if quantized:
+        in_specs += [any_spec, any_spec]
+        scratch += [
+            pltpu.VMEM((2, G, ps, Hk), ks_pages.dtype),
+            pltpu.VMEM((2, G, ps, Hk), vs_pages.dtype),
+        ]
+        operands = [q, k_pages, v_pages, ks_pages, vs_pages]
+    n_sems = 4 if quantized else 2
+    scratch += [pltpu.SemaphoreType.DMA((2, G))] * n_sems
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(B,),
-        in_specs=[
-            pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
             stat_spec,
             stat_spec,
         ],
-        scratch_shapes=[
-            pltpu.VMEM((2, G, ps, Hk * D), k_pages.dtype),
-            pltpu.VMEM((2, G, ps, Hk * D), k_pages.dtype),
-            pltpu.SemaphoreType.DMA((2, G)),
-            pltpu.SemaphoreType.DMA((2, G)),
-        ],
+        scratch_shapes=scratch,
     )
     acc, m, l = pl.pallas_call(
         kernel,
@@ -304,9 +362,7 @@ def _decode_call(
         positions.astype(jnp.int32),
         window,
         page_range.astype(jnp.int32),
-        q,
-        k_pages,
-        v_pages,
+        *operands,
     )
     return acc, m[..., :1], l[..., :1]
 
@@ -356,28 +412,10 @@ def paged_attention_decode(
     online-softmax states merge via pmax/psum over sp. ep stays an
     unmentioned axis with replicated operands.
     """
-    if isinstance(k_pages, tuple):
-        # int8 KV pools: the DMA kernel reads raw pool bytes and has no
-        # dequant stage yet — quantized decode takes the gather path
-        # (which reads HALF the pool bytes of the bf16 gather, so the
-        # downgrade is mild; in-kernel dequant is the planned follow-up).
-        if force_kernel:
-            # A verification harness forcing the kernel must not be
-            # handed the gather path while believing the kernel ran.
-            raise ValueError(
-                "paged_attention_decode(force_kernel=True) has no DMA "
-                "kernel for quantized (int8 KV) pools yet"
-            )
-        from .paged_attention import paged_attention
-
-        return paged_attention(
-            q, k_pages, v_pages, page_tables, q_positions,
-            scale=scale, logit_softcap=logit_softcap, window=window,
-            mesh=mesh,
-        )
-
+    quantized = isinstance(k_pages, tuple)
     B = q.shape[0]
-    Hk, D = k_pages.shape[2], k_pages.shape[3]
+    data_pool = k_pages[0] if quantized else k_pages
+    Hk, D = data_pool.shape[2], data_pool.shape[3]
 
     if not (force_kernel or interpret or use_paged_kernel(Hk, D)):
         from .paged_attention import paged_attention
@@ -454,13 +492,20 @@ def paged_attention_decode(
                 acc = jax.lax.psum(acc * corr, "sp")
             return _normalize(acc, l, q2.dtype)
 
+        # Quantized pools are (values, scales) pairs: per-arg specs are
+        # pytrees matching that structure (scale pools [N, ps, Hk]
+        # head-shard on their LAST dim).
+        pool_spec = (
+            (P(None, None, "tp", None), P(None, None, "tp"))
+            if quantized else P(None, None, "tp", None)
+        )
         sm = jax.shard_map(
             inner_sm,
             mesh=mesh,
             in_specs=(
                 P("dp", "tp", None),          # q [B, Hq, D]
-                P(None, None, "tp", None),    # k_pages
-                P(None, None, "tp", None),    # v_pages
+                pool_spec,                    # k_pages
+                pool_spec,                    # v_pages
                 P("dp", None),                # page_tables
                 P("dp"),                      # positions
                 P(None),                      # window

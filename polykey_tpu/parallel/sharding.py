@@ -132,6 +132,13 @@ def paged_kv_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P("pp", None, None, "tp", None))
 
 
+def paged_kv_scale_sharding(mesh: Mesh) -> NamedSharding:
+    """int8-KV scale pools [L, N, page_size, Hk]: same placement as the
+    data pools (paged_kv_sharding) with the head axis LAST — kept beside
+    it so the two specs cannot drift apart."""
+    return NamedSharding(mesh, P("pp", None, None, "tp"))
+
+
 def contiguous_kv_sharding(mesh: Mesh) -> NamedSharding:
     """Contiguous cache [L, B, S, Hk, D]: batch over dp, heads over tp."""
     return NamedSharding(mesh, P("pp", "dp", None, "tp", None))

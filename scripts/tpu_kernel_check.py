@@ -79,6 +79,8 @@ def check_paged_decode() -> None:
          [(50.0, 1024, 0)]),
     ]
     failures: list[str] = []
+    from polykey_tpu.ops.paged_attention import quantize_kv_rows
+
     for label, B, Hq, Hk, D, ps, P, dtype, tol, variants in cases:
         # Isolate per-case: an unattended run (tpu_watcher) must keep the
         # other geometries' evidence when one compile or OOM fails.
@@ -131,6 +133,23 @@ def check_paged_decode() -> None:
             print(f"{label} per-call: kernel {timed['kernel']:.2f} ms, "
                   f"gather {timed['gather']:.2f} ms "
                   f"({timed['gather'] / max(timed['kernel'], 1e-9):.2f}x)")
+
+            # int8-KV variant: the in-kernel dequant stage (scale pages
+            # stream alongside data pages). Proves the Mosaic lowering
+            # of the [ps, Hk] scale-page DMAs at this geometry.
+            kq = quantize_kv_rows(kp)
+            vq = quantize_kv_rows(vp)
+            refq = paged_attention(
+                q, kq, vq, pts, positions, scale=0.125)
+            t0 = time.monotonic()
+            outq = paged_attention_decode(
+                q, kq, vq, pts, positions, scale=0.125, force_kernel=True)
+            errq = float(jnp.max(jnp.abs(
+                refq.astype(jnp.float32) - outq.astype(jnp.float32))))
+            print(f"paged {label} int8kv: err={errq:.2e} "
+                  f"({time.monotonic() - t0:.1f}s inc. compile)")
+            assert errq < tol, f"int8kv paged kernel mismatch ({label}): {errq}"
+            del kq, vq, refq, outq
         except Exception as e:
             print(f"paged {label} FAILED: {type(e).__name__}: {e}")
             failures.append(f"paged {label}: {e}")

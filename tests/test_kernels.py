@@ -302,3 +302,28 @@ def test_pp_mesh_routes_to_gather_path(monkeypatch):
     )
     assert calls["gather"] == 1
     assert float(jnp.max(jnp.abs(ref - out))) < TOL
+
+
+@pytest.mark.parametrize("win", [None, 24])
+def test_paged_decode_kernel_quantized_matches_gather(win):
+    """int8-KV pools through the DMA kernel's in-kernel dequant stage
+    (scale pages stream alongside data pages; stale scale rows zeroed on
+    the V side) vs the quantized gather path. Both dequantize with the
+    same stored bf16 scales, so agreement is fp-tolerance, not
+    quantization-tolerance."""
+    from polykey_tpu.ops.paged_attention import quantize_kv_rows
+
+    q, kp, vp, pt, pos = _paged_case(
+        4, 8, 2, 64, 16, 8, [[5], [37], [63], [100]]
+    )
+    k8, ks = quantize_kv_rows(kp)
+    v8, vs = quantize_kv_rows(vp)
+    kq, vq = (k8, ks), (v8, vs)
+    ref = paged_attention(q, kq, vq, pt, pos, scale=0.125,
+                          window=None if win is None else jnp.int32(win))
+    out = paged_attention_decode(
+        q, kq, vq, pt, pos, scale=0.125,
+        window=None if win is None else jnp.int32(win),
+        interpret=True,
+    )
+    assert float(jnp.max(jnp.abs(ref - out))) < TOL
