@@ -109,7 +109,7 @@ class EngineConfig:
     prefix_cache: bool = False
     prefix_cache_pages: int = 0
 
-    # Pre-compile the prefill group shapes ({1,2,4} × buckets) and the
+    # Pre-compile the prefill group shapes ({1,2,4,8} × buckets) and the
     # decode block (or spec round) at engine construction, before the loop
     # starts — first requests (and benchmark windows) then never pay XLA
     # compile time. Costs startup latency.

@@ -257,7 +257,7 @@ def _retire_lane_fn(last_tokens, seq_lens, page_tables, active, caps, slot):
     )
 
 
-_MAX_PREFILL_GROUP = 4   # burst admissions batched per prefill dispatch
+_MAX_PREFILL_GROUP = 8   # burst admissions batched per prefill dispatch
 
 
 class EngineDeadError(RuntimeError):
@@ -945,10 +945,10 @@ class InferenceEngine:
     def _dispatch_prefill_group(self, bucket: int, group: list) -> None:
         """One batched prefill dispatch for up to _MAX_PREFILL_GROUP
         same-bucket admissions, padded to a power of two so the compiled
-        shape set stays small ({1,2,4} × buckets). Padded rows point their
+        shape set stays small ({1,2,4,8} × buckets). Padded rows point their
         page tables at the reserved garbage page and are never resolved."""
         n = len(group)
-        n_pad = 1 if n == 1 else 2 if n == 2 else 4
+        n_pad = 1 if n == 1 else 2 if n == 2 else 4 if n <= 4 else 8
         cfg = self.config
         tokens = np.zeros((n_pad, bucket), dtype=np.int32)
         starts = np.zeros((n_pad,), dtype=np.int32)
@@ -1021,8 +1021,12 @@ class InferenceEngine:
         greedy_variants = (True, False) if warm_sampled else (True,)
         put = partial(jax.device_put, device=self._repl)
         # Possible padded group sizes given the slot count (groups are
-        # bounded by free slots; n=3 pads to 4, so B>=3 can see [4]).
-        pads = [1] + ([2] if B >= 2 else []) + ([4] if B >= 3 else [])
+        # bounded by free slots; n=3 pads to 4, n=5 pads to 8). A
+        # full-rate admission burst of 32 then costs 4 weight-read
+        # passes instead of 8 — prefill is weight-bandwidth-bound
+        # exactly like decode, so group width amortizes it.
+        pads = ([1] + ([2] if B >= 2 else []) + ([4] if B >= 3 else [])
+                + ([8] if B >= 5 else []))
         self._upload_slot_state()
         dev = self._dev
         zrow = np.zeros((cfg.pages_per_seq,), np.int32)
