@@ -35,13 +35,21 @@ def main() -> None:
     seconds = float(os.environ.get("STRESS_SECONDS", "120"))
     workers = int(os.environ.get("STRESS_WORKERS", "12"))
 
+    # STRESS_KV_DTYPE selects the pool dtype ("int8" covers the
+    # quantized scale pools through every admission/retire path);
+    # unset, the run's fixed RNG flips a reproducible coin.
+    kv_dtype = os.environ.get("STRESS_KV_DTYPE")
+    if kv_dtype is None:
+        kv_dtype = "int8" if random.Random(0).random() < 0.5 else ""
     cfg = EngineConfig(
         model="tiny-llama", tokenizer="byte", dtype="float32",
+        kv_dtype=kv_dtype,
         max_decode_slots=4, page_size=8, num_pages=96, max_seq_len=64,
         prefill_buckets=(16, 32), max_new_tokens_cap=24,
         draft_model="tiny-llama", spec_gamma=3, top_p_candidates=32,
         prefix_cache=True, lookahead_blocks=3, decode_block_steps=4,
     )
+    print(f"kv_dtype={kv_dtype or 'fp'}", flush=True)
     eng = InferenceEngine(cfg)
     svc = TpuService(eng)
     rng = random.Random(0)

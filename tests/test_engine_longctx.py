@@ -184,3 +184,15 @@ def test_spec_engine_chunked_prefill():
     plain, _ = _run_one(LONG_CONFIG, prompt)
     spec, _ = _run_one(spec_cfg, prompt)
     assert spec == plain
+
+
+def test_int8_kv_chunked_matches_single_window():
+    """Chunked prefill writes through the quantized page-granular path
+    (aligned chunk starts) and decodes through the int8 window: chunked
+    and single-window int8-KV engines must agree exactly."""
+    prompt = _prompt(600, seed=3)
+    chunked, _ = _run_one(
+        dataclasses.replace(LONG_CONFIG, kv_dtype="int8"), prompt)
+    wide, _ = _run_one(
+        dataclasses.replace(WIDE_CONFIG, kv_dtype="int8"), prompt)
+    assert chunked == wide

@@ -207,3 +207,18 @@ def test_spec_prefix_hit_long_suffix_chunks():
     )
     out, _ = _serve(spec_cfg, prompts)
     assert out == ref
+
+
+def test_int8_kv_prefix_hit_matches_uncached():
+    """Prefix caching with int8 KV pools: cached pages hold quantized
+    values + scales in parallel pools indexed by the same page ids, so a
+    warm hit must reproduce the uncached int8-KV engine's tokens
+    exactly (int8-KV vs int8-KV — the quantization is deterministic)."""
+    cfg_q = dataclasses.replace(CFG, kv_dtype="int8")
+    prompts = ["the same long-ish prompt body repeated", ] * 3
+    ref, _ = _serve(
+        dataclasses.replace(cfg_q, prefix_cache=False), prompts)
+    out, stats = _serve(cfg_q, prompts)
+    assert out == ref
+    assert out[0] == out[1] == out[2]
+    assert stats["prefix_hit_tokens"] > 0
