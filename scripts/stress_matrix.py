@@ -23,7 +23,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 
 
-def main() -> None:
+def main(kv_dtype: str = "", seconds: float | None = None) -> None:
     jax.config.update("jax_platforms", "cpu")
 
     from google.protobuf import struct_pb2
@@ -32,15 +32,9 @@ def main() -> None:
     from polykey_tpu.engine.engine import InferenceEngine
     from polykey_tpu.gateway.tpu_service import TpuService
 
-    seconds = float(os.environ.get("STRESS_SECONDS", "120"))
+    if seconds is None:
+        seconds = float(os.environ.get("STRESS_SECONDS", "120"))
     workers = int(os.environ.get("STRESS_WORKERS", "12"))
-
-    # STRESS_KV_DTYPE selects the pool dtype ("int8" covers the
-    # quantized scale pools through every admission/retire path);
-    # unset, the run's fixed RNG flips a reproducible coin.
-    kv_dtype = os.environ.get("STRESS_KV_DTYPE")
-    if kv_dtype is None:
-        kv_dtype = "int8" if random.Random(0).random() < 0.5 else ""
     cfg = EngineConfig(
         model="tiny-llama", tokenizer="byte", dtype="float32",
         kv_dtype=kv_dtype,
@@ -117,4 +111,14 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    # STRESS_KV_DTYPE pins the pool dtype for the whole budget; unset,
+    # the time budget splits across BOTH dtypes so the quantized pools
+    # (scale pools through every admission/retire path) are always
+    # exercised, not left to a coin.
+    pinned = os.environ.get("STRESS_KV_DTYPE")
+    if pinned is not None:
+        main(kv_dtype=pinned)
+    else:
+        budget = float(os.environ.get("STRESS_SECONDS", "120"))
+        main(kv_dtype="", seconds=budget / 2)
+        main(kv_dtype="int8", seconds=budget / 2)

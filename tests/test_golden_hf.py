@@ -244,3 +244,49 @@ def test_tied_llama_matches_hf(tmp_path):
     theirs = _hf_logits(model, tokens)
     np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-3)
     assert (ours.argmax(-1) == theirs.argmax(-1)).all()
+
+
+@pytest.mark.parametrize(
+    "cfg",
+    [TINY_LLAMA, TINY_GEMMA],
+    ids=lambda c: c.name,
+)
+def test_paged_int8_kv_tracks_hf(cfg, tmp_path):
+    """The PAGED serving path with int8 KV pools (the engine's
+    kv_dtype='int8' configuration) against HF's full-precision logits on
+    real architectures: agreement within quantization tolerance — the
+    bound that catches a wrong-axis scale or a mask regression, which
+    land orders of magnitude past it."""
+    from polykey_tpu.engine.kv_cache import init_paged_kv
+    from polykey_tpu.models.transformer import forward_paged
+
+    model, params = _export_hf(cfg, tmp_path)
+    tokens = _tokens(cfg, seed=5)
+    theirs = _hf_logits(model, tokens)
+
+    ps = 4
+    P = (T + ps - 1) // ps + 1
+    pool = init_paged_kv(cfg, 1 + B * P, ps, jnp.float32, kv_dtype=jnp.int8)
+    pt = np.zeros((B, P), np.int32)
+    page = 1
+    for b in range(B):
+        for j in range(P):
+            pt[b, j] = page
+            page += 1
+    pt = jnp.asarray(pt)
+    toks = jnp.asarray(tokens, jnp.int32)
+
+    split = T // 2
+    pos = jnp.broadcast_to(jnp.arange(split, dtype=jnp.int32), (B, split))
+    hidden, pool = forward_paged(params, cfg, toks[:, :split], pos, pool, pt)
+    got = [np.asarray(unembed(params, cfg, hidden), np.float32)]
+    for t in range(split, T):
+        pos_t = jnp.full((B, 1), t, jnp.int32)
+        hidden, pool = forward_paged(
+            params, cfg, toks[:, t:t + 1], pos_t, pool, pt)
+        got.append(np.asarray(unembed(params, cfg, hidden), np.float32))
+    ours = np.concatenate(got, axis=1)
+
+    denom = np.max(np.abs(theirs)) + 1e-6
+    rel = np.max(np.abs(ours - theirs)) / denom
+    assert rel < 0.08, f"int8-KV drift vs HF: {rel:.3f}"
