@@ -38,7 +38,7 @@ def main():
     K = int(sys.argv[4]) if len(sys.argv) > 4 else 16
 
     from polykey_tpu.engine.engine import _decode_fn
-    from polykey_tpu.engine.kv_cache import init_paged_kv
+    from polykey_tpu.engine.kv_cache import init_paged_kv, kv_pool_bytes
     from polykey_tpu.models.config import get_config
     from polykey_tpu.models.transformer import init_params
 
@@ -51,9 +51,15 @@ def main():
     page_size = 16
     pages_per_seq = (ctx + 256 + page_size - 1) // page_size  # headroom to decode into
     total_pages = B * pages_per_seq + 1
-    paged = init_paged_kv(cfg, total_pages, page_size, dtype=jnp.bfloat16)
-    pool_gb = 2 * np.prod(paged.k.shape) * 2 / 1e9
-    log(f"pool: {pool_gb:.2f} GB")
+    kv_int8 = os.environ.get("POLYKEY_PROFILE_KV", "") == "int8"
+    kv_q = jnp.int8 if kv_int8 else None
+    paged = init_paged_kv(
+        cfg, total_pages, page_size, dtype=jnp.bfloat16, kv_dtype=kv_q,
+    )
+    pool_gb = kv_pool_bytes(
+        cfg, total_pages, page_size, dtype=jnp.bfloat16, kv_dtype=kv_q,
+    ) / 1e9
+    log(f"pool: {pool_gb:.2f} GB kv={'int8' if kv_int8 else 'bf16'}")
 
     pt = np.zeros((B, pages_per_seq), np.int32)
     for b in range(B):
@@ -73,7 +79,8 @@ def main():
         )
 
     results = {"model": model, "batch": B, "ctx": ctx, "K": K,
-               "platform": dev.platform, "pool_gb": round(pool_gb, 2)}
+               "platform": dev.platform, "pool_gb": round(pool_gb, 2),
+               "kv": "int8" if kv_int8 else "bf16"}
 
     def run_variant(name, steps, donate, kernel):
         if kernel:

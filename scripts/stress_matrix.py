@@ -46,7 +46,6 @@ def main(kv_dtype: str = "", seconds: float | None = None) -> None:
     print(f"kv_dtype={kv_dtype or 'fp'}", flush=True)
     eng = InferenceEngine(cfg)
     svc = TpuService(eng)
-    rng = random.Random(0)
     errors: list[str] = []
     done_count, cancels = [0], [0]
     deadline = time.monotonic() + seconds

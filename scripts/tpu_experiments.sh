@@ -1,0 +1,61 @@
+#!/bin/bash
+# Throughput-lever sweep for the 8B headline, run AFTER the watcher's
+# baseline bench lands (it polls for that artifact): each experiment is
+# one isolated phase-B/B2 run with a different slots / weight-quant /
+# KV-dtype combination, recorded under perf/bench_exp_*.json. The
+# levers (PERF.md): batch width amortizes the weight read; int4 halves
+# it; int8 KV halves the pool so width can go higher.
+cd /root/repo || exit 1
+LOG=perf/experiments.log
+exec >>"$LOG" 2>&1
+echo "$(date -Is) experiments runner start pid=$$"
+
+# Wait for the watcher's TPU-backed baseline (or an operator touch of
+# perf/experiments_go to force-start).
+while true; do
+  if ls perf/bench_watcher_*.json >/dev/null 2>&1 || [ -f perf/experiments_go ]; then
+    break
+  fi
+  sleep 90
+done
+echo "$(date -Is) baseline present; starting sweep"
+
+run_exp() {
+  name=$1; phase=$2; shift 2
+  ts=$(date +%Y%m%d_%H%M%S)
+  out="perf/bench_exp_${name}_${ts}.json"
+  echo "$(date -Is) exp ${name}: env $*"
+  env "$@" \
+    POLYKEY_BENCH_PHASES="$phase" POLYKEY_BENCH_ISOLATE=0 \
+    POLYKEY_BENCH_PROBE_TRIES=1 POLYKEY_BENCH_PROBE_TIMEOUT=90 \
+    timeout 2400 python bench.py > "$out" 2> "perf/bench_exp_${name}_${ts}.log"
+  rc=$?
+  if grep -q '"platform": "tpu"' "$out" 2>/dev/null; then
+    echo "$(date -Is) exp ${name} rc=${rc} -> ${out}"
+  else
+    echo "$(date -Is) exp ${name} rc=${rc} NOT tpu-backed (tunnel flap?); kept for log"
+  fi
+}
+
+# Baseline already measured B@32 int8. Sweep the levers:
+run_exp b_slots48      B  POLYKEY_BENCH_8B_SLOTS=48
+run_exp b_kv8_slots64  B  POLYKEY_BENCH_8B_SLOTS=64 POLYKEY_BENCH_KV_DTYPE=int8
+run_exp b2_int4_s48    B2 POLYKEY_BENCH_8B_INT4_SLOTS=48
+run_exp b2_int4_kv8_s64 B2 POLYKEY_BENCH_8B_INT4_SLOTS=64 POLYKEY_BENCH_KV_DTYPE=int8
+
+echo "$(date -Is) sweep done"
+for f in perf/bench_exp_*.json; do
+  python - "$f" <<'EOF'
+import json, sys
+try:
+    d = json.load(open(sys.argv[1]))
+    det = d.get("details", {})
+    for k in ("engine_8b_int8", "engine_8b_int4"):
+        if k in det and "tok_s" in det[k]:
+            sc = det[k].get("step_costs", {})
+            print(f"{sys.argv[1]}: {k} {det[k]['tok_s']} tok/s "
+                  f"lanes={sc.get('avg_lanes')} ttft={det[k].get('p50_ttft_ms')}")
+except Exception as e:
+    print(f"{sys.argv[1]}: unreadable ({e})")
+EOF
+done
