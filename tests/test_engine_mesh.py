@@ -250,3 +250,16 @@ def test_tp2_int8_kv_matches_single_device(reference_outputs):
     assert _run_prompts_for(
         dataclasses.replace(cfg_kv, tp=2), PROMPTS
     ) == _run_prompts_for(cfg_kv, PROMPTS)
+
+
+def test_sp2_int8_kv_matches_single_device(reference_outputs):
+    """sp=2 with int8 KV: sequence-parallel prefill writes quantized
+    pages into the sp-replicated (values, scales) pools via GSPMD, and
+    context-parallel decode merges the quantized kernel's partial
+    softmax states across the page sub-ranges. Greedy equality vs the
+    single-device int8-KV engine."""
+    del reference_outputs
+    cfg_kv = dataclasses.replace(BASE_CONFIG, kv_dtype="int8")
+    assert _run_prompts_for(
+        dataclasses.replace(cfg_kv, sp=2), PROMPTS
+    ) == _run_prompts_for(cfg_kv, PROMPTS)
