@@ -515,7 +515,8 @@ _PHASE_KEYS = (
 )
 
 
-def _run_isolated(result: dict, headline_only: bool) -> None:
+def _run_isolated(result: dict, headline_only: bool,
+                  phases: list | None = None) -> None:
     """Run each phase in its own subprocess (POLYKEY_BENCH_PHASES=<name>)
     and merge their details into one artifact. A wedged backend client
     (the r03 failure: one UNIMPLEMENTED dispatch poisoned the in-process
@@ -523,9 +524,12 @@ def _run_isolated(result: dict, headline_only: bool) -> None:
     costs only its own phase. Children share the fabricated-tree disk
     cache and the persistent XLA compile cache, so per-child setup is
     mmap + cache hits; child stderr streams through live."""
-    phases = [p for p, _ in _PHASE_KEYS]
-    if headline_only:
-        phases = ["0", "B"]
+    if phases is None:
+        phases = [p for p, _ in _PHASE_KEYS]
+        if headline_only:
+            phases = ["0", "B"]
+    order = [p for p, _ in _PHASE_KEYS]
+    phases = [p for p in order if p in phases]
     keys = dict(_PHASE_KEYS)
     # Operator skips (the child would honor these and produce no entry,
     # which the no-entry branch below would misread as a tunnel flap):
@@ -631,8 +635,14 @@ def main() -> None:
     def phase_on(name: str) -> bool:
         return selected is None or name in selected
 
-    if (selected is None and os.environ.get(
-            "POLYKEY_BENCH_ISOLATE", "1" if on_tpu else "0") == "1"):
+    isolate = os.environ.get(
+        "POLYKEY_BENCH_ISOLATE", "1" if on_tpu else "0") == "1"
+    if isolate and selected is not None and len(selected) > 1:
+        # Explicit ISOLATE over a phase subset: contain wedges between
+        # the selected phases too (each child gets one phase).
+        _run_isolated(result, headline_only, phases=sorted(selected))
+        return
+    if isolate and selected is None:
         _run_isolated(result, headline_only)
         return
     # 128 requests ≈ 16k tokens: enough steady-state that ramp/tail don't

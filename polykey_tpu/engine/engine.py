@@ -597,6 +597,19 @@ class InferenceEngine:
         self.dead: Optional[str] = None
         self.last_progress = time.monotonic()
 
+        import os as _os
+
+        self._trace_acc = (
+
+            {"iters": 0}
+
+            if _os.environ.get("POLYKEY_LOOP_TRACE", "") == "1"
+
+            else None
+
+        )
+
+
         self._thread = threading.Thread(
             target=self._run, name="polykey-engine", daemon=True
         )
@@ -653,15 +666,14 @@ class InferenceEngine:
     # -- engine thread ------------------------------------------------------
 
     def _run(self) -> None:
-        # POLYKEY_LOOP_TRACE=1: accumulate wall time per loop phase and
-        # print a summary to stderr every 100 iterations — the tool that
-        # found the r03 host-side serialization (PERF.md). Near-zero cost
-        # when off (one getenv at thread start, no timers taken).
-        import os as _os
-
-        trace = _os.environ.get("POLYKEY_LOOP_TRACE", "") == "1"
-        tacc: dict = {"iters": 0}
-        self._trace_acc = tacc if trace else None
+        # POLYKEY_LOOP_TRACE=1 (read once at CONSTRUCTION — engine
+        # __init__ sets _trace_acc, so a caller toggling the env after
+        # the constructor returns cannot race this thread): accumulate
+        # wall time per loop phase and print a summary to stderr every
+        # 100 iterations — the tool that found the r03 host-side
+        # serialization (PERF.md). Near-zero cost when off.
+        trace = self._trace_acc is not None
+        tacc: dict = self._trace_acc if trace else {"iters": 0}
 
         def _t() -> float:
             return time.monotonic() if trace else 0.0

@@ -158,11 +158,15 @@ def paged_write(
         return pools[0], pools[1]
 
     if T == 1:
-        from .paged_attention_kernel import use_paged_kernel
+        from .paged_attention_kernel import (
+            use_paged_kernel,
+            use_quantized_paged_kernel,
+        )
 
         Hk, D = data_pool.shape[2], data_pool.shape[3]
         pp = mesh.shape.get("pp", 1) if mesh is not None else 1
-        if use_paged_kernel(Hk, D) and pp == 1:
+        gate = use_quantized_paged_kernel if quantized else use_paged_kernel
+        if gate(Hk, D) and pp == 1:
             return repack(_write_decode_kernel(
                 writes, page_ids[:, 0], offsets[:, 0], mesh,
             ))

@@ -367,6 +367,21 @@ def _decode_call(
     return acc, m[..., :1], l[..., :1]
 
 
+def use_quantized_paged_kernel(num_kv_heads: int, head_dim: int) -> bool:
+    """Gate for the int8-KV kernel paths (read dequant stage + scale-page
+    writes): same geometry rule as the data pools, plus the dedicated
+    POLYKEY_DISABLE_KV_KERNEL kill-switch — the scale-page DMAs
+    ([ps, Hk], minor dim far below lane width) are a separate Mosaic
+    lowering surface, and a regression there must be containable without
+    taking the WORKING fp kernels down with it (the quantized fallback
+    is the int8 gather/scatter, still half the bf16 bytes)."""
+    import os
+
+    if os.environ.get("POLYKEY_DISABLE_KV_KERNEL", "").lower() in ("1", "true"):
+        return False
+    return use_paged_kernel(num_kv_heads, head_dim)
+
+
 def use_paged_kernel(num_kv_heads: int, head_dim: int) -> bool:
     """The DMA kernel needs TPU hardware; the folded head-lane dimension
     (num_kv_heads · head_dim) must be 128-aligned for DMA tiling.
@@ -417,7 +432,8 @@ def paged_attention_decode(
     data_pool = k_pages[0] if quantized else k_pages
     Hk, D = data_pool.shape[2], data_pool.shape[3]
 
-    if not (force_kernel or interpret or use_paged_kernel(Hk, D)):
+    gate = use_quantized_paged_kernel if quantized else use_paged_kernel
+    if not (force_kernel or interpret or gate(Hk, D)):
         from .paged_attention import paged_attention
 
         return paged_attention(

@@ -64,12 +64,13 @@ def main():
     log(f"10 independent dispatches: issue={t_dispatch*1000:.1f} ms, "
         f"complete={t_total*1000:.1f} ms")
 
-    # tiny-result D2H: what a per-block token fetch costs
-    small = jax.jit(lambda x: x.sum())(x)
-    small.block_until_ready()
+    # tiny-result D2H: what a per-block token fetch costs (reuse ONE
+    # jitted fn — a fresh jit per iteration times re-tracing, not fetch)
+    small_fn = jax.jit(lambda x: x.sum())
+    np.asarray(small_fn(x))
     t0 = time.monotonic()
     for _ in range(5):
-        np.asarray(jax.jit(lambda x: x.sum())(x))
+        np.asarray(small_fn(x))
     log(f"small-result fetch: {(time.monotonic()-t0)/5*1000:.1f} ms")
 
     # 3. int4 probe last (may wedge the backend)

@@ -815,6 +815,9 @@ def test_admission_keeps_slots_occupied():
     )
     import os as _os
 
+    # The engine latches the trace flag at CONSTRUCTION (engine.__init__
+    # sets _trace_acc), so popping right after the constructor returns
+    # cannot race the engine thread.
     _os.environ["POLYKEY_LOOP_TRACE"] = "1"
     try:
         engine = InferenceEngine(cfg)
