@@ -759,12 +759,13 @@ def main() -> None:
             t0 = time.monotonic()
             params8 = fabricate_params(cfg8, "bfloat16", quantize=True)
             log(f"fabricated 8B int8 tree in {time.monotonic() - t0:.1f}s")
-            # 32 slots x 512 positions = 1024 pages at full occupancy
-            # (+ reserved garbage page + slack): ~2 GiB of KV next to
-            # ~8.5 GiB of int8 weights on a 16 GiB chip. Batch width is
-            # the single-chip throughput lever while decode stays
-            # weight-bandwidth-bound.
-            slots8 = int(os.environ.get("POLYKEY_BENCH_8B_SLOTS", "32"))
+            # 48 slots x 512 positions = 1536 pages at full occupancy
+            # (~3.2 GiB of KV next to ~8.5 GiB of int8 weights on a
+            # 16 GiB chip — a safe margin). Batch width is the
+            # single-chip throughput lever while decode stays
+            # weight-bandwidth-bound: tok/s scales ~linearly in slots
+            # until compute-per-step grows past the weight read.
+            slots8 = int(os.environ.get("POLYKEY_BENCH_8B_SLOTS", "48"))
             cfg_b = EngineConfig(
                 kv_dtype=kv_dtype,
                 model="llama-3-8b",

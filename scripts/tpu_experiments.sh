@@ -37,8 +37,8 @@ run_exp() {
   fi
 }
 
-# Baseline already measured B@32 int8. Sweep the levers:
-run_exp b_slots48      B  POLYKEY_BENCH_8B_SLOTS=48
+# Baseline (watcher bench) now measures B@48 int8. Sweep around it:
+run_exp b_slots32      B  POLYKEY_BENCH_8B_SLOTS=32
 run_exp b_kv8_slots64  B  POLYKEY_BENCH_8B_SLOTS=64 POLYKEY_BENCH_KV_DTYPE=int8
 run_exp b2_int4_s48    B2 POLYKEY_BENCH_8B_INT4_SLOTS=48
 run_exp b2_int4_kv8_s64 B2 POLYKEY_BENCH_8B_INT4_SLOTS=64 POLYKEY_BENCH_KV_DTYPE=int8
