@@ -734,6 +734,9 @@ class InferenceEngine:
                     _acc("dispatch", t0)
                     if trace:
                         tacc["blocks"] = tacc.get("blocks", 0) + 1
+                        tacc["max_depth"] = max(
+                            tacc.get("max_depth", 0), self._depth_target
+                        )
                         tacc["disp_steps"] = (
                             tacc.get("disp_steps", 0)
                             + self._last_dispatch_steps
@@ -1390,7 +1393,12 @@ class InferenceEngine:
             spec_candidates = (
                 0 if all_untruncated else self.config.top_p_candidates
             )
-            self._depth_target = self._depth   # spec rounds: full-size blocks
+            # Spec rounds: full-size blocks; >= 1 token lands per round,
+            # so `remaining` rounds always suffice (same tail-work cap
+            # as the plain path).
+            self._depth_target = min(
+                self._depth, max(1, self._remaining_budget(act))
+            )
             return (
                 "spec",
                 self._dispatch_spec(dev, spec_candidates),
@@ -1406,8 +1414,18 @@ class InferenceEngine:
             self._solo_steps if int(act.sum()) == 1 else self._block_steps
         )
         self._last_dispatch_steps = steps
+        # Constant steps-in-flight across block sizes — but never more
+        # than the active streams still NEED: every in-flight step costs
+        # a full weight-read on device even when its lanes have stopped,
+        # so lookahead past the longest remaining budget burns device
+        # time at stream tails and queues real latency in front of the
+        # next arrival's prefill (a solo stream at K=2 used to keep 64
+        # steps ≈ 0.9 s of dead work in flight).
+        remaining = self._remaining_budget(act)
+        blocks_needed = max(1, -(-remaining // max(1, steps)))
         self._depth_target = min(
-            64, self._depth * (self._block_steps // max(1, steps))
+            64, self._depth * (self._block_steps // max(1, steps)),
+            blocks_needed,
         )
         with jax.profiler.TraceAnnotation("polykey/decode"):
             (packed_dev, last_dev, seq_dev, act_dev,
@@ -1454,6 +1472,11 @@ class InferenceEngine:
         k = request.top_k
         C = self.config.top_p_candidates
         return min(k, C) if (C > 0 and k > 0) else k
+
+    def _remaining_budget(self, act) -> int:
+        """Longest remaining token budget over active lanes (host
+        mirrors) — the tail-work cap both dispatch paths share."""
+        return int(np.max(np.where(act, self._caps - self._seq_lens, 0)))
 
     def _snapshot_requests(self):
         """Per-slot request identities at dispatch time: with cross-block

@@ -623,24 +623,45 @@ def test_adaptive_block_solo_vs_loaded():
     static_cfg = dataclasses.replace(cfg, adaptive_block=False)
 
     def run_solo(config):
-        eng = InferenceEngine(config)
+        import os as _os
+
+        prior = _os.environ.get("POLYKEY_LOOP_TRACE")
+        _os.environ["POLYKEY_LOOP_TRACE"] = "1"
+        try:
+            eng = InferenceEngine(config)
+        finally:
+            if prior is None:
+                _os.environ.pop("POLYKEY_LOOP_TRACE", None)
+            else:
+                _os.environ["POLYKEY_LOOP_TRACE"] = prior
         try:
             r = GenRequest(prompt="adaptive probe", max_new_tokens=12)
             eng.submit(r)
             tokens, done, error = _collect(r)
             assert error is None and done is not None
-            return tokens, eng._last_dispatch_steps, eng._depth_target
+            acc = eng._trace_acc or {}
+            return (tokens, eng._last_dispatch_steps, eng._depth_target,
+                    acc.get("max_depth", 0))
         finally:
             eng.shutdown()
 
-    solo_tokens, solo_k, solo_depth = run_solo(cfg)
-    static_tokens, static_k, static_depth = run_solo(static_cfg)
+    solo_tokens, solo_k, solo_tail_depth, solo_max = run_solo(cfg)
+    static_tokens, static_k, static_tail_depth, static_max = run_solo(
+        static_cfg)
     assert solo_k == 1 and static_k == 8
     assert solo_tokens == static_tokens
-    # Constant steps-in-flight: shrinking K deepens the pipeline by the
-    # same factor (depth x block_time must keep covering the roundtrip).
-    assert solo_depth == cfg.lookahead_blocks * 8
-    assert static_depth == cfg.lookahead_blocks
+    # Constant steps-in-flight MID-STREAM: shrinking K deepens the
+    # pipeline (depth x block_time keeps covering the roundtrip), up to
+    # the stream's remaining budget (12 new tokens -> ~12 blocks at K=1).
+    assert solo_max >= 10, solo_max
+    assert solo_max <= cfg.lookahead_blocks * 8
+    # Tail cap: in-flight work never exceeds what active streams still
+    # need — the final dispatches shrink to one block, so stream tails
+    # don't leave ~lookahead x K steps of dead full-batch work queued in
+    # front of the next arrival's prefill.
+    assert solo_tail_depth == 1, solo_tail_depth
+    assert static_tail_depth == 1, static_tail_depth
+    assert static_max <= cfg.lookahead_blocks
 
     # Under load (>1 active stream) the adaptive engine uses the full K.
     eng = InferenceEngine(cfg)
