@@ -18,14 +18,6 @@ while true; do
   if timeout 60 python -c "import jax; d=jax.devices()[0]; print(d.platform, d.device_kind)" 2>/dev/null | grep -q tpu; then
     echo "$(date -Is) tunnel LIVE"
     ts=$(date +%Y%m%d_%H%M%S)
-    if [ ! -f perf/kernel_check_ok ]; then
-      timeout 2400 python scripts/tpu_kernel_check.py > "perf/kernel_check_${ts}.txt" 2>&1
-      kc_rc=$?
-      echo "$(date -Is) kernel-check rc=${kc_rc} -> perf/kernel_check_${ts}.txt"
-      if [ "$kc_rc" -eq 0 ]; then
-        echo "perf/kernel_check_${ts}.txt" > perf/kernel_check_ok
-      fi
-    fi
     if [ ! -f "perf/tunnel_probe_ok" ]; then
       timeout 300 python scripts/probe_tunnel.py > "perf/tunnel_probe_${ts}.txt" 2>&1
       probe_rc=$?
@@ -50,6 +42,18 @@ while true; do
       > "perf/bench_watcher_${ts}.json" 2> "perf/bench_watcher_${ts}.log"
     bench_rc=$?
     echo "$(date -Is) bench attempt ${BENCH_TRIES}/${MAX_BENCH_TRIES} rc=${bench_rc} -> perf/bench_watcher_${ts}.json"
+    # Kernel-check AFTER the bench: a short tunnel window should land the
+    # headline number first — the bench self-rescues from kernel compile
+    # failures anyway, and the check's own compile set got bigger (write
+    # kernel + both int8-KV stages per geometry).
+    if [ ! -f perf/kernel_check_ok ]; then
+      timeout 2400 python scripts/tpu_kernel_check.py > "perf/kernel_check_${ts}.txt" 2>&1
+      kc_rc=$?
+      echo "$(date -Is) kernel-check rc=${kc_rc} -> perf/kernel_check_${ts}.txt"
+      if [ "$kc_rc" -eq 0 ]; then
+        echo "perf/kernel_check_${ts}.txt" > perf/kernel_check_ok
+      fi
+    fi
     # Only stop once a real TPU artifact with an actual throughput number
     # landed: a tunnel flap mid-run makes bench fall back to CPU (rc=0,
     # "platform": "cpu"), and a TPU-stamped run whose every engine phase
