@@ -66,6 +66,9 @@ dryrun: ## Compile-check the multi-chip sharded step on a virtual mesh
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	  $(PYTHON) -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
 
+multiproc-demo: ## 2-process jax.distributed train+serve on localhost CPU
+	bash scripts/run_multiproc_demo.sh
+
 clean: ## Remove build artifacts and caches
 	rm -rf $(BUILD_DIR) .pytest_cache
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

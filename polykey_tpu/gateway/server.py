@@ -217,6 +217,14 @@ def _default_service(logger: Logger, health: Optional[HealthService] = None) -> 
             except RuntimeError:
                 pass  # backend already initialized
 
+        # Multi-host bootstrap BEFORE the engine initializes the backend:
+        # under POLYKEY_COORDINATOR/NUM_PROCESSES/PROCESS_ID (or a TPU
+        # pod runtime) every host's chips join one global device list, so
+        # the engine's mesh can span hosts. Single-host no-op.
+        from ..parallel.distributed import initialize_from_env
+
+        initialize_from_env(logger)
+
         from .tpu_service import TpuService
 
         return TpuService.from_env(health=health, logger=logger)

@@ -55,10 +55,19 @@ def initialize_from_env(logger=None) -> bool:
                 logger.warn("jax.distributed auto-init skipped", error=str(e))
             return False
 
+    if not (coordinator and num_procs and proc_id is not None):
+        # Half-set env would reach jax.distributed.initialize with Nones
+        # and die with an opaque error; name the missing knobs instead.
+        raise ValueError(
+            "partial distributed config: POLYKEY_COORDINATOR, "
+            "POLYKEY_NUM_PROCESSES and POLYKEY_PROCESS_ID must be set "
+            f"together (coordinator={coordinator!r}, "
+            f"num_processes={num_procs!r}, process_id={proc_id!r})"
+        )
     jax.distributed.initialize(
         coordinator_address=coordinator,
-        num_processes=int(num_procs) if num_procs else None,
-        process_id=int(proc_id) if proc_id else None,
+        num_processes=int(num_procs),
+        process_id=int(proc_id),
     )
     if logger is not None:
         logger.info(

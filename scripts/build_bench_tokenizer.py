@@ -25,15 +25,23 @@ import random
 import string
 
 
-def synth_corpus(n_docs: int = 4000, seed: int = 7):
+def synth_corpus(n_docs: int = 4000, seed: int = 7, n_words: int = 20_000):
     """Mixed prose/code/unicode documents — enough byte-pair diversity
-    that training fills the whole vocab budget."""
+    that training fills the whole vocab budget.
+
+    Byte-level BPE merges stay inside pre-tokenized units (words), so the
+    reachable vocab is bounded by the distinct frequent words and their
+    prefixes: a 128k vocab (Llama-3 size) needs a much larger word pool
+    than 32k does. Callers pass n_words scaled to the vocab target."""
     rng = random.Random(seed)
     words = [
         "".join(rng.choice(string.ascii_lowercase)
-                for _ in range(rng.randint(2, 10)))
-        for _ in range(20_000)
+                for _ in range(rng.randint(2, 12)))
+        for _ in range(n_words)
     ]
+    # Zipf-ish reuse: sampling uniformly from a huge pool makes every
+    # word rare (few merges get frequent enough); bias toward a head.
+    head = words[: max(2000, n_words // 10)]
     common = ["the", "of", "and", "to", "in", "is", "that", "for", "with",
               "model", "token", "server", "stream", "request", "engine",
               "attention", "decode", "cache", "batch", "layer"]
@@ -46,7 +54,9 @@ def synth_corpus(n_docs: int = 4000, seed: int = 7):
     for _ in range(n_docs):
         n = rng.randint(20, 120)
         doc = " ".join(
-            rng.choice(common) if rng.random() < 0.4 else rng.choice(words)
+            rng.choice(common) if rng.random() < 0.3
+            else rng.choice(head) if rng.random() < 0.5
+            else rng.choice(words)
             for _ in range(n)
         )
         if rng.random() < 0.2:
@@ -73,7 +83,13 @@ def main() -> int:
         initial_alphabet=tokenizers.pre_tokenizers.ByteLevel.alphabet(),
         show_progress=False,
     )
-    tok.train_from_iterator(synth_corpus(), trainer)
+    # Corpus sized to the vocab target: merges stay inside pre-tokenized
+    # words, so filling a 128k vocab needs a proportionally larger pool
+    # of repeated words than the 32k default does.
+    n_words = max(20_000, args.vocab)
+    n_docs = max(4000, args.vocab // 4)
+    tok.train_from_iterator(
+        synth_corpus(n_docs=n_docs, n_words=n_words), trainer)
 
     os.makedirs(args.out, exist_ok=True)
     tok.save(os.path.join(args.out, "tokenizer.json"))

@@ -1,0 +1,18 @@
+#!/bin/bash
+# Two-process jax.distributed demo on localhost CPU: rank 0 is the
+# coordinator, each rank owns 2 virtual devices, and the hybrid DCN mesh
+# runs one train + one serving step with dp crossing the process boundary
+# (tests/multiproc_worker.py). Same path a real multi-host deployment
+# takes via POLYKEY_COORDINATOR / POLYKEY_NUM_PROCESSES /
+# POLYKEY_PROCESS_ID (parallel/distributed.py:initialize_from_env).
+set -e
+cd "$(dirname "$0")/.."
+PORT=${1:-9921}
+python tests/multiproc_worker.py 0 2 "$PORT" &
+P0=$!
+python tests/multiproc_worker.py 1 2 "$PORT" &
+P1=$!
+# Separate waits: `wait p1 p2` returns only the LAST pid's status, which
+# would mask a rank-0 failure.
+wait $P0
+wait $P1

@@ -39,6 +39,11 @@ run_exp() {
 
 # Baseline (watcher bench) now measures B@48 int8. Sweep around it:
 run_exp b_slots32      B  POLYKEY_BENCH_8B_SLOTS=32
+# Equal-slots int8-KV: vs the @48 baseline this isolates the KV-dtype
+# cost/benefit itself (dequant work vs halved KV reads); the @64 run
+# below adds the capacity win. Together they decide the default
+# (VERDICT r3 next #7).
+run_exp b_kv8_slots48  B  POLYKEY_BENCH_8B_SLOTS=48 POLYKEY_BENCH_KV_DTYPE=int8
 run_exp b_kv8_slots64  B  POLYKEY_BENCH_8B_SLOTS=64 POLYKEY_BENCH_KV_DTYPE=int8
 run_exp b2_int4_s48    B2 POLYKEY_BENCH_8B_INT4_SLOTS=48
 run_exp b2_int4_kv8_s64 B2 POLYKEY_BENCH_8B_INT4_SLOTS=64 POLYKEY_BENCH_KV_DTYPE=int8

@@ -21,18 +21,30 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def _slope_ms(run_ms, n: int = 20) -> float:
+def _slope_ms(run_ms, n: int = 20, repeats: int = 3) -> float:
     """Per-call ms via the two-length slope: (wall_2n - wall_n) / n.
 
     block_until_ready is a NO-OP on the axon backend (PERF.md), so
     `run_ms(m)` must execute m calls and sync via a small
     materialization (np.asarray of a scalar slice); the slope cancels
     the tunnel's constant dispatch+sync overhead, which is both large
-    and variable here."""
+    and variable here. A single (w1, w2) pair is still one tunnel-latency
+    sample away from nonsense (r03 logs swung 17.94->15.61 ms between
+    same-minute runs), so take the median slope over `repeats` pairs and
+    report the spread so readers can judge the number's stability."""
     run_ms(2)                       # warm (compile already done by caller)
-    w1 = run_ms(n)
-    w2 = run_ms(2 * n)
-    return (w2 - w1) / n * 1e3
+    slopes = []
+    for _ in range(repeats):
+        w1 = run_ms(n)
+        w2 = run_ms(2 * n)
+        slopes.append((w2 - w1) / n * 1e3)
+    slopes.sort()
+    med = slopes[len(slopes) // 2]
+    spread = slopes[-1] - slopes[0]
+    if med > 0 and spread > 0.5 * med:
+        print(f"    [slope spread {spread:.2f} ms over {repeats} pairs "
+              f"(median {med:.2f}) — treat with caution]")
+    return med
 
 
 def _paged_inputs(B, Hq, Hk, D, ps, P, dtype, seed=0):
@@ -316,6 +328,30 @@ def check_flash() -> None:
             print(f"flash {label}: err={err:.2e} "
                   f"({time.monotonic() - t0:.1f}s inc. compile)")
             assert err < tol, f"flash kernel mismatch ({label}): {err}"
+
+            # Timed slope for the serving-dtype long-context case only
+            # (bounds compile time): flash kernel vs the materialized
+            # XLA attention it replaces in prefill.
+            if label == "2k-bf16":
+                timed = {}
+                for name, fn in [
+                    ("kernel", lambda: flash_attention(
+                        q, k, v, qpos, scale=0.088, force_kernel=True)),
+                    ("xla", lambda: attention(
+                        q, k, v, make_attention_mask(qpos, S),
+                        scale=0.088)),
+                ]:
+                    def run(m, fn=fn):
+                        t0 = time.monotonic()
+                        out = None
+                        for _ in range(m):
+                            out = fn()
+                        np.asarray(jnp.sum(out[0, 0, 0]))
+                        return time.monotonic() - t0
+                    timed[name] = _slope_ms(run, n=10)
+                print(f"flash {label} per-call: kernel "
+                      f"{timed['kernel']:.2f} ms, xla {timed['xla']:.2f} ms "
+                      f"({timed['xla'] / max(timed['kernel'], 1e-9):.2f}x)")
         except Exception as e:
             print(f"flash {label} FAILED: {type(e).__name__}: {e}")
             failures.append(f"flash {label}: {e}")
