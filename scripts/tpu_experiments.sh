@@ -28,6 +28,7 @@ run_exp() {
   env "$@" \
     POLYKEY_BENCH_PHASES="$phase" POLYKEY_BENCH_ISOLATE=0 \
     POLYKEY_BENCH_PROBE_TRIES=1 POLYKEY_BENCH_PROBE_TIMEOUT=90 \
+    POLYKEY_BENCH_NO_REPLAY=1 \
     timeout 2400 python bench.py > "$out" 2> "perf/bench_exp_${name}_${ts}.log"
   rc=$?
   if grep -q '"platform": "tpu"' "$out" 2>/dev/null; then

@@ -37,7 +37,10 @@ while true; do
       HEADLINE_ONLY=1
       echo "$(date -Is) escalating to POLYKEY_BENCH_HEADLINE_ONLY=1"
     fi
+    # NO_REPLAY: the watcher exists to land LIVE hardware runs; replaying
+    # its own previous artifact would terminate the loop vacuously.
     POLYKEY_BENCH_PROBE_TRIES=1 POLYKEY_BENCH_HEADLINE_ONLY=$HEADLINE_ONLY \
+      POLYKEY_BENCH_NO_REPLAY=1 \
       timeout 7200 python bench.py \
       > "perf/bench_watcher_${ts}.json" 2> "perf/bench_watcher_${ts}.log"
     bench_rc=$?
