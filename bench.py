@@ -183,7 +183,7 @@ def _latest_tpu_artifact() -> tuple[str, dict] | None:
       file can never masquerade as this round's measurement."""
     import glob
 
-    perf_dir = os.path.join(
+    perf_dir = os.environ.get("POLYKEY_BENCH_PERF_DIR") or os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "perf")
     max_age_s = 3600 * float(
         os.environ.get("POLYKEY_BENCH_REPLAY_MAX_AGE_H", "14"))

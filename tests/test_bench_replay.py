@@ -1,0 +1,83 @@
+"""Selection rules of the bench's TPU-artifact replay path.
+
+When the tunnel is down at official-bench time, bench.py replays the best
+TPU-backed watcher artifact (bench.py:_latest_tpu_artifact) instead of
+emitting another CPU fallback. These rules decide what lands in the
+round's official artifact, so they are pinned here:
+- CPU-fallback / failed / already-replayed artifacts are never selected;
+- experiment-sweep artifacts (non-default configs) are excluded;
+- a target-comparable 8B line (vs_baseline non-null) beats a NEWER
+  partial rescue artifact;
+- artifacts older than the age bound are ignored.
+"""
+
+import json
+import os
+import time
+
+import bench
+
+
+def _write(dirpath, name, line, age_s=0.0):
+    path = os.path.join(dirpath, name)
+    with open(path, "w") as f:
+        json.dump(line, f)
+    if age_s:
+        t = time.time() - age_s
+        os.utime(path, (t, t))
+    return path
+
+
+def _tpu_line(metric="llama3_8b_int8_engine_tok_s_per_chip",
+              value=2000.0, vs_baseline=1.0, **extra):
+    return {"metric": metric, "value": value, "unit": "tok/s",
+            "vs_baseline": vs_baseline,
+            "details": {"platform": "tpu"}, **extra}
+
+
+def _select(tmp_path, monkeypatch):
+    monkeypatch.setenv("POLYKEY_BENCH_PERF_DIR", str(tmp_path))
+    return bench._latest_tpu_artifact()
+
+
+def test_no_artifacts_returns_none(tmp_path, monkeypatch):
+    assert _select(tmp_path, monkeypatch) is None
+
+
+def test_ineligible_artifacts_skipped(tmp_path, monkeypatch):
+    cpu = _tpu_line()
+    cpu["details"]["platform"] = "cpu"
+    _write(tmp_path, "bench_watcher_a.json", cpu)
+    _write(tmp_path, "bench_watcher_b.json",
+           {"metric": "bench_failed", "value": 0.0,
+            "details": {"platform": "tpu"}})
+    _write(tmp_path, "bench_watcher_c.json",
+           _tpu_line(replayed_from="perf/earlier.json"))
+    # Experiment artifacts run non-default configs: never the headline.
+    _write(tmp_path, "bench_exp_kv8.json", _tpu_line(value=9999.0))
+    assert _select(tmp_path, monkeypatch) is None
+
+
+def test_8b_beats_newer_partial(tmp_path, monkeypatch):
+    _write(tmp_path, "bench_watcher_full.json",
+           _tpu_line(value=2345.6), age_s=3600)
+    _write(tmp_path, "bench_watcher_rescue.json",
+           _tpu_line(metric="llama-1b-bench_engine_tok_s_per_chip",
+                     value=900.0, vs_baseline=None))
+    path, line = _select(tmp_path, monkeypatch)
+    assert path.endswith("bench_watcher_full.json")
+    assert line["value"] == 2345.6
+
+
+def test_newest_8b_wins_and_age_bound(tmp_path, monkeypatch):
+    _write(tmp_path, "bench_watcher_old.json",
+           _tpu_line(value=2100.0), age_s=7200)
+    _write(tmp_path, "bench_watcher_new.json", _tpu_line(value=2200.0))
+    path, line = _select(tmp_path, monkeypatch)
+    assert path.endswith("bench_watcher_new.json")
+
+    # Everything aged out -> no replay.
+    for name in ("bench_watcher_old.json", "bench_watcher_new.json"):
+        t = time.time() - 15 * 3600
+        os.utime(os.path.join(tmp_path, name), (t, t))
+    assert _select(tmp_path, monkeypatch) is None
