@@ -20,7 +20,29 @@ Bootstrap env (standard JAX multi-process contract, overridable for tests):
 On TPU pods these are auto-detected from the metadata server, so
 `initialize_from_env()` with no env set simply calls
 `jax.distributed.initialize()` when running under a multi-host runtime and
-is a no-op on a single host.
+is a no-op on a single host. The gateway server calls this before engine
+init (gateway/server.py:_default_service), and the path is executed for
+real — two localhost processes, gloo collectives across the boundary —
+by tests/test_distributed_multiproc.py / scripts/run_multiproc_demo.sh.
+
+Multi-host SERVING topology (design note): JAX is multi-controller — every
+process must dispatch identical programs in identical order — so the
+engine's dynamic scheduler (admissions, block sizing, spec-gamma dial)
+cannot make independent per-host decisions against one shared mesh.
+Two deployment shapes follow:
+- **tp/pp within a host, dp across hosts, one engine per host** (the
+  shape this framework ships): each host runs its own gateway + engine
+  on its local chips; a stateless gRPC load balancer spreads requests.
+  No cross-host collective is on the decode path at all, which is
+  strictly better than DCN attention reads; the hybrid-mesh path
+  (EngineConfig.num_slices) covers the single-controller multi-slice
+  case where one process owns several ICI domains.
+- A model too large for one host's chips (tp spanning hosts) requires
+  lock-step scheduling: every host runs the same engine loop on the
+  same request stream (rank 0 broadcasts admissions via the mesh, as in
+  the multiproc train test). Supported by the sharded step functions;
+  the scheduler-broadcast harness is deliberately not built until a
+  target deployment needs it — the reference has no analog.
 """
 
 from __future__ import annotations

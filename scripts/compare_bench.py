@@ -28,6 +28,7 @@ PHASES = [
     ("engine_8b_int4", "B2 8B int4"),
     ("engine_ttft_tokenized", "A-tok real-BPE TTFT"),
     ("prefix_cache", "A2 prefix cache"),
+    ("grpc_e2e", "G  gRPC e2e"),
     ("engine_longctx", "D  long context"),
     ("engine_moe", "E  moe (mixtral-bench)"),
     ("engine_spec", "C  spec ceiling"),
@@ -64,6 +65,9 @@ def _phase_line(name: str, d: dict, old: dict | None) -> str:
                      ("cold_ttft_ms", "cold {:.1f}ms"),
                      ("p50_warm_ttft_ms", "warm {:.1f}ms"),
                      ("host_encode_ms", "encode {:.2f}ms"),
+                     ("p50_e2e_ttft_ms", "e2e-ttft {:.1f}ms"),
+                     ("saturated_e2e_ttft_ms", "e2e-ttft(sat) {:.1f}ms"),
+                     ("gateway_overhead_ms", "gw-overhead {:.1f}ms"),
                      ("spec_acceptance", "acc {:.2f}")):
         if key in d:
             bits.append(fmt.format(d[key]))
@@ -94,6 +98,9 @@ def main() -> int:
 
     print(f"platform: {nd.get('platform', '?')}"
           + (f"   (prior: {od.get('platform', '?')})" if old else ""))
+    if "replayed_from" in new:
+        print(f"REPLAYED artifact: {new['replayed_from']} "
+              f"(measured {new.get('measured_at', '?')})")
     if "kernels_disabled" in nd:
         print(f"!! Pallas kernels were DISABLED: {nd['kernels_disabled'][:90]}")
 

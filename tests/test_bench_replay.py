@@ -37,6 +37,9 @@ def _tpu_line(metric="llama3_8b_int8_engine_tok_s_per_chip",
 
 def _select(tmp_path, monkeypatch):
     monkeypatch.setenv("POLYKEY_BENCH_PERF_DIR", str(tmp_path))
+    # Pin the age bound: an ambient operator override would change which
+    # fixtures age out.
+    monkeypatch.setenv("POLYKEY_BENCH_REPLAY_MAX_AGE_H", "14")
     return bench._latest_tpu_artifact()
 
 
