@@ -165,6 +165,90 @@ def probe_backend() -> str | None:
     return None
 
 
+def _artifact_timestamp(path: str, line: dict) -> float:
+    """Measurement time of a bench artifact, most-trustworthy first:
+    the watcher's filename timestamp (bench_watcher_%Y%m%d_%H%M%S.json,
+    local time — the watcher stamps with `date +%Y%m%d_%H%M%S`), an
+    embedded measured_at field (UTC), a date-only filename stamp, the
+    file's last git commit time, then mtime. mtime alone is unsafe
+    (ADVICE r4): a git checkout resets mtimes to checkout time, so a
+    committed previous-round artifact would look brand-new — the git
+    commit time catches exactly that case; mtime is only reached for
+    uncommitted files, where it is genuinely the write time."""
+    import calendar
+    import re
+
+    m = re.search(r"(\d{8}_\d{6})", os.path.basename(path))
+    if m:
+        try:
+            return time.mktime(time.strptime(m.group(1), "%Y%m%d_%H%M%S"))
+        except ValueError:
+            pass
+    measured = line.get("measured_at")
+    if isinstance(measured, str):
+        try:
+            return calendar.timegm(
+                time.strptime(measured, "%Y-%m-%dT%H:%M:%SZ"))
+        except ValueError:
+            pass
+    # Date-only stamps (bench_2026-07-30_*.json).
+    m = re.search(r"(\d{4}-\d{2}-\d{2})", os.path.basename(path))
+    if m:
+        try:
+            return time.mktime(time.strptime(m.group(1), "%Y-%m-%d"))
+        except ValueError:
+            pass
+    try:
+        out = subprocess.run(
+            ["git", "-C", os.path.dirname(os.path.abspath(path)),
+             "log", "-1", "--format=%at", "--", path],
+            capture_output=True, text=True, timeout=15)
+        if out.returncode == 0 and out.stdout.strip():
+            return float(out.stdout.strip())
+    except Exception:
+        pass
+    return os.path.getmtime(path)
+
+
+def _scan_artifacts(perf_dir: str, max_age_s: float,
+                    include_prefix: str = "bench_",
+                    exclude_prefixes: tuple = ()) -> tuple | None:
+    """Shared artifact scan: glob perf_dir for eligible (replayable,
+    in-age-bound) bench lines and return the winner as (path, line, ts),
+    preferring target-comparable (vs_baseline non-null) then newest.
+    Both replay paths select through here so the rules can't drift."""
+    import glob
+
+    candidates = []
+    for path in glob.glob(os.path.join(perf_dir, include_prefix + "*.json")):
+        name = os.path.basename(path)
+        if name.startswith(exclude_prefixes):
+            continue
+        try:
+            with open(path) as f:
+                line = json.load(f)
+            ts = _artifact_timestamp(path, line)
+        except Exception:
+            continue
+        if _replayable(line) and time.time() - ts <= max_age_s:
+            is_8b = line.get("vs_baseline") is not None
+            candidates.append(((is_8b, ts), path, line))
+    if not candidates:
+        return None
+    (_, ts), path, line = max(candidates, key=lambda c: c[0])
+    return path, line, ts
+
+
+def _replayable(line: dict) -> bool:
+    """A TPU-backed, non-failed, not-already-replayed bench line."""
+    det = line.get("details", {})
+    return (det.get("platform") == "tpu"
+            and line.get("metric") != "bench_failed"
+            and "replayed_from" not in line
+            and isinstance(line.get("value"), (int, float))
+            and line["value"] > 0)
+
+
 def _latest_tpu_artifact() -> tuple[str, dict] | None:
     """Best TPU-backed, non-failed bench artifact from this round's
     watcher runs. The r3 failure mode: real hardware numbers landed
@@ -181,33 +265,65 @@ def _latest_tpu_artifact() -> tuple[str, dict] | None:
       partial one (a HEADLINE_ONLY rescue that only landed phase A);
     - bounded age (default 14 h ≈ one round) so a stale previous-round
       file can never masquerade as this round's measurement."""
-    import glob
-
     perf_dir = os.environ.get("POLYKEY_BENCH_PERF_DIR") or os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "perf")
     max_age_s = 3600 * float(
         os.environ.get("POLYKEY_BENCH_REPLAY_MAX_AGE_H", "14"))
-    candidates = []
-    for path in glob.glob(os.path.join(perf_dir, "bench_watcher_*.json")):
-        try:
-            with open(path) as f:
-                line = json.load(f)
-            mtime = os.path.getmtime(path)
-        except Exception:
-            continue
-        det = line.get("details", {})
-        if (det.get("platform") == "tpu"
-                and line.get("metric") != "bench_failed"
-                and "replayed_from" not in line
-                and isinstance(line.get("value"), (int, float))
-                and line["value"] > 0
-                and time.time() - mtime <= max_age_s):
-            is_8b = line.get("vs_baseline") is not None
-            candidates.append(((is_8b, mtime), path, line))
-    if not candidates:
+    found = _scan_artifacts(perf_dir, max_age_s,
+                            include_prefix="bench_watcher_")
+    if found is None:
         return None
-    _, path, line = max(candidates, key=lambda c: c[0])
+    path, line, _ = found
     return path, line
+
+
+def _prior_round_tpu_artifact() -> tuple[str, dict, dict] | None:
+    """Cross-round fallback: the best committed TPU-backed artifact from a
+    PREVIOUS round, used only when this round's watcher landed nothing
+    (the r4 failure: a full-round outage left no current artifact, so the
+    official line fell back to CPU even though r3's real TPU evidence sat
+    in perf/). Age-bounded (default 14 days) and emitted with explicit
+    provenance {round, date, engine_rev} so a stale number can never
+    masquerade as a fresh measurement.
+
+    Scans ALL committed bench artifacts including watcher-named ones
+    (a prior round's TPU watcher artifact is legitimate evidence — only
+    the 14 h current-round bound excludes it from the primary path);
+    experiment sweeps (non-default configs) and failed runs stay out."""
+    import re
+
+    perf_dir = os.environ.get("POLYKEY_BENCH_PERF_DIR") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "perf")
+    max_age_s = 86400 * float(
+        os.environ.get("POLYKEY_BENCH_XROUND_MAX_AGE_DAYS", "14"))
+    found = _scan_artifacts(
+        perf_dir, max_age_s,
+        exclude_prefixes=("bench_exp_", "bench_failed_"))
+    if found is None:
+        return None
+    path, line, ts = found
+
+    name = os.path.basename(path)
+    m = re.search(r"_r(\d+)", name)
+    rnd = f"r{int(m.group(1)):02d}" if m else "unknown"
+    rev = ""
+    try:
+        out = subprocess.run(
+            ["git", "-C", os.path.dirname(os.path.abspath(__file__)),
+             "log", "--diff-filter=A", "--format=%h", "-1", "--",
+             os.path.relpath(path,
+                             os.path.dirname(os.path.abspath(__file__)))],
+            capture_output=True, text=True, timeout=15)
+        rev = out.stdout.strip()
+    except Exception:
+        pass
+    provenance = {
+        "round": rnd,
+        "date": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(ts)),
+        "engine_rev": rev or "unknown",
+        "cross_round": True,
+    }
+    return path, line, provenance
 
 
 def fabricate_params(cfg, dtype, quantize: bool, bits: int = 8):
@@ -503,7 +619,14 @@ def _compose_line(result: dict) -> dict:
     int8/int4: both are "Llama-3-8B greedy decode on one chip";
     quantization width is an implementation choice the target doesn't
     constrain), else the phase-A number with vs_baseline null (ADVICE r1:
-    no apples-to-oranges ratio)."""
+    no apples-to-oranges ratio).
+
+    A non-TPU run can no longer headline a tok/s number (VERDICT r4
+    weak #1: four CPU artifacts in a row were honest on inspection but
+    shaped like wins): the headline becomes `no_tpu_evidence`, with the
+    CPU measurement relegated to cpu_reference + details.
+    POLYKEY_BENCH_ALLOW_CPU_HEADLINE=1 restores the old shape for local
+    development runs that are deliberately CPU."""
     baseline = 2000.0  # BASELINE.md: tok/s/chip, 8B-class greedy on v5e
 
     def valid(key):
@@ -519,7 +642,7 @@ def _compose_line(result: dict) -> dict:
     )
     if best is not None:
         qname, phase_best = best
-        return {
+        line = {
             "metric": f"llama3_8b_{qname}_engine_tok_s_per_chip",
             "value": phase_best["tok_s"],
             "unit": "tok/s",
@@ -527,9 +650,9 @@ def _compose_line(result: dict) -> dict:
             "p50_ttft_ms": phase_best["p50_ttft_ms"],
             "details": result,
         }
-    if "tok_s" in result.get("engine_1b", {}):
+    elif "tok_s" in result.get("engine_1b", {}):
         a = result["engine_1b"]
-        return {
+        line = {
             "metric": "{}_engine_tok_s_per_chip".format(a["model"]),
             "value": a["tok_s"],
             "unit": "tok/s",
@@ -537,13 +660,35 @@ def _compose_line(result: dict) -> dict:
             "p50_ttft_ms": a["p50_ttft_ms"],
             "details": result,
         }
-    return {
-        "metric": "bench_failed",
-        "value": 0.0,
-        "unit": "tok/s",
-        "vs_baseline": None,
-        "details": result,
-    }
+    else:
+        return {
+            "metric": "bench_failed",
+            "value": 0.0,
+            "unit": "tok/s",
+            "vs_baseline": None,
+            "details": result,
+        }
+    if (result.get("platform") != "tpu"
+            and os.environ.get(
+                "POLYKEY_BENCH_ALLOW_CPU_HEADLINE", "") != "1"):
+        return {
+            "metric": "no_tpu_evidence",
+            "value": 0.0,
+            "unit": "none",
+            "vs_baseline": None,
+            "note": ("no TPU measurement this run and no replayable TPU "
+                     "artifact; the CPU-platform numbers under "
+                     "cpu_reference/details are NOT comparable to the "
+                     "2,000 tok/s target"),
+            "cpu_reference": {
+                "metric": line["metric"],
+                "value": line["value"],
+                "unit": line["unit"],
+                "p50_ttft_ms": line.get("p50_ttft_ms"),
+            },
+            "details": result,
+        }
+    return line
 
 
 _PHASE_KEYS = (
@@ -659,13 +804,36 @@ def main() -> None:
             line["replayed_from"] = os.path.relpath(
                 path, os.path.dirname(os.path.abspath(__file__)))
             line["measured_at"] = time.strftime(
-                "%Y-%m-%dT%H:%M:%SZ", time.gmtime(os.path.getmtime(path)))
+                "%Y-%m-%dT%H:%M:%SZ",
+                time.gmtime(_artifact_timestamp(path, line)))
             line["live_probe"] = (
                 "tpu backend unavailable at emit time; this line replays "
                 f"the TPU-backed watcher artifact measured at "
                 f"{line['measured_at']}"
             )
             log(f"replaying TPU artifact {path}")
+            print(json.dumps(line), flush=True)
+            return
+        # No current-round evidence at all (the r4 failure mode: a
+        # full-round outage). Carry the last real TPU number forward
+        # with cross-round provenance rather than emitting a CPU
+        # headline or nothing.
+        prior = _prior_round_tpu_artifact()
+        if prior is not None:
+            path, line, provenance = prior
+            line["replayed_from"] = os.path.relpath(
+                path, os.path.dirname(os.path.abspath(__file__)))
+            line["provenance"] = provenance
+            line["measured_at"] = provenance["date"]
+            line["live_probe"] = (
+                "tpu backend unavailable for the ENTIRE round; this line "
+                f"replays the {provenance['round']} TPU artifact measured "
+                f"at {provenance['date']} (engine_rev "
+                f"{provenance['engine_rev']}). It is NOT a fresh "
+                "measurement of the current engine."
+            )
+            log(f"cross-round replay of TPU artifact {path} "
+                f"({provenance['round']})")
             print(json.dumps(line), flush=True)
             return
 

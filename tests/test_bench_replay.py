@@ -84,3 +84,90 @@ def test_newest_8b_wins_and_age_bound(tmp_path, monkeypatch):
         t = time.time() - 15 * 3600
         os.utime(os.path.join(tmp_path, name), (t, t))
     assert _select(tmp_path, monkeypatch) is None
+
+
+def test_filename_timestamp_beats_mtime(tmp_path, monkeypatch):
+    # ADVICE r4: a git checkout resets mtime to checkout time, so a
+    # previous-round watcher artifact would look brand-new by mtime. The
+    # filename timestamp is authoritative when present.
+    old_ts = time.strftime("%Y%m%d_%H%M%S", time.localtime(
+        time.time() - 20 * 3600))
+    _write(tmp_path, f"bench_watcher_{old_ts}.json", _tpu_line(value=2100.0))
+    # mtime is "now" (just written) but the embedded timestamp is 20 h old
+    # -> aged out of the 14 h bound.
+    assert _select(tmp_path, monkeypatch) is None
+
+
+def _select_prior(tmp_path, monkeypatch):
+    monkeypatch.setenv("POLYKEY_BENCH_PERF_DIR", str(tmp_path))
+    monkeypatch.setenv("POLYKEY_BENCH_XROUND_MAX_AGE_DAYS", "14")
+    return bench._prior_round_tpu_artifact()
+
+
+def test_prior_round_selection_and_provenance(tmp_path, monkeypatch):
+    # Experiment/failed artifacts are never eligible; round artifacts are.
+    _write(tmp_path, "bench_exp_kv8.json", _tpu_line(value=9999.0))
+    _write(tmp_path, "bench_failed_y.json", _tpu_line(value=50.0))
+    _write(tmp_path, "bench_stdout_r03.json", _tpu_line(value=117.9))
+    path, line, prov = _select_prior(tmp_path, monkeypatch)
+    assert path.endswith("bench_stdout_r03.json")
+    assert prov["round"] == "r03"
+    assert prov["cross_round"] is True
+    assert set(prov) >= {"round", "date", "engine_rev"}
+
+
+def test_prior_round_accepts_aged_watcher_artifact(tmp_path, monkeypatch):
+    # A prior round's TPU watcher artifact in its normal on-disk name is
+    # legitimate evidence: aged out of the 14 h current-round bound, it
+    # must still be reachable by the cross-round path (code-review r5:
+    # the initial exclusion made normal watcher evidence unreplayable).
+    old_ts = time.strftime("%Y%m%d_%H%M%S",
+                           time.localtime(time.time() - 2 * 86400))
+    _write(tmp_path, f"bench_watcher_{old_ts}.json", _tpu_line(value=2000.0))
+    assert _select(tmp_path, monkeypatch) is None  # current-round: aged out
+    path, line, prov = _select_prior(tmp_path, monkeypatch)
+    assert path.endswith(f"bench_watcher_{old_ts}.json")
+
+
+def test_prior_round_age_bound(tmp_path, monkeypatch):
+    _write(tmp_path, "bench_stdout_r03.json", _tpu_line(value=117.9),
+           age_s=20 * 86400)
+    assert _select_prior(tmp_path, monkeypatch) is None
+
+
+def test_prior_round_prefers_comparable_then_newest(tmp_path, monkeypatch):
+    partial = _tpu_line(metric="llama-1b-bench_engine_tok_s_per_chip",
+                        value=900.0, vs_baseline=None)
+    _write(tmp_path, "bench_partial_r04.json", partial)
+    _write(tmp_path, "bench_stdout_r03.json", _tpu_line(value=117.9),
+           age_s=86400)
+    path, line, prov = _select_prior(tmp_path, monkeypatch)
+    assert path.endswith("bench_stdout_r03.json")
+
+
+def test_compose_cpu_run_headlines_no_tpu_evidence(monkeypatch):
+    monkeypatch.delenv("POLYKEY_BENCH_ALLOW_CPU_HEADLINE", raising=False)
+    result = {"platform": "cpu",
+              "engine_1b": {"model": "tiny-llama", "tok_s": 2923.0,
+                            "p50_ttft_ms": 12.0}}
+    line = bench._compose_line(result)
+    assert line["metric"] == "no_tpu_evidence"
+    assert line["value"] == 0.0
+    assert line["vs_baseline"] is None
+    assert line["cpu_reference"]["value"] == 2923.0
+    assert line["details"]["engine_1b"]["tok_s"] == 2923.0
+
+    # The explicit dev override restores the old CPU shape.
+    monkeypatch.setenv("POLYKEY_BENCH_ALLOW_CPU_HEADLINE", "1")
+    line = bench._compose_line(result)
+    assert line["metric"] == "tiny-llama_engine_tok_s_per_chip"
+    assert line["value"] == 2923.0
+
+
+def test_compose_tpu_headline_unchanged():
+    result = {"platform": "tpu",
+              "engine_8b_int8": {"tok_s": 2100.0, "p50_ttft_ms": 90.0}}
+    line = bench._compose_line(result)
+    assert line["metric"] == "llama3_8b_int8_engine_tok_s_per_chip"
+    assert line["value"] == 2100.0
+    assert line["vs_baseline"] == 1.05
