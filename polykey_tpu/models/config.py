@@ -69,6 +69,16 @@ class ModelConfig:
         head = 0 if self.tie_embeddings else embed
         return embed + self.num_layers * block + self.hidden_size + head
 
+    def num_active_params(self) -> int:
+        """Parameters touched per token: for MoE, only the router plus the
+        top-k routed experts count (roofline math — per-token FLOPs scale
+        with active params, not total)."""
+        if not self.is_moe:
+            return self.num_params()
+        return self.num_params() - (
+            self.num_layers * 3 * self.hidden_size * self.intermediate_size
+            * (self.num_experts - self.num_experts_per_tok))
+
 
 LLAMA3_8B = ModelConfig(
     name="llama-3-8b",
