@@ -29,12 +29,13 @@ real-BPE host encode (the
 locally-trained 32k tokenizer asset under assets/bench_tokenizer, or
 POLYKEY_BENCH_TOKENIZER; a recorded exclusion when absent), A2
 prefix-cache TTFT (cold vs warm suffix prefill), D long-context (2k
-prompts / 4k positions, chunked prefill), C speculative serving with
+prompts / 4k positions, chunked prefill), D2 long-context XL (8k
+prompts / 16k positions), C speculative serving with
 draft == target (the acceptance-1.0 ceiling).
 A compile-shaped phase-A failure on TPU retries once with the Pallas
 kill-switches set (kernels_disabled recorded in the artifact).
 
-Run order is 0, A, B, B2, A-tok, A2, G, D, E, C, C2 — the headline phases
+Run order is 0, A, B, B2, A-tok, A2, G, D, D2, E, C, C2 — the headline phases
 (B int8, B2 int4; the JSON line takes the better) run as early as
 possible so a tunnel flap mid-bench still leaves a target-comparable
 number in the artifact. POLYKEY_BENCH_SKIP_8B_INT4=1 skips B2.
@@ -732,6 +733,7 @@ _PHASE_KEYS = (
     ("A2", "prefix_cache"),
     ("G", "grpc_e2e"),
     ("D", "engine_longctx"),
+    ("D2", "engine_longctx_xl"),
     ("E", "engine_moe"),
     ("C", "engine_spec"),
     ("C2", "engine_gemma_spec"),
@@ -1427,6 +1429,38 @@ def main() -> None:
         except Exception as e:
             log(f"phase D failed: {e}")
             result["engine_longctx"] = {"error": str(e)}
+
+    # --- Phase D2: the 16k tier (VERDICT r4 #5 — 8k-prompt/16k-position
+    # serving; SURVEY §5 "sequences beyond one chip's HBM" is covered by
+    # sp/CP in the dryrun, this phase prices the single-chip envelope:
+    # 8 slots x 16k x 32 KiB KV = 4 GiB next to the 1B bf16 weights). ---
+    if (on_tpu and not headline_only and phase_on("D2")
+            and os.environ.get("POLYKEY_BENCH_SKIP_LONGCTX", "") != "1"):
+        try:
+            log("--- phase D2: long-context XL (8k prompt / 16k positions) ---")
+            cfg_d2 = EngineConfig(
+                kv_dtype=kv_dtype,
+                model=model_a,
+                dtype="bfloat16",
+                max_decode_slots=8,
+                page_size=16,
+                num_pages=8 * 1024 + 64,
+                max_seq_len=16384,
+                prefill_buckets=(512,),
+                prefill_chunk=512,
+                max_new_tokens_cap=max_new,
+                decode_block_steps=block,
+                lookahead_blocks=lookahead,
+                compile_warmup=True,
+                warm_sampled_variants=False,
+            )
+            result["engine_longctx_xl"] = {
+                "model": model_a,
+                **bench_engine(cfg_d2, None, 8, 8192, max_new),
+            }
+        except Exception as e:
+            log(f"phase D2 failed: {e}")
+            result["engine_longctx_xl"] = {"error": str(e)}
 
     # --- Phase E: MoE serving — measurement config 4's mechanism on one
     # chip. mixtral-bench keeps the 8x7B architecture (8 experts, top-2,

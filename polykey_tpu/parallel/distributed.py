@@ -64,7 +64,7 @@ def initialize_from_env(logger=None) -> bool:
     num_procs = os.environ.get("POLYKEY_NUM_PROCESSES")
     proc_id = os.environ.get("POLYKEY_PROCESS_ID")
 
-    if coordinator is None and num_procs is None:
+    if coordinator is None and num_procs is None and proc_id is None:
         # No explicit config: only auto-initialize under a real multi-host
         # TPU runtime (where JAX can discover peers); never on CPU/dev.
         if os.environ.get("TPU_WORKER_HOSTNAMES", "").count(",") == 0:
@@ -77,15 +77,30 @@ def initialize_from_env(logger=None) -> bool:
                 logger.warn("jax.distributed auto-init skipped", error=str(e))
             return False
 
-    if not (coordinator and num_procs and proc_id is not None):
-        # Half-set env would reach jax.distributed.initialize with Nones
-        # and die with an opaque error; name the missing knobs instead.
+    # ANY of the three set = explicit config (ADVICE r4: a lone
+    # POLYKEY_PROCESS_ID used to fall through the auto branch silently).
+    # All three must be present, non-empty, and the counts int-parseable —
+    # otherwise jax.distributed.initialize dies with an opaque error.
+    def _int_ok(v):
+        try:
+            int(v)
+            return True
+        except (TypeError, ValueError):
+            return False
+
+    if not (coordinator and num_procs and proc_id
+            and _int_ok(num_procs) and _int_ok(proc_id)):
         raise ValueError(
             "partial distributed config: POLYKEY_COORDINATOR, "
             "POLYKEY_NUM_PROCESSES and POLYKEY_PROCESS_ID must be set "
-            f"together (coordinator={coordinator!r}, "
+            "together, non-empty, with integer counts "
+            f"(coordinator={coordinator!r}, "
             f"num_processes={num_procs!r}, process_id={proc_id!r})"
         )
+    if jax.distributed.is_initialized():
+        # Keep the documented idempotency on the explicit path too (ADVICE
+        # r4: a second _default_service build in one process would crash).
+        return True
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=int(num_procs),

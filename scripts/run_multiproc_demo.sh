@@ -12,6 +12,10 @@ python tests/multiproc_worker.py 0 2 "$PORT" &
 P0=$!
 python tests/multiproc_worker.py 1 2 "$PORT" &
 P1=$!
+# A dead rank must take the survivor with it (ADVICE r4: under set -e a
+# rank-0 failure exited at `wait $P0`, orphaning rank 1 to hang against
+# the dead coordinator until its own timeout).
+trap 'kill $P0 $P1 2>/dev/null || true' EXIT
 # Separate waits: `wait p1 p2` returns only the LAST pid's status, which
 # would mask a rank-0 failure.
 wait $P0

@@ -54,8 +54,30 @@ def test_mesh_from_env(monkeypatch):
 def test_initialize_from_env_is_noop_without_config(monkeypatch):
     monkeypatch.delenv("POLYKEY_COORDINATOR", raising=False)
     monkeypatch.delenv("POLYKEY_NUM_PROCESSES", raising=False)
+    monkeypatch.delenv("POLYKEY_PROCESS_ID", raising=False)
     monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
     assert initialize_from_env() is False
+
+
+def test_initialize_from_env_partial_config_raises(monkeypatch):
+    """ANY of the three knobs set = explicit config; half-set, empty, or
+    non-integer values must raise the named error, not fall through to
+    the auto branch or die inside jax.distributed (ADVICE r4)."""
+    for env in (
+        {"POLYKEY_PROCESS_ID": "0"},                    # lone rank
+        {"POLYKEY_COORDINATOR": "localhost:9999"},      # lone coordinator
+        {"POLYKEY_COORDINATOR": "localhost:9999",       # empty rank
+         "POLYKEY_NUM_PROCESSES": "2", "POLYKEY_PROCESS_ID": ""},
+        {"POLYKEY_COORDINATOR": "localhost:9999",       # non-integer count
+         "POLYKEY_NUM_PROCESSES": "two", "POLYKEY_PROCESS_ID": "0"},
+    ):
+        for k in ("POLYKEY_COORDINATOR", "POLYKEY_NUM_PROCESSES",
+                  "POLYKEY_PROCESS_ID"):
+            monkeypatch.delenv(k, raising=False)
+        for k, v in env.items():
+            monkeypatch.setenv(k, v)
+        with pytest.raises(ValueError, match="partial distributed config"):
+            initialize_from_env()
 
 
 def test_hybrid_mesh_train_step_matches_flat_mesh():
