@@ -8,7 +8,7 @@ CXXFLAGS ?= -O2 -std=c++17 -Wall -Wextra
 BUILD_DIR := build
 
 .PHONY: help run run-client test test-models native protos clean bench dryrun \
-	kernel-check tunnel-probe bench-tokenizer tpu-watch
+	kernel-check tunnel-probe bench-tokenizer tpu-watch metrics-smoke
 
 help: ## Show available targets
 	@grep -E '^[a-zA-Z_-]+:.*?## .*$$' $(MAKEFILE_LIST) | \
@@ -44,6 +44,9 @@ protos: ## Regenerate protobuf stubs from protos/
 
 bench: ## Run the benchmark harness (prints one JSON line)
 	$(PYTHON) bench.py
+
+metrics-smoke: ## Boot the stack on CPU, scrape /metrics, assert required families
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/metrics_smoke.py
 
 kernel-check: ## Compile + compare the Pallas kernels on real TPU
 	$(PYTHON) scripts/tpu_kernel_check.py

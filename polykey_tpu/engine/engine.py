@@ -44,7 +44,10 @@ import time
 import traceback
 from dataclasses import dataclass, field
 from functools import partial
-from typing import Optional
+from typing import TYPE_CHECKING, Optional
+
+if TYPE_CHECKING:
+    from ..obs.trace import Span
 
 import jax
 import jax.numpy as jnp
@@ -87,6 +90,12 @@ class GenRequest:
     out: queue.Queue = field(default_factory=queue.Queue)
     cancelled: threading.Event = field(default_factory=threading.Event)
     timings: RequestTimings = field(default_factory=RequestTimings)
+    # Root span attached by the gateway; None means the request is
+    # untraced and the engine records no spans for it (bench and embedder
+    # paths pay zero tracing cost). The engine appends queue_wait /
+    # prefill / decode children; decode gets per-block children as blocks
+    # are processed.
+    trace: Optional["Span"] = None
 
 
 @dataclass
@@ -118,6 +127,13 @@ class _Slot:
     seed_row: Optional[np.ndarray] = None   # [2] int32 RNG root halves
     prompt_len: int = 0
     prompt_ids: Optional[np.ndarray] = None  # for prefix-cache insertion
+    # Open "decode" span for traced requests (None otherwise): opened when
+    # the first token resolves, closed by _finish; per-block children are
+    # appended by _process_step/_process_spec.
+    decode_span: Optional["Span"] = None
+    # End of this slot's previous emit window (first-token resolve or the
+    # last processed block) — the inter-token-latency clock.
+    last_emit: float = 0.0
 
 
 def _prefill_fn(
@@ -642,6 +658,7 @@ class InferenceEngine:
                 "pages_free": self.allocator.num_free,
                 "pages_total": self.config.num_pages,
                 "queued": self._submit.qsize(),
+                "inflight_blocks": len(self._inflight_q),
             }
         )
         if self._spec:
@@ -903,6 +920,16 @@ class InferenceEngine:
             self.allocator.release_all(matched)     # drop lookup's refs
             raise
         pages = matched + fresh
+        if request.trace is not None:
+            # Recorded only after allocation succeeds: an AllocationError
+            # requeues the request and re-enters this method, and the
+            # span tree must hold ONE queue_wait covering the whole wait
+            # (enqueue through the attempt that actually admitted).
+            request.trace.child(
+                "queue_wait",
+                start=request.timings.enqueued,
+                end=request.timings.prefill_start,
+            )
 
         page_table = np.zeros((1, cfg.pages_per_seq), dtype=np.int32)
         page_table[0, : len(pages)] = pages
@@ -1296,6 +1323,20 @@ class InferenceEngine:
             self._prefix.insert(slot.prompt_ids, slot.pages)
         self._last_tokens[slot_idx] = token
         request.timings.first_token = time.monotonic()
+        slot.last_emit = request.timings.first_token
+        if request.trace is not None:
+            # Prefill phase: admission tokenize through first-token
+            # delivery (covers bucketed, batched, and chunked prefill —
+            # all funnel through this resolve).
+            request.trace.child(
+                "prefill",
+                start=request.timings.prefill_start,
+                end=request.timings.first_token,
+                prompt_tokens=slot.prompt_len,
+            )
+            slot.decode_span = request.trace.child(
+                "decode", start=request.timings.first_token
+            )
         request.out.put(("token", token))
         self._maybe_finish(slot_idx, token)
 
@@ -1478,6 +1519,41 @@ class InferenceEngine:
         mirrors) — the tail-work cap both dispatch paths share."""
         return int(np.max(np.where(act, self._caps - self._seq_lens, 0)))
 
+    def _note_block_token(self, slot: _Slot, block_span, before: int,
+                          t_sync: float, **attrs):
+        """Per-token block-span upkeep shared by the plain and spec
+        process paths: lazily open the slot's decode_block child (only
+        traced slots get one) and keep its token count and end time
+        current. Called BEFORE the token (and any terminal event
+        _maybe_finish enqueues) reaches the client — the gateway may
+        snapshot the tree the moment the stream ends, and a child added
+        after that snapshot would be lost."""
+        if slot.decode_span is None:
+            return None
+        if block_span is None:
+            # Clamp to the parent's start: when the slot's first token
+            # resolved within THIS sync, t_sync predates the decode span
+            # opened at first_token, and a child must not begin before
+            # its parent in the rendered tree.
+            block_span = slot.decode_span.child(
+                "decode_block",
+                start=max(t_sync, slot.decode_span.start),
+                **attrs,
+            )
+        block_span.set(tokens=slot.generated - before)
+        block_span.end = time.monotonic()
+        return block_span
+
+    def _note_block_done(self, slot: _Slot, before: int) -> None:
+        """Post-block ITL accounting shared by both process paths: the
+        window since the slot's previous emit, amortized per token."""
+        n = slot.generated - before
+        if n > 0:
+            now = time.monotonic()
+            if slot.last_emit > 0:
+                self.metrics.on_itl((now - slot.last_emit) * 1e3 / n, n)
+            slot.last_emit = now
+
     def _snapshot_requests(self):
         """Per-slot request identities at dispatch time: with cross-block
         lookahead a slot can be finished (cancel) and re-admitted while its
@@ -1504,6 +1580,7 @@ class InferenceEngine:
             # drained / all cancelled). Nothing to emit — skip the sync
             # entirely so the drain costs no host↔device roundtrip.
             return
+        t_sync = time.monotonic()
         packed = np.asarray(data)     # [K, B]; blocks until block done
 
         emitted = 0
@@ -1521,6 +1598,8 @@ class InferenceEngine:
                     continue
             # The block's own [K, B] shape, not the configured K — the
             # adaptive dispatcher varies K per block.
+            before = slot.generated
+            block_span = None
             for k in range(packed.shape[0]):
                 token = int(packed[k, i])
                 if token < 0:
@@ -1528,11 +1607,16 @@ class InferenceEngine:
                 slot.generated += 1
                 self._seq_lens[i] += 1
                 self._last_tokens[i] = token
+                block_span = self._note_block_token(
+                    slot, block_span, before, t_sync,
+                    steps=int(packed.shape[0]),
+                )
                 slot.request.out.put(("token", token))
                 emitted += 1
                 self._maybe_finish(i, token)
                 if self._slots[i] is None:  # finished mid-block
                     break
+            self._note_block_done(slot, before)
         self.metrics.on_step(emitted)
 
     def _dispatch_spec(self, dev: dict, candidates: int = 0):
@@ -1568,6 +1652,7 @@ class InferenceEngine:
         (spec_decode_fn), which owns truncation and the untruncated n_acc
         the dial needs."""
         packed_dev, stats_dev = data
+        t_sync = time.monotonic()
         packed = np.asarray(packed_dev)  # [B, gamma+1]; blocks until done
         accepted, proposed = (int(v) for v in np.asarray(stats_dev))
 
@@ -1582,6 +1667,8 @@ class InferenceEngine:
                 self._resolve_slot(i, slot)
                 if self._slots[i] is not slot:
                     continue
+            before = slot.generated
+            block_span = None
             for j in range(packed.shape[1]):
                 token = int(packed[i, j])
                 if token < 0:
@@ -1589,11 +1676,15 @@ class InferenceEngine:
                 slot.generated += 1
                 self._seq_lens[i] += 1
                 self._last_tokens[i] = token
+                block_span = self._note_block_token(
+                    slot, block_span, before, t_sync, spec_round=True,
+                )
                 slot.request.out.put(("token", token))
                 emitted += 1
                 self._maybe_finish(i, token)
                 if self._slots[i] is None:   # finished mid-window
                     break
+            self._note_block_done(slot, before)
         self.metrics.on_step(emitted)
         self.metrics.on_spec(accepted, proposed)
         if proposed > 0 and self._gamma_low != self._gamma_max:
@@ -1627,6 +1718,20 @@ class InferenceEngine:
         request = slot.request
         request.timings.finished = time.monotonic()
         request.timings.completion_tokens = slot.generated
+        if slot.decode_span is not None:
+            slot.decode_span.set(tokens=slot.generated)
+            slot.decode_span.finish(end=request.timings.finished)
+        if request.trace is not None and error is not None:
+            # Cancellation is not a failure label: the gateway cancels on
+            # stop-sequence matches and client disconnects, both of which
+            # end the RPC cleanly (tpu_service._text_events calls the
+            # engine's "cancelled" the EXPECTED outcome). A postmortem
+            # reader must not chase phantom errors on stop-terminated
+            # requests.
+            if error == "cancelled":
+                request.trace.set(cancelled=True)
+            else:
+                request.trace.set(error=error)
         self.allocator.release_all(slot.pages)
         self._slots[slot_idx] = None
         self._active[slot_idx] = False

@@ -4,7 +4,13 @@ The reference's observability is per-RPC duration logging only (SURVEY.md §5
 "metrics"); the engine adds what serving needs: request phase timestamps
 (enqueue → prefill → first token → finish), throughput counters, and pool
 gauges. Snapshots surface through the `engine_stats` tool and per-request
-Usage on the streaming RPC.
+Usage on the streaming RPC; the same state exports in Prometheus text form
+via obs.exposition.engine_collector (ISSUE 1).
+
+TTFT and inter-token latency are histogram-backed (obs.histogram): fixed
+log-spaced buckets give O(1)-memory p50/p90/p95/p99 over the FULL history
+(the old 512-entry ring only saw recent requests and sorted on every
+snapshot) and render directly as Prometheus ``_bucket`` families.
 """
 
 from __future__ import annotations
@@ -12,6 +18,8 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
+
+from ..obs.histogram import Histogram
 
 
 @dataclass
@@ -37,11 +45,8 @@ class RequestTimings:
                 return (self.completion_tokens - 1) / elapsed
         return 0.0
 
-
 class EngineMetrics:
     """Thread-safe counters; cheap enough to update from the step loop."""
-
-    _TTFT_WINDOW = 512   # recent-TTFT ring for percentile gauges
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -52,8 +57,11 @@ class EngineMetrics:
         self.decode_steps = 0
         self.ttft_ms_sum = 0.0
         self.ttft_ms_count = 0
-        self._ttft_ring: list[float] = []
-        self._ttft_ring_pos = 0
+        # Latency histograms (observe() is internally locked; kept outside
+        # self._lock so a scrape rendering them never contends the step
+        # loop's counter lock).
+        self.ttft_hist = Histogram()
+        self.itl_hist = Histogram()
         self.drafts_accepted = 0
         self.drafts_proposed = 0
         self._window_start = time.monotonic()
@@ -76,6 +84,15 @@ class EngineMetrics:
                 self._window_start = now
                 self._window_tokens = 0
 
+    def on_itl(self, gap_ms: float, count: int = 1) -> None:
+        """Record `count` tokens delivered with a per-token gap of
+        `gap_ms` (one decode block's inter-emit window amortized over its
+        tokens). Per-BLOCK measurement, not per-request mean: a 2 s stall
+        between blocks lands in the histogram as 2 s-scale gaps for that
+        block's tokens instead of vanishing into a request average."""
+        if gap_ms > 0:
+            self.itl_hist.observe(gap_ms, count)
+
     def on_spec(self, accepted: int, proposed: int) -> None:
         """Per-round speculative counters; acceptance rate is the speedup
         dial (engine._spec_step counts emitted tokens only — ADVICE r1)."""
@@ -84,21 +101,17 @@ class EngineMetrics:
             self.drafts_proposed += proposed
 
     def on_finish(self, timings: RequestTimings, failed: bool = False) -> None:
+        ttft = timings.ttft_ms
         with self._lock:
             if failed:
                 self.requests_failed += 1
             else:
                 self.requests_completed += 1
-            if timings.ttft_ms > 0:
-                self.ttft_ms_sum += timings.ttft_ms
+            if ttft > 0:
+                self.ttft_ms_sum += ttft
                 self.ttft_ms_count += 1
-                if len(self._ttft_ring) < self._TTFT_WINDOW:
-                    self._ttft_ring.append(timings.ttft_ms)
-                else:
-                    self._ttft_ring[self._ttft_ring_pos] = timings.ttft_ms
-                self._ttft_ring_pos = (
-                    self._ttft_ring_pos + 1
-                ) % self._TTFT_WINDOW
+        if ttft > 0:
+            self.ttft_hist.observe(ttft)
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -107,6 +120,23 @@ class EngineMetrics:
                 if self.ttft_ms_count
                 else 0.0
             )
+            # The throughput window only advances inside on_step, so on an
+            # idle engine the last busy window's rate would be reported
+            # forever (now also scraped as polykey_tokens_per_sec —
+            # phantom throughput on dashboards). Under traffic on_step
+            # flushes the window at ~1s intervals; a window start more
+            # than 5s old means the step loop has gone idle — decay the
+            # gauge (any unflushed remainder tokens are equally stale).
+            if (
+                self.tokens_per_sec > 0.0
+                and time.monotonic() - self._window_start > 5.0
+            ):
+                self.tokens_per_sec = 0.0
+                # Restart the window clean or the first flush after idle
+                # would average the new burst over the whole idle gap and
+                # report ~0 while decoding at full speed.
+                self._window_start = time.monotonic()
+                self._window_tokens = 0
             snap = {
                 "requests_admitted": self.requests_admitted,
                 "requests_completed": self.requests_completed,
@@ -116,20 +146,28 @@ class EngineMetrics:
                 "tokens_per_sec": round(self.tokens_per_sec, 2),
                 "mean_ttft_ms": round(mean_ttft, 2),
             }
-            if self._ttft_ring:
-                # p50/p95 over the recent window — TTFT is half the
-                # north-star metric and its tail, not its mean, is what
-                # operators chase.
-                ordered = sorted(self._ttft_ring)
-                n = len(ordered)
-                snap["p50_ttft_ms"] = round(ordered[n // 2], 2)
-                snap["p95_ttft_ms"] = round(
-                    ordered[min(n - 1, (n * 95) // 100)], 2
-                )
-            if self.drafts_proposed:
-                snap["drafts_accepted"] = self.drafts_accepted
-                snap["drafts_proposed"] = self.drafts_proposed
-                snap["spec_acceptance"] = round(
-                    self.drafts_accepted / self.drafts_proposed, 3
-                )
-            return snap
+            drafts_proposed = self.drafts_proposed
+            drafts_accepted = self.drafts_accepted
+        if self.ttft_hist.count:
+            # TTFT tail percentiles — TTFT is half the north-star metric
+            # and its tail, not its mean, is what operators chase. These
+            # are SINCE-START percentiles (the old p50_ttft_ms/p95_ttft_ms
+            # keys over a recent-512 ring are gone — recency belongs to
+            # the scraper via rate() over the exported buckets, not to a
+            # second windowing scheme in-process).
+            p50, p95, p99 = self.ttft_hist.percentiles(50, 95, 99)
+            snap["ttft_ms_p50"] = round(p50, 2)
+            snap["ttft_ms_p95"] = round(p95, 2)
+            snap["ttft_ms_p99"] = round(p99, 2)
+        if self.itl_hist.count:
+            p50, p95, p99 = self.itl_hist.percentiles(50, 95, 99)
+            snap["itl_ms_p50"] = round(p50, 2)
+            snap["itl_ms_p95"] = round(p95, 2)
+            snap["itl_ms_p99"] = round(p99, 2)
+        if drafts_proposed:
+            snap["drafts_accepted"] = drafts_accepted
+            snap["drafts_proposed"] = drafts_proposed
+            snap["spec_acceptance"] = round(
+                drafts_accepted / drafts_proposed, 3
+            )
+        return snap

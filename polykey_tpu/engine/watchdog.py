@@ -17,11 +17,19 @@ import time
 
 class Watchdog:
     def __init__(self, engine, health=None, logger=None,
-                 check_interval_s: float = 5.0):
+                 check_interval_s: float = 5.0,
+                 recorder=None, stall_counter=None):
         self.engine = engine
         self.health = health
         self.logger = logger
         self.check_interval_s = check_interval_s
+        # Observability hooks (both optional): `recorder` is an
+        # obs.trace.FlightRecorder that gets a "watchdog_stall" event with
+        # the engine state frozen at trip time — the postmortem record the
+        # restarted process would otherwise take to its grave;
+        # `stall_counter` is the Prometheus watchdog_stalls_total counter.
+        self.recorder = recorder
+        self.stall_counter = stall_counter
         self.tripped = False
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -49,6 +57,24 @@ class Watchdog:
             )
             if self.logger is not None:
                 self.logger.error("watchdog tripped", error=message)
+            if self.stall_counter is not None:
+                self.stall_counter.inc()
+            if self.recorder is not None:
+                # Freeze what the engine looked like at trip time.
+                # engine.stats() reads host mirrors and queue sizes only —
+                # non-blocking, safe while the device call is wedged.
+                try:
+                    snap = self.engine.stats()
+                    self.recorder.event(
+                        "watchdog_stall",
+                        message=message,
+                        stalled_for_s=round(stalled_for, 1),
+                        slots_busy=snap["slots_busy"],
+                        queued=snap["queued"],
+                        inflight_blocks=snap["inflight_blocks"],
+                    )
+                except Exception:
+                    pass  # postmortem capture must never mask the trip
             # Only flag and flip health here; slot/allocator state belongs to
             # the engine thread. If that thread ever returns from the wedged
             # device call it sees `dead` and fails in-flight work itself; if

@@ -34,6 +34,7 @@ from ..proto.polykey_v2_grpc import (
     PolykeyServiceServicer,
     add_PolykeyServiceServicer_to_server,
 )
+from ..obs import MetricsHTTPServer, Observability
 from .health import HealthService
 from .interceptor import LoggingInterceptor
 from .jsonlog import Logger
@@ -107,18 +108,23 @@ def build_server(
     address: str = ":50051",
     max_workers: int = 32,
     health: Optional[HealthService] = None,
+    obs: Optional[Observability] = None,
 ):
     """Assemble the fully-wired gRPC server; returns (server, health, port).
 
     An existing HealthService may be passed in so backends created before the
-    server (the engine + its watchdog) can flip serving status.
+    server (the engine + its watchdog) can flip serving status. Passing an
+    `Observability` bundle turns on request tracing (root spans in the
+    interceptor, children from the backend) and RPC counters; the same
+    bundle should be shared with the backend (TpuService) and the /metrics
+    exposition server so all three see one registry and one recorder.
     """
     logger = logger or Logger()
     server = grpc.server(
         futures.ThreadPoolExecutor(
             max_workers=max_workers, thread_name_prefix="polykey-rpc"
         ),
-        interceptors=[LoggingInterceptor(logger)],
+        interceptors=[LoggingInterceptor(logger, obs=obs)],
         options=_KEEPALIVE_OPTIONS,
     )
 
@@ -166,19 +172,24 @@ def serve(service: Optional[Service] = None, address: Optional[str] = None) -> N
     if address is None:
         address = os.environ.get("LISTEN_ADDR") or ":50051"
 
+    obs = Observability()
     health = HealthService()
     if service is None:
         try:
-            service = _default_service(logger, health)
+            service = _default_service(logger, health, obs)
         except Exception as e:
             logger.error("failed to initialize backend", error=str(e))
             raise SystemExit(1)
 
     try:
-        server, health, _ = build_server(service, logger, address, health=health)
+        server, health, _ = build_server(
+            service, logger, address, health=health, obs=obs
+        )
     except OSError as e:
         logger.error("failed to listen", error=str(e))
         raise SystemExit(1)
+
+    metrics_server = _start_metrics_server(obs, logger)
 
     _log_service_table(logger)
 
@@ -194,10 +205,43 @@ def serve(service: Optional[Service] = None, address: Optional[str] = None) -> N
     health.shutdown()
     server.stop(grace=10).wait()
     service.close()
+    if metrics_server is not None:
+        metrics_server.stop()
     logger.info("server stopped")
 
 
-def _default_service(logger: Logger, health: Optional[HealthService] = None) -> Service:
+def _start_metrics_server(
+    obs: Observability, logger: Logger
+) -> Optional[MetricsHTTPServer]:
+    """Prometheus exposition sidecar thread. POLYKEY_METRICS_PORT picks
+    the port (default 9464, the conventional exporter port); 0 disables.
+    A bind failure degrades to no endpoint rather than killing the
+    gateway — the gRPC metrics_text view still works."""
+    port_raw = os.environ.get("POLYKEY_METRICS_PORT", "9464")
+    try:
+        port = int(port_raw)
+    except ValueError:
+        logger.warn("invalid POLYKEY_METRICS_PORT; metrics disabled",
+                    value=port_raw)
+        return None
+    if port <= 0:
+        return None
+    try:
+        metrics_server = MetricsHTTPServer(obs.registry, port=port).start()
+    except OSError as e:
+        logger.warn("metrics endpoint failed to bind; continuing without",
+                    port=port, error=str(e))
+        return None
+    logger.info("metrics endpoint listening", port=metrics_server.port,
+                path="/metrics")
+    return metrics_server
+
+
+def _default_service(
+    logger: Logger,
+    health: Optional[HealthService] = None,
+    obs: Optional[Observability] = None,
+) -> Service:
     """Select the backend: TPU engine when requested, mock otherwise.
 
     The reference hard-wires its mock (main.go:85). Here POLYKEY_BACKEND=tpu
@@ -227,7 +271,7 @@ def _default_service(logger: Logger, health: Optional[HealthService] = None) -> 
 
         from .tpu_service import TpuService
 
-        return TpuService.from_env(health=health, logger=logger)
+        return TpuService.from_env(health=health, logger=logger, obs=obs)
     from .mock_service import MockService
 
     return MockService()

@@ -13,7 +13,12 @@ Tools:
   returns the full completion as string_output; the streaming RPC emits
   incremental UTF-8-safe deltas and a terminal chunk with Usage (TTFT,
   tok/s).
-- ``engine_stats`` — struct_output snapshot of engine metrics and pool state.
+- ``engine_stats`` — struct_output snapshot of engine metrics and pool state,
+  including TTFT/ITL percentiles and the most recent traced request's span
+  tree. ``view: "metrics_text"`` returns the Prometheus text page as
+  string_output (same bytes as the HTTP /metrics endpoint — scrapeable over
+  gRPC when no sidecar port is exposed); ``view: "trace"`` returns the
+  recent span trees + flight-recorder events for postmortems.
 - the reference's mock tools (example_tool / struct_tool / file_tool) keep
   their exact semantics via delegation to MockService, so a client of the
   reference sees no behavior change for non-LLM tools (including the
@@ -24,12 +29,14 @@ from __future__ import annotations
 
 import math
 import queue
+import time
 from typing import Iterator, Optional
 
 from ..engine.config import EngineConfig, enable_persistent_compile_cache
 from ..engine.engine import GenRequest, InferenceEngine
 from ..engine.tokenizer import ByteTokenizer, IncrementalDetokenizer
 from ..engine.watchdog import Watchdog
+from ..obs import Observability, current_span, engine_collector
 from ..proto import common_v2_pb2 as cmn
 from ..proto import polykey_v2_pb2 as pk
 from .mock_service import MockService
@@ -46,16 +53,64 @@ class TpuService(Service):
         watchdog: Optional[Watchdog] = None,
         secrets=None,
         logger=None,
+        obs: Optional[Observability] = None,
     ):
         self.engine = engine
         self.watchdog = watchdog
         self.secrets = secrets      # gateway.security.SecretStore or None
         self.logger = logger
+        self.obs = obs
+        self.stall_counter = None
         self._mock = MockService()
         self._profile_dir: Optional[str] = None
+        if obs is not None:
+            # Bind the engine into the scrape registry. A registry holds
+            # ONE engine's families (the names carry no engine label):
+            # first service to register wins, later services sharing the
+            # Observability (in-process tests) reuse its families. The
+            # stall counter is get-or-created independently so watchdog
+            # accounting never depends on who registered the gauge.
+            from ..obs import Counter, Gauge
+
+            up_gauge, created = obs.registry.get_or_create(
+                Gauge,
+                "polykey_engine_up",
+                "1 while the engine thread is alive.",
+                fn=lambda: 0.0 if engine.dead else 1.0,
+            )
+            if created:
+                obs.registry.register_collector(engine_collector(engine))
+            self.stall_counter, _ = obs.registry.get_or_create(
+                Counter,
+                "polykey_watchdog_stalls_total",
+                "Watchdog trips on a wedged engine step loop.",
+            )
 
     @classmethod
-    def from_env(cls, health=None, logger=None) -> "TpuService":
+    def create(
+        cls, engine: InferenceEngine, health=None, logger=None,
+        secrets=None, obs: Optional[Observability] = None,
+    ) -> "TpuService":
+        """Build a service with its watchdog fully wired. The watchdog is
+        built after the service so its observability hooks (flight-
+        recorder events + stall counter) come from the shared bundle —
+        the ONE place this wiring lives (from_env and the metrics-smoke
+        probe both call it, so they can't drift apart)."""
+        service = cls(engine, None, secrets=secrets, logger=logger, obs=obs)
+        watchdog = Watchdog(
+            engine, health=health, logger=logger,
+            recorder=obs.recorder if obs is not None else None,
+            stall_counter=service.stall_counter,
+        )
+        watchdog.start()
+        service.watchdog = watchdog
+        return service
+
+    @classmethod
+    def from_env(
+        cls, health=None, logger=None,
+        obs: Optional[Observability] = None,
+    ) -> "TpuService":
         from .security import SecretStore
 
         config = EngineConfig.from_env()
@@ -65,8 +120,10 @@ class TpuService(Service):
         # TPU recompiles; POLYKEY_COMPILE_CACHE=0 opts out.
         enable_persistent_compile_cache()
         engine = InferenceEngine(config, health=health, logger=logger)
-        watchdog = Watchdog(engine, health=health, logger=logger)
-        watchdog.start()
+        service = cls.create(
+            engine, health=health, logger=logger,
+            secrets=SecretStore.from_env(logger), obs=obs,
+        )
         if logger is not None:
             logger.info(
                 "engine initialized",
@@ -75,8 +132,7 @@ class TpuService(Service):
                 pages=config.num_pages,
                 page_size=config.page_size,
             )
-        return cls(engine, watchdog,
-                   secrets=SecretStore.from_env(logger), logger=logger)
+        return service
 
     def _resolve_secret(self, secret_id) -> None:
         """Resolve `secret_id` through the encrypted store (the consumption
@@ -199,10 +255,12 @@ class TpuService(Service):
         buf = ""
         stopped = False
         timings = None
+        detok_s = 0.0     # cumulative detokenize wall time (trace span)
         for kind, value in self._drain(
             request, self.engine.config.request_timeout_s
         ):
             if kind == "token":
+                t0 = time.monotonic()
                 if incremental:
                     delta, utf8_tail = tokenizer.decode_incremental(
                         [value], utf8_tail
@@ -211,6 +269,7 @@ class TpuService(Service):
                     # Context-dependent detokenization (BPE/sentencepiece):
                     # bounded-window incremental decode, O(n) total.
                     delta = detok.push(value)
+                detok_s += time.monotonic() - t0
                 if not delta:
                     continue
                 if not stops:
@@ -258,7 +317,9 @@ class TpuService(Service):
             # End of stream: release held-back text (the incremental
             # detokenizer's window and/or the stop scanner's tail), still
             # honoring a stop that only completes in the final text.
+            t0 = time.monotonic()
             tail = detok.flush() if detok is not None else ""
+            detok_s += time.monotonic() - t0
             buf += tail
             if buf:
                 cut = min(
@@ -269,6 +330,14 @@ class TpuService(Service):
                     buf = buf[:cut]
                 if buf:
                     yield "delta", buf
+        if request.trace is not None and detok_s > 0:
+            # Detokenize work interleaves with decode; record it as one
+            # span of its cumulative duration anchored at stream end (the
+            # attr marks it as an accumulation, not a contiguous window).
+            end = time.monotonic()
+            request.trace.child(
+                "detokenize", start=end - detok_s, end=end, cumulative=True
+            )
         yield "done", timings
 
     # -- Service interface --------------------------------------------------
@@ -313,21 +382,66 @@ class TpuService(Service):
         })
         return response
 
+    def _engine_stats(self, parameters) -> pk.ExecuteToolResponse:
+        """engine_stats views: default counters+percentiles (+ the most
+        recent traced request's span tree), `metrics_text` (Prometheus
+        page over gRPC), `trace` (flight-recorder dump)."""
+        params = dict(parameters) if parameters is not None else {}
+        view = params.get("view", "stats")
+        response = pk.ExecuteToolResponse(
+            status=cmn.Status(code=200, message="Tool executed successfully")
+        )
+        if view in ("metrics_text", "prometheus"):
+            if self.obs is None:
+                raise ValueError(
+                    "metrics_text needs observability wiring (serve via "
+                    "gateway.server or pass obs= to TpuService)"
+                )
+            response.string_output = self.obs.registry.render()
+            return response
+        if view == "trace":
+            if self.obs is None:
+                raise ValueError(
+                    "trace view needs observability wiring (serve via "
+                    "gateway.server or pass obs= to TpuService)"
+                )
+            response.struct_output.update({
+                "traces": self.obs.recorder.traces(),
+                "events": self.obs.recorder.events(),
+            })
+            return response
+        if view != "stats":
+            raise ValueError(
+                f"unknown engine_stats view {view!r}; "
+                "use stats, metrics_text, or trace"
+            )
+        stats = self.engine.stats()
+        if self.obs is not None:
+            last = self.obs.recorder.last(self._is_llm_trace)
+            if last is not None:
+                stats["last_trace"] = last
+        response.struct_output.update(stats)
+        return response
+
+    @staticmethod
+    def _is_llm_trace(trace: dict) -> bool:
+        return trace.get("attrs", {}).get("tool") in _LLM_TOOLS
+
     def execute_tool(self, tool_name, parameters, secret_id, metadata):
         self._resolve_secret(secret_id)
+        span = current_span()
+        if span is not None:
+            span.set(tool=tool_name)
         if tool_name == "engine_profile":
             return self._engine_profile(parameters)
         if tool_name == "engine_stats":
-            response = pk.ExecuteToolResponse(
-                status=cmn.Status(code=200, message="Tool executed successfully")
-            )
-            response.struct_output.update(self.engine.stats())
-            return response
+            return self._engine_stats(parameters)
         if tool_name not in _LLM_TOOLS:
             return self._mock.execute_tool(tool_name, parameters, secret_id, metadata)
 
         params = dict(parameters) if parameters is not None else {}
         request = self._build_request(parameters)
+        request.trace = span
         stops = self._parse_stops(params)
         self.engine.submit(request)
 
@@ -343,7 +457,13 @@ class TpuService(Service):
                     token_ids.append(value)
                 elif kind == "error":
                     raise RuntimeError(value)
+            t0 = time.monotonic()
             text = self.engine.tokenizer.decode(token_ids)
+            if request.trace is not None:
+                request.trace.child(
+                    "detokenize", start=t0, end=time.monotonic(),
+                    tokens=len(token_ids),
+                )
         else:
             pieces: list[str] = []
             for kind, value in self._text_events(request, stops):
@@ -361,6 +481,9 @@ class TpuService(Service):
         self, tool_name, parameters, secret_id, metadata
     ) -> Iterator[pk.ExecuteToolStreamChunk]:
         self._resolve_secret(secret_id)
+        span = current_span()
+        if span is not None:
+            span.set(tool=tool_name)
         if tool_name not in _LLM_TOOLS:
             yield from self._mock.execute_tool_stream(
                 tool_name, parameters, secret_id, metadata
@@ -369,6 +492,7 @@ class TpuService(Service):
 
         params = dict(parameters) if parameters is not None else {}
         request = self._build_request(parameters)
+        request.trace = span
         stops = self._parse_stops(params)
         self.engine.submit(request)
 
@@ -381,6 +505,12 @@ class TpuService(Service):
                     timings = value
         except GeneratorExit:
             request.cancelled.set()  # client went away mid-stream
+            if span is not None:
+                # Stamp the abort reason NOW: the interceptor freezes the
+                # tree into the flight recorder the moment this exception
+                # unwinds, before the engine thread reaches its own
+                # _finish bookkeeping for the cancelled slot.
+                span.set(client_disconnected=True)
             raise
 
         final = pk.ExecuteToolStreamChunk(

@@ -1,0 +1,67 @@
+"""Observability: request tracing, latency histograms, Prometheus export.
+
+The standing measurement substrate for perf work (ISSUE 1): every
+serving component reports into one `Observability` bundle —
+
+- `registry` — Prometheus metrics, rendered by the /metrics endpoint
+  and the `engine_stats` tool's ``metrics_text`` view;
+- `tracer` + `recorder` — per-request span trees (root opened by the
+  gRPC interceptor, children recorded by the engine) kept in a bounded
+  flight recorder for postmortems.
+
+Everything is stdlib-only and cheap enough to stay on in production.
+"""
+
+from .exposition import MetricsHTTPServer, engine_collector
+from .histogram import DEFAULT_MS_BUCKETS, Histogram, log_buckets
+from .prometheus import (
+    CONTENT_TYPE,
+    Counter,
+    Gauge,
+    HistogramMetric,
+    Registry,
+    render_counter,
+    render_gauge,
+    render_histogram,
+)
+from .trace import (
+    FlightRecorder,
+    Span,
+    Tracer,
+    current_span,
+    new_trace_id,
+    set_current_span,
+)
+
+
+class Observability:
+    """Composition root shared by the gateway and its backend."""
+
+    def __init__(self, recorder_capacity: int = 64):
+        self.registry = Registry()
+        self.recorder = FlightRecorder(capacity=recorder_capacity)
+        self.tracer = Tracer(self.recorder)
+
+
+__all__ = [
+    "CONTENT_TYPE",
+    "Counter",
+    "DEFAULT_MS_BUCKETS",
+    "FlightRecorder",
+    "Gauge",
+    "Histogram",
+    "HistogramMetric",
+    "MetricsHTTPServer",
+    "Observability",
+    "engine_collector",
+    "Registry",
+    "Span",
+    "Tracer",
+    "current_span",
+    "log_buckets",
+    "new_trace_id",
+    "render_counter",
+    "render_gauge",
+    "render_histogram",
+    "set_current_span",
+]

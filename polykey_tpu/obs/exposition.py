@@ -1,0 +1,168 @@
+"""Prometheus exposition endpoint + the engine scrape collector.
+
+A stdlib ``http.server`` thread on the gateway (no new dependencies, no
+asyncio) serving:
+
+- ``GET /metrics`` — the registry's full text page;
+- ``GET /healthz`` — 200 "ok" (container-level liveness probes that
+  can't speak gRPC health).
+
+The engine collector snapshots `InferenceEngine` state at scrape time —
+no background sampler, no per-step bookkeeping beyond what
+`EngineMetrics` already does.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .prometheus import (
+    CONTENT_TYPE,
+    Registry,
+    render_counter,
+    render_gauge,
+    render_histogram,
+)
+
+
+def engine_collector(engine):
+    """Scrape-time collector over a live InferenceEngine: counters and
+    gauges come from `engine.stats()` (the engine's public surface, so a
+    rename of its internals can't 500 the scrape); the latency families
+    read `engine.metrics.ttft_hist` / `.itl_hist` directly — those two
+    attributes are part of EngineMetrics' public contract (this collector
+    and the snapshot percentiles both depend on them). Registered once
+    per engine via `Registry.register_collector`."""
+
+    def collect() -> list[str]:
+        snap = engine.stats()
+        lines: list[str] = []
+        lines += render_counter(
+            "polykey_requests_admitted_total",
+            "Requests accepted into the engine queue.",
+            snap["requests_admitted"],
+        )
+        lines += render_counter(
+            "polykey_requests_completed_total",
+            "Requests finished successfully.", snap["requests_completed"],
+        )
+        lines += render_counter(
+            "polykey_requests_failed_total",
+            "Requests finished with an error (includes cancellations: "
+            "stop-sequence matches and client disconnects).",
+            snap["requests_failed"],
+        )
+        lines += render_counter(
+            "polykey_decode_tokens_total",
+            "Tokens emitted by the decode loop.", snap["tokens_generated"],
+        )
+        lines += render_counter(
+            "polykey_decode_steps_total",
+            "Decode blocks processed.", snap["decode_steps"],
+        )
+        lines += render_gauge(
+            "polykey_active_requests",
+            "Requests currently holding a decode slot.", snap["slots_busy"],
+        )
+        lines += render_gauge(
+            "polykey_queue_depth",
+            "Requests waiting for admission.", snap["queued"],
+        )
+        lines += render_gauge(
+            "polykey_pages_free",
+            "Free KV pages in the block allocator.", snap["pages_free"],
+        )
+        lines += render_gauge(
+            "polykey_pages_total",
+            "Total KV pages in the pool.", snap["pages_total"],
+        )
+        lines += render_gauge(
+            "polykey_tokens_per_sec",
+            "Decode throughput over the last ~1s window.",
+            snap["tokens_per_sec"],
+        )
+        lines += render_histogram(
+            "polykey_ttft_ms",
+            "Time to first token (enqueue to first emit), ms.",
+            engine.metrics.ttft_hist,
+        )
+        lines += render_histogram(
+            "polykey_itl_ms",
+            "Inter-token gap, ms (per decode block, amortized per token).",
+            engine.metrics.itl_hist,
+        )
+        if snap.get("drafts_proposed"):
+            lines += render_counter(
+                "polykey_spec_drafts_proposed_total",
+                "Speculative draft tokens proposed.",
+                snap["drafts_proposed"],
+            )
+            lines += render_counter(
+                "polykey_spec_drafts_accepted_total",
+                "Speculative draft tokens accepted.",
+                snap["drafts_accepted"],
+            )
+        return lines
+
+    return collect
+
+
+class _Handler(BaseHTTPRequestHandler):
+    registry: Registry = None  # set by MetricsHTTPServer subclassing
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            try:
+                body = self.registry.render().encode()
+            except Exception as e:  # a broken collector must not 500 opaquely
+                self.send_response(500)
+                self.end_headers()
+                self.wfile.write(f"collector error: {e}\n".encode())
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif path == "/healthz":
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+            self.end_headers()
+            self.wfile.write(b"ok\n")
+        else:
+            self.send_response(404)
+            self.end_headers()
+            self.wfile.write(b"try /metrics\n")
+
+    def log_message(self, *args) -> None:
+        pass  # scrapes are high-frequency noise; the JSON log stays clean
+
+
+class MetricsHTTPServer:
+    """Daemon-thread exposition server. `port=0` binds an ephemeral port
+    (tests / smoke); `.port` reports the bound one."""
+
+    def __init__(self, registry: Registry, host: str = "0.0.0.0",
+                 port: int = 9464):
+        handler = type("BoundHandler", (_Handler,), {"registry": registry})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "MetricsHTTPServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="polykey-metrics",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -1,0 +1,156 @@
+#!/usr/bin/env python3
+"""Exposition-endpoint smoke test (``make metrics-smoke``).
+
+Boots the full serving stack on CPU with a tiny model — gRPC gateway,
+TPU-service backend, observability bundle, Prometheus HTTP endpoint —
+runs a streaming generation, scrapes /metrics DURING and after it, and
+asserts the required metric families are present and well-formed. This
+is the ISSUE 1 acceptance probe in script form: exit 0 means an operator
+pointing a Prometheus scrape-config at the gateway will see data.
+"""
+
+import os
+import sys
+import threading
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import grpc  # noqa: E402
+
+from polykey_tpu.engine.config import EngineConfig  # noqa: E402
+from polykey_tpu.engine.engine import InferenceEngine  # noqa: E402
+from polykey_tpu.gateway import server as gateway_server  # noqa: E402
+from polykey_tpu.gateway.jsonlog import Logger  # noqa: E402
+from polykey_tpu.gateway.tpu_service import TpuService  # noqa: E402
+from polykey_tpu.obs import MetricsHTTPServer, Observability  # noqa: E402
+from polykey_tpu.proto import polykey_v2_pb2 as pk  # noqa: E402
+from polykey_tpu.proto.polykey_v2_grpc import PolykeyServiceStub  # noqa: E402
+
+REQUIRED_FAMILIES = (
+    "polykey_ttft_ms_bucket",
+    "polykey_itl_ms_bucket",
+    "polykey_decode_tokens_total",
+    "polykey_active_requests",
+    "polykey_requests_completed_total",
+    "polykey_rpcs_total",
+    "polykey_engine_up",
+    "polykey_watchdog_stalls_total",
+    "polykey_pages_free",
+)
+
+CONFIG = EngineConfig(
+    model="tiny-llama", tokenizer="byte", dtype="float32",
+    max_decode_slots=4, page_size=8, num_pages=64, max_seq_len=64,
+    prefill_buckets=(16, 32), max_new_tokens_cap=48,
+    default_max_new_tokens=16,
+)
+
+
+def scrape(port: int) -> str:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10
+    ) as resp:
+        assert resp.status == 200, resp.status
+        ctype = resp.headers["Content-Type"]
+        assert "text/plain" in ctype, ctype
+        return resp.read().decode()
+
+
+def main() -> int:
+    logger = Logger(stream=open(os.devnull, "w"))
+    obs = Observability()
+    print("booting tiny engine on CPU ...", flush=True)
+    engine = InferenceEngine(CONFIG, logger=logger)
+    # Same factory from_env uses — the smoke probe exercises exactly the
+    # production service/watchdog/obs wiring.
+    service = TpuService.create(engine, logger=logger, obs=obs)
+    server, _, port = gateway_server.build_server(
+        service, logger, address="127.0.0.1:0", obs=obs
+    )
+    server.start()
+    metrics = MetricsHTTPServer(obs.registry, host="127.0.0.1", port=0)
+    metrics.start()
+    print(f"gateway :{port}  metrics :{metrics.port}/metrics", flush=True)
+
+    failures: list[str] = []
+    try:
+        channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+        stub = PolykeyServiceStub(channel)
+        request = pk.ExecuteToolRequest(tool_name="llm_generate")
+        request.parameters.update(
+            {"prompt": "metrics smoke", "max_tokens": 32}
+        )
+
+        mid_stream_page = {}
+
+        def generate():
+            chunks = list(stub.ExecuteToolStream(request, timeout=120))
+            assert chunks[-1].final
+
+        gen = threading.Thread(target=generate)
+        gen.start()
+        # Scrape while the stream is (likely) in flight — the endpoint
+        # must serve concurrently with the engine loop.
+        mid_stream_page["text"] = scrape(metrics.port)
+        gen.join(timeout=120)
+        assert not gen.is_alive(), "generation did not finish"
+
+        page = scrape(metrics.port)
+        for family in REQUIRED_FAMILIES:
+            if family not in page:
+                failures.append(f"missing family: {family}")
+        if 'polykey_ttft_ms_bucket{le="+Inf"} 0' in page:
+            failures.append("ttft histogram recorded no observations")
+        if "polykey_engine_up 1" not in page:
+            failures.append("engine_up gauge not 1")
+        # The mid-stream scrape's real assertion is that it SUCCEEDED
+        # (scrape() raises otherwise): the endpoint serves a valid page
+        # concurrently with the engine loop. Check the page parsed.
+        if not mid_stream_page["text"].startswith("# HELP"):
+            failures.append("mid-stream scrape returned malformed page")
+
+        # The gRPC metrics_text view must match the HTTP page's families.
+        req = pk.ExecuteToolRequest(tool_name="engine_stats")
+        req.parameters.update({"view": "metrics_text"})
+        grpc_page = stub.ExecuteTool(req, timeout=30).string_output
+        for family in REQUIRED_FAMILIES:
+            if family not in grpc_page:
+                failures.append(f"gRPC metrics_text missing: {family}")
+
+        # And the span tree for the request must be retrievable.
+        stats = dict(
+            stub.ExecuteTool(
+                pk.ExecuteToolRequest(tool_name="engine_stats"), timeout=30
+            ).struct_output
+        )
+        if "last_trace" not in stats:
+            failures.append("engine_stats has no last_trace")
+        else:
+            names = {c["name"] for c in dict(stats["last_trace"])["children"]}
+            for phase in ("queue_wait", "prefill", "decode", "detokenize"):
+                if phase not in names:
+                    failures.append(f"last_trace missing {phase} span")
+        channel.close()
+    finally:
+        metrics.stop()
+        server.stop(grace=None)
+        service.close()
+
+    if failures:
+        print("metrics-smoke FAILED:")
+        for failure in failures:
+            print(f"  - {failure}")
+        return 1
+    print(f"metrics-smoke OK: {len(REQUIRED_FAMILIES)} families present, "
+          "span tree complete")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -514,6 +514,81 @@ def test_grpc_concurrent_streams(grpc_stack):
     assert not errors, errors
 
 
+def test_traced_request_span_tree(engine):
+    """End-to-end tracing acceptance: a streaming generation through an
+    obs-wired stack leaves a span tree in the flight recorder whose
+    queue/prefill/decode/detokenize phases account for the request's
+    wall time, retrievable via the engine_stats tool."""
+    from polykey_tpu.obs import Observability
+
+    obs = Observability()
+    service = TpuService(engine, obs=obs)
+    logger = Logger(stream=io.StringIO(), level="debug")
+    server, _, port = gateway_server.build_server(
+        service, logger, address="127.0.0.1:0", obs=obs
+    )
+    server.start()
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    try:
+        stub = PolykeyServiceStub(channel)
+        request = pk.ExecuteToolRequest(tool_name="llm_generate")
+        request.parameters.update({"prompt": "trace this", "max_tokens": 8})
+        chunks = list(stub.ExecuteToolStream(request, timeout=120))
+        assert chunks[-1].final
+
+        resp = stub.ExecuteTool(
+            pk.ExecuteToolRequest(tool_name="engine_stats"), timeout=30
+        )
+        stats = dict(resp.struct_output)
+        assert "last_trace" in stats
+        trace = dict(stats["last_trace"])
+        assert trace["attrs"]["tool"] == "llm_generate"
+        children = {c["name"]: dict(c) for c in trace["children"]}
+        for phase in ("queue_wait", "prefill", "decode", "detokenize"):
+            assert phase in children, f"missing {phase} span"
+        # decode carries per-block children with token counts.
+        blocks = children["decode"].get("children", [])
+        assert blocks and sum(
+            int(b["attrs"]["tokens"]) for b in blocks
+        ) >= chunks[-1].usage.completion_tokens - 1
+        # The engine phases partition the request's wall time: their sum
+        # must land within the RPC's root duration, close to it (slack
+        # for RPC framing + scheduler jitter on busy CI hosts).
+        phase_ms = sum(
+            children[p]["duration_ms"]
+            for p in ("queue_wait", "prefill", "decode", "detokenize")
+        )
+        assert phase_ms <= trace["duration_ms"] * 1.05
+        assert phase_ms >= trace["duration_ms"] * 0.5
+
+        # TTFT/ITL percentiles (histogram-backed) surface in the stats.
+        assert stats["ttft_ms_p50"] > 0
+        assert stats["ttft_ms_p99"] >= stats["ttft_ms_p50"]
+
+        # metrics_text view renders the Prometheus page over gRPC.
+        request = pk.ExecuteToolRequest(tool_name="engine_stats")
+        request.parameters.update({"view": "metrics_text"})
+        resp = stub.ExecuteTool(request, timeout=30)
+        page = resp.string_output
+        for family in ("polykey_ttft_ms_bucket", "polykey_decode_tokens_total",
+                       "polykey_active_requests", "polykey_engine_up",
+                       "polykey_watchdog_stalls_total"):
+            assert family in page, f"missing {family} in exposition"
+
+        # trace view dumps the recorder.
+        request = pk.ExecuteToolRequest(tool_name="engine_stats")
+        request.parameters.update({"view": "trace"})
+        resp = stub.ExecuteTool(request, timeout=30)
+        dump = dict(resp.struct_output)
+        assert any(
+            dict(dict(t).get("attrs") or {}).get("tool") == "llm_generate"
+            for t in dump["traces"]
+        )
+    finally:
+        channel.close()
+        server.stop(grace=None)
+
+
 def test_quantized_engine_serves():
     """POLYKEY_QUANTIZE path: int8 weight-only engine generates end to end
     and stays deterministic (greedy)."""

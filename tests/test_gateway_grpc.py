@@ -3,6 +3,8 @@ the zero-TPU test discipline from SURVEY.md §4 (the mock is the fake
 backend, as in the reference's integration tier)."""
 
 import io
+import json
+import urllib.request
 
 import grpc
 import pytest
@@ -11,6 +13,7 @@ from polykey_tpu.gateway import server as gateway_server
 from polykey_tpu.gateway.jsonlog import Logger
 from polykey_tpu.gateway.mock_service import MockService
 from polykey_tpu.gateway.service import Service
+from polykey_tpu.obs import MetricsHTTPServer, Observability
 from polykey_tpu.proto import health_v1_pb2 as health_pb
 from polykey_tpu.proto import polykey_v2_pb2 as pk
 from polykey_tpu.proto import reflection_v1alpha_pb2 as refl_pb
@@ -133,6 +136,127 @@ def test_reflection_list_and_lookup(stack):
     assert "grpc.health.v1.Health" in services
     files = responses[1].file_descriptor_response.file_descriptor_proto
     assert len(files) >= 2  # polykey_v2.proto + its imports
+
+
+@pytest.fixture()
+def traced_stack():
+    """Full stack with observability wired: interceptor tracing + RPC
+    counters + the /metrics exposition endpoint."""
+    obs = Observability()
+    log_buffer = io.StringIO()
+    logger = Logger(stream=log_buffer, level="debug")
+    server, health, port = gateway_server.build_server(
+        MockService(), logger, address="127.0.0.1:0", obs=obs
+    )
+    server.start()
+    metrics = MetricsHTTPServer(obs.registry, host="127.0.0.1", port=0)
+    metrics.start()
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    yield channel, obs, metrics.port, log_buffer
+    channel.close()
+    metrics.stop()
+    server.stop(grace=None)
+
+
+def test_trace_id_logged_and_echoed(traced_stack):
+    channel, obs, _, log_buffer = traced_stack
+    stub = PolykeyServiceStub(channel)
+    call = stub.ExecuteTool.with_call(
+        pk.ExecuteToolRequest(tool_name="example_tool"),
+        timeout=5,
+        metadata=(("x-trace-id", "deadbeef01020304"),),
+    )
+    _, rpc = call
+    # Client-supplied trace id is echoed in trailing metadata...
+    trailing = {k: v for k, v in rpc.trailing_metadata()}
+    assert trailing.get("x-trace-id") == "deadbeef01020304"
+    # ...and appears on both interceptor log lines.
+    lines = [json.loads(l) for l in log_buffer.getvalue().splitlines()]
+    traced = [l for l in lines if l.get("trace_id")]
+    assert any(l["msg"] == "gRPC call received" for l in traced)
+    assert any(l["msg"] == "gRPC call finished" for l in traced)
+    assert all(l["trace_id"] == "deadbeef01020304" for l in traced)
+    # Childless OK RPCs are NOT filed in the flight recorder: routine
+    # mock-tool / engine_stats polls must never evict the span trees the
+    # recorder exists to preserve.
+    assert obs.recorder.last() is None
+
+
+def test_trace_id_minted_when_absent(traced_stack):
+    channel, _, _, log_buffer = traced_stack
+    stub = PolykeyServiceStub(channel)
+    _, rpc = stub.ExecuteTool.with_call(
+        pk.ExecuteToolRequest(tool_name="example_tool"), timeout=5
+    )
+    trailing = {k: v for k, v in rpc.trailing_metadata()}
+    minted = trailing.get("x-trace-id")
+    assert minted and len(minted) == 16
+    assert minted in log_buffer.getvalue()
+
+
+def test_oversized_trace_id_replaced(traced_stack):
+    """Client-supplied ids outside 1-64 [A-Za-z0-9_-] are ignored: they
+    fan out to trailers, logs, and recorded spans, so a hostile client
+    must not control their size or charset."""
+    channel, _, _, _ = traced_stack
+    stub = PolykeyServiceStub(channel)
+    _, rpc = stub.ExecuteTool.with_call(
+        pk.ExecuteToolRequest(tool_name="example_tool"),
+        timeout=5,
+        metadata=(("x-trace-id", "x" * 500),),
+    )
+    trailing = {k: v for k, v in rpc.trailing_metadata()}
+    echoed = trailing.get("x-trace-id")
+    assert echoed and len(echoed) == 16 and echoed != "x" * 500
+
+
+def test_metrics_endpoint_smoke(traced_stack):
+    """Exposition smoke: hit RPCs, then scrape /metrics and check the
+    gateway families render as valid Prometheus text."""
+    channel, _, metrics_port, _ = traced_stack
+    stub = PolykeyServiceStub(channel)
+    stub.ExecuteTool(pk.ExecuteToolRequest(tool_name="example_tool"), timeout=5)
+    list(stub.ExecuteToolStream(pk.ExecuteToolRequest(tool_name="file_tool"),
+                                timeout=5))
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{metrics_port}/metrics", timeout=5
+    ) as resp:
+        assert resp.status == 200
+        assert "text/plain" in resp.headers["Content-Type"]
+        body = resp.read().decode()
+    assert "# TYPE polykey_rpcs_total counter" in body
+    assert (
+        'polykey_rpcs_total{code="OK",'
+        'method="/polykey.v2.PolykeyService/ExecuteTool"} 1'
+    ) in body
+    assert (
+        'polykey_rpcs_total{code="OK",'
+        'method="/polykey.v2.PolykeyService/ExecuteToolStream"} 1'
+    ) in body
+
+
+def test_failed_rpc_recorded_for_postmortem():
+    """Non-OK RPCs are filed in the flight recorder even without child
+    spans — failures are exactly what postmortems go looking for."""
+    obs = Observability()
+    server, health, port = gateway_server.build_server(
+        _FailingService(), Logger(stream=io.StringIO()),
+        address="127.0.0.1:0", obs=obs,
+    )
+    server.start()
+    try:
+        with grpc.insecure_channel(f"127.0.0.1:{port}") as channel:
+            stub = PolykeyServiceStub(channel)
+            with pytest.raises(grpc.RpcError):
+                stub.ExecuteTool(
+                    pk.ExecuteToolRequest(tool_name="x"), timeout=5
+                )
+        trace = obs.recorder.last()
+        assert trace is not None
+        assert trace["name"].endswith("ExecuteTool")
+        assert trace["attrs"]["code"] != "OK"
+    finally:
+        server.stop(grace=None)
 
 
 def test_reflection_v1_list_and_lookup(stack):

@@ -1,0 +1,260 @@
+"""Observability subsystem tests: histogram bucket/percentile math, span
+nesting and cross-thread child appends, flight-recorder eviction, and the
+Prometheus text rendering round-trip (render → parse → same numbers)."""
+
+import re
+import threading
+import urllib.request
+
+import pytest
+
+from polykey_tpu.obs import (
+    FlightRecorder,
+    Histogram,
+    MetricsHTTPServer,
+    Observability,
+    Registry,
+    Span,
+    Tracer,
+    log_buckets,
+)
+
+
+# -- histogram ---------------------------------------------------------------
+
+
+def test_log_buckets_shape():
+    bounds = log_buckets(1.0, 1000.0, per_decade=2)
+    assert bounds[0] == 1.0
+    assert bounds[-1] >= 1000.0
+    assert all(b2 > b1 for b1, b2 in zip(bounds, bounds[1:]))
+    # ~2 per decade over 3 decades.
+    assert 6 <= len(bounds) <= 8
+
+
+def test_log_buckets_rejects_bad_range():
+    with pytest.raises(ValueError):
+        log_buckets(0, 10)
+    with pytest.raises(ValueError):
+        log_buckets(10, 10)
+
+
+def test_histogram_bucket_counts_are_cumulative():
+    h = Histogram([1, 10, 100])
+    for v in (0.5, 5, 5, 50, 5000):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["buckets"] == [(1, 1), (10, 3), (100, 4)]
+    assert snap["inf"] == 5
+    assert snap["count"] == 5
+    assert snap["sum"] == pytest.approx(5060.5)
+
+
+def test_histogram_boundary_value_lands_in_its_bucket():
+    # Prometheus le is inclusive: observe(10) counts in le="10".
+    h = Histogram([1, 10, 100])
+    h.observe(10)
+    assert h.snapshot()["buckets"] == [(1, 0), (10, 1), (100, 1)]
+
+
+def test_histogram_percentiles_interpolate():
+    h = Histogram([10, 20, 30, 40])
+    for v in (5, 15, 25, 35):
+        h.observe(v)
+    # p50 → rank 2 of 4 → falls at the top of the second bucket.
+    assert h.percentile(50) == pytest.approx(20.0)
+    # p100 clamps at the largest finite bound.
+    assert h.percentile(100) == pytest.approx(40.0)
+    assert h.percentile(0) <= h.percentile(99)
+
+
+def test_histogram_percentile_overflow_clamps():
+    h = Histogram([1, 2])
+    h.observe(100)   # lands in +Inf
+    assert h.percentile(99) == 2  # no upper edge → largest finite bound
+
+
+def test_histogram_empty_and_nan():
+    h = Histogram([1, 2])
+    assert h.percentile(99) == 0.0
+    h.observe(float("nan"))
+    h.observe(float("inf"))
+    assert h.count == 0
+
+
+def test_histogram_thread_safety():
+    h = Histogram(log_buckets(1, 1000))
+    threads = [
+        threading.Thread(
+            target=lambda: [h.observe(i % 500 + 1) for i in range(1000)]
+        )
+        for _ in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.count == 4000
+    assert h.snapshot()["inf"] == 4000
+
+
+# -- spans + recorder --------------------------------------------------------
+
+
+def test_span_nesting_and_to_dict():
+    root = Span("rpc", trace_id="abc123")
+    child = root.child("prefill")
+    child.child("chunk", tokens=128).finish()
+    child.finish()
+    root.finish()
+    tree = root.to_dict()
+    assert tree["name"] == "rpc"
+    assert tree["trace_id"] == "abc123"
+    assert tree["children"][0]["name"] == "prefill"
+    assert tree["children"][0]["children"][0]["attrs"]["tokens"] == 128
+    # Children share the trace id.
+    assert tree["children"][0]["trace_id"] == "abc123"
+    assert tree["duration_ms"] >= tree["children"][0]["duration_ms"] >= 0
+
+
+def test_span_explicit_timestamps():
+    root = Span("rpc", start=100.0)
+    root.child("queue_wait", start=100.0, end=100.25)
+    root.finish(end=101.0)
+    tree = root.to_dict()
+    assert tree["duration_ms"] == pytest.approx(1000.0)
+    assert tree["children"][0]["duration_ms"] == pytest.approx(250.0)
+
+
+def test_span_cross_thread_children():
+    root = Span("rpc")
+    def add(n):
+        for i in range(n):
+            root.child(f"c{i}").finish()
+    threads = [threading.Thread(target=add, args=(50,)) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    root.finish()
+    assert len(root.to_dict()["children"]) == 200
+
+
+def test_recorder_ring_eviction():
+    rec = FlightRecorder(capacity=3)
+    tracer = Tracer(rec)
+    for i in range(5):
+        span = tracer.start(f"rpc{i}")
+        tracer.finish_and_record(span)
+    names = [t["name"] for t in rec.traces()]
+    assert names == ["rpc2", "rpc3", "rpc4"]     # oldest two evicted
+    assert rec.last()["name"] == "rpc4"
+    assert rec.last(lambda t: t["name"] == "rpc3")["name"] == "rpc3"
+    assert rec.last(lambda t: t["name"] == "rpc0") is None
+
+
+def test_recorder_events_ring():
+    rec = FlightRecorder(capacity=2, event_capacity=3)
+    for i in range(5):
+        rec.event("watchdog_stall", n=i)
+    events = rec.events()
+    assert len(events) == 3
+    assert [e["n"] for e in events] == [2, 3, 4]
+    assert all(e["kind"] == "watchdog_stall" for e in events)
+
+
+# -- Prometheus rendering round-trip ----------------------------------------
+
+
+def _parse_exposition(text: str) -> dict:
+    """Minimal exposition-format parser: {name{labels} : value}."""
+    samples = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*(?:\{[^}]*\})?) (\S+)$",
+                     line)
+        assert m, f"unparseable exposition line: {line!r}"
+        samples[m.group(1)] = float(m.group(2))
+    return samples
+
+
+def test_prometheus_render_round_trip():
+    reg = Registry()
+    c = reg.counter("polykey_rpcs_total", "RPCs.", ("method", "code"))
+    c.inc(method="/a", code="OK")
+    c.inc(3, method="/a", code="Unknown")
+    g = reg.gauge("polykey_active_requests", "Active.")
+    g.set(7)
+    h = Histogram([1, 10])
+    h.observe(0.5)
+    h.observe(5)
+    h.observe(50)
+    reg.histogram("polykey_ttft_ms", "TTFT.", h)
+    text = reg.render()
+    assert text.endswith("\n")
+    samples = _parse_exposition(text)
+    assert samples['polykey_rpcs_total{code="OK",method="/a"}'] == 1
+    assert samples['polykey_rpcs_total{code="Unknown",method="/a"}'] == 3
+    assert samples["polykey_active_requests"] == 7
+    assert samples['polykey_ttft_ms_bucket{le="1"}'] == 1
+    assert samples['polykey_ttft_ms_bucket{le="10"}'] == 2
+    assert samples['polykey_ttft_ms_bucket{le="+Inf"}'] == 3
+    assert samples["polykey_ttft_ms_count"] == 3
+    assert samples["polykey_ttft_ms_sum"] == pytest.approx(55.5)
+    # TYPE headers present exactly once per family.
+    assert text.count("# TYPE polykey_ttft_ms histogram") == 1
+
+
+def test_registry_rejects_duplicates_and_gets():
+    reg = Registry()
+    c = reg.counter("x_total", "x")
+    with pytest.raises(ValueError):
+        reg.counter("x_total", "again")
+    assert reg.get("x_total") is c
+    assert reg.get("nope") is None
+
+
+def test_counter_label_validation():
+    reg = Registry()
+    c = reg.counter("y_total", "y", ("method",))
+    with pytest.raises(ValueError):
+        c.inc(code="OK")
+    with pytest.raises(ValueError):
+        c.inc(-1, method="/a")
+
+
+def test_callback_gauge_evaluates_at_scrape():
+    reg = Registry()
+    state = {"v": 1.0}
+    reg.gauge("live_gauge", "live", fn=lambda: state["v"])
+    assert "live_gauge 1" in reg.render()
+    state["v"] = 2.0
+    assert "live_gauge 2" in reg.render()
+
+
+# -- HTTP exposition ---------------------------------------------------------
+
+
+def test_metrics_http_server_serves_registry():
+    obs = Observability()
+    obs.registry.gauge("polykey_active_requests", "Active.", fn=lambda: 2)
+    srv = MetricsHTTPServer(obs.registry, host="127.0.0.1", port=0).start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=5
+        ) as resp:
+            assert resp.status == 200
+            assert "text/plain" in resp.headers["Content-Type"]
+            body = resp.read().decode()
+        assert "polykey_active_requests 2" in body
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/healthz", timeout=5
+        ) as resp:
+            assert resp.read() == b"ok\n"
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/nope", timeout=5
+            )
+    finally:
+        srv.stop()
