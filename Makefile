@@ -73,15 +73,38 @@ multiproc-demo: ## 2-process jax.distributed train+serve on localhost CPU
 	bash scripts/run_multiproc_demo.sh
 
 # -- local CI reproduction (reference Makefile:217-308 scan/ci-check family) --
-.PHONY: lint scan ci-check
+.PHONY: lint polylint native-asan scan ci-check
 
-lint: ## Lint (ruff, same invocation as CI; syntax-gate fallback offline)
+lint: ## Lint: ruff (pinned ruff.toml, same config as CI) + polylint
 	@if command -v ruff >/dev/null 2>&1; then \
-	  ruff check polykey_tpu/ tests/ bench.py; \
+	  ruff check polykey_tpu/ tests/ bench.py scripts/; \
 	else \
 	  echo "ruff not installed (CI pins ruff==0.12.5); falling back to a syntax gate"; \
 	  $(PYTHON) -m compileall -q polykey_tpu/ tests/ bench.py scripts/; \
 	fi
+	@$(MAKE) polylint
+
+polylint: ## Project-invariant static analysis (stdlib-only, always runs)
+	$(PYTHON) -m polykey_tpu.analysis
+
+ASAN_FLAGS := -g -O1 -fsanitize=address,undefined -fno-omit-frame-pointer
+
+native-asan: ## Build native components under ASan/UBSan and smoke-run them
+	@mkdir -p $(BUILD_DIR)/asan
+	$(CXX) -std=c++17 -Wall -Wextra $(ASAN_FLAGS) \
+	  -o $(BUILD_DIR)/asan/log-beautifier native/log_beautifier.cc
+	$(CXX) -std=c++17 -Wall -Wextra $(ASAN_FLAGS) \
+	  -o $(BUILD_DIR)/asan/block-allocator-smoke \
+	  native/block_allocator_smoke.cc native/block_allocator.cc
+	$(BUILD_DIR)/asan/block-allocator-smoke
+	@printf '%s\n' \
+	  '{"time":"2026-08-03T00:00:00Z","level":"INFO","msg":"gRPC call received","method":"/polykey.v2.PolykeyService/ExecuteTool","trace_id":"smoke1"}' \
+	  '{"time":"2026-08-03T00:00:01Z","level":"INFO","msg":"gRPC call finished","method":"/polykey.v2.PolykeyService/ExecuteTool","duration":"12.3ms","code":"OK","trace_id":"smoke1"}' \
+	  'compose-prefix | {"time":"2026-08-03T00:00:02Z","level":"ERROR","msg":"gRPC call finished","method":"/x/Y","duration":"1ms","code":"Internal"}' \
+	  'not json at all' \
+	  '{"broken":' \
+	  | $(BUILD_DIR)/asan/log-beautifier >/dev/null
+	@echo "native-asan OK"
 
 scan: ## Security scan (Trivy fs over the tree + lockfile, CRITICAL/HIGH gate)
 	@if ! command -v trivy >/dev/null 2>&1; then \
@@ -97,10 +120,11 @@ scan: ## Security scan (Trivy fs over the tree + lockfile, CRITICAL/HIGH gate)
 	  --scanners vuln,secret \
 	  --severity CRITICAL,HIGH
 
-ci-check: ## Run the CI pipeline locally: lint, tests, native build, scan
+ci-check: ## Run the CI pipeline locally: lint+polylint, tests, native(+asan), scan
 	@$(MAKE) lint
 	@$(MAKE) test
 	@$(MAKE) native
+	@$(MAKE) native-asan
 	@# Probe trivy here, not via scan's exit code: make launders any
 	@# recipe failure to exit 2, so findings and tool-missing would be
 	@# indistinguishable through $(MAKE) scan's status.

@@ -207,6 +207,8 @@ def _artifact_timestamp(path: str, line: dict) -> float:
         if out.returncode == 0 and out.stdout.strip():
             return float(out.stdout.strip())
     except Exception:
+        # git absent / not a checkout: the mtime fallback below is the
+        # documented degraded mode for artifact age, not an error.
         pass
     return os.path.getmtime(path)
 
@@ -230,7 +232,10 @@ def _scan_artifacts(perf_dir: str, max_age_s: float,
                 line = json.load(f)
             ts = _artifact_timestamp(path, line)
         except Exception:
+            # Corrupt/unreadable artifact: skip it, the scan picks the
+            # best of the remaining candidates.
             continue
+        # polylint: disable=PL002(artifact age vs a persisted epoch stamp needs the wall clock)
         if _replayable(line) and time.time() - ts <= max_age_s:
             is_8b = line.get("vs_baseline") is not None
             candidates.append(((is_8b, ts), path, line))
@@ -317,6 +322,8 @@ def _prior_round_tpu_artifact() -> tuple[str, dict, dict] | None:
             capture_output=True, text=True, timeout=15)
         rev = out.stdout.strip()
     except Exception:
+        # Provenance is best-effort: "unknown" engine_rev below is the
+        # explicit degraded value when git isn't available.
         pass
     provenance = {
         "round": rnd,

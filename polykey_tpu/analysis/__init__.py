@@ -1,0 +1,55 @@
+"""polylint — project-invariant static analysis for the TPU serving stack.
+
+The engine's hot path survives on rules no general-purpose linter knows:
+host↔device syncs are only legal at annotated resolve points, latency
+math must use monotonic clocks, ``except Exception`` must never wedge a
+request silently, nothing may block under the engine's locks, threads
+must be daemons or owned by a ``stop()``, jit boundaries must stay pure,
+and metric families must follow the ``obs/`` naming contract. PR 1 made
+regressions in these invariants *observable*; this package makes a whole
+class of them impossible to merge.
+
+Usage::
+
+    python -m polykey_tpu.analysis                    # lint the repo
+    python -m polykey_tpu.analysis --json             # machine-readable
+    python -m polykey_tpu.analysis --list-rules       # rule table
+    python -m polykey_tpu.analysis --write-baseline   # grandfather
+
+Per-line suppression (reason required; reasonless or unused suppressions
+are themselves findings)::
+
+    packed = np.asarray(data)  # polylint: disable=PL001(resolve point)
+
+The package is stdlib-only by design: the CI lint job installs ruff and
+nothing else, and ``python -m polykey_tpu.analysis`` must run there.
+"""
+
+from .baseline import apply_baseline, load_baseline, write_baseline
+from .core import (
+    FileContext,
+    Finding,
+    Rule,
+    all_rules,
+    check_file,
+    register,
+    run_paths,
+)
+
+# Importing the rules module populates the registry as a side effect
+# (it must follow the core import that defines the registry).
+from . import rules
+
+__all__ = [
+    "FileContext",
+    "Finding",
+    "Rule",
+    "all_rules",
+    "apply_baseline",
+    "check_file",
+    "load_baseline",
+    "register",
+    "rules",
+    "run_paths",
+    "write_baseline",
+]

@@ -1,0 +1,97 @@
+"""Grandfathering baseline: pre-existing findings that don't fail the run.
+
+A baseline entry is keyed by a content fingerprint — sha1 over
+(rule | path | stripped source line | occurrence index) — NOT by line
+number, so unrelated edits above a grandfathered finding don't churn the
+file. The occurrence index disambiguates identical lines in one file.
+
+The committed baseline should trend toward empty: fix findings instead
+of baselining them; ``--write-baseline`` exists for adopting polylint on
+a codebase with debt, and stale entries are reported so the file shrinks
+as debt is paid.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from pathlib import Path
+
+from .core import Finding
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE = "polylint-baseline.json"
+
+
+def fingerprint(finding: Finding, occurrence: int) -> str:
+    payload = f"{finding.rule}|{finding.path}|{finding.snippet}|{occurrence}"
+    return hashlib.sha1(payload.encode("utf-8")).hexdigest()[:16]
+
+
+def _with_fingerprints(findings: list[Finding]) -> list[tuple[str, Finding]]:
+    seen: dict[tuple[str, str, str], int] = {}
+    out: list[tuple[str, Finding]] = []
+    for f in findings:
+        key = (f.rule, f.path, f.snippet)
+        occurrence = seen.get(key, 0)
+        seen[key] = occurrence + 1
+        out.append((fingerprint(f, occurrence), f))
+    return out
+
+
+def load_baseline(path: Path) -> dict:
+    """Baseline dict (empty when the file doesn't exist)."""
+    if not path.is_file():
+        return {"version": BASELINE_VERSION, "findings": {}}
+    data = json.loads(path.read_text(encoding="utf-8"))
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path} has version {data.get('version')!r}, "
+            f"expected {BASELINE_VERSION}"
+        )
+    return data
+
+
+def write_baseline(path: Path, findings: list[Finding]) -> int:
+    """Grandfather every blocking finding; returns the entry count.
+
+    Fingerprints are computed over the FULL finding list (suppressed
+    ones included) so occurrence indices line up with apply_baseline's —
+    filtering first would shift the index of a blocking finding that
+    shares its source line with a suppressed twin.
+    """
+    entries = {
+        fp: {
+            "rule": f.rule, "path": f.path, "line": f.line,
+            "message": f.message,
+        }
+        for fp, f in _with_fingerprints(findings) if f.blocking
+    }
+    path.write_text(
+        json.dumps(
+            {"version": BASELINE_VERSION, "findings": entries},
+            indent=2, sort_keys=True,
+        ) + "\n",
+        encoding="utf-8",
+    )
+    return len(entries)
+
+
+def apply_baseline(
+    findings: list[Finding], baseline: dict
+) -> tuple[list[Finding], list[str]]:
+    """Mark baselined findings; returns (findings, stale fingerprints) —
+    stale entries are baseline lines whose finding no longer exists."""
+    from dataclasses import replace
+
+    entries = baseline.get("findings", {})
+    matched: set[str] = set()
+    out: list[Finding] = []
+    for fp, f in _with_fingerprints(findings):
+        if f.blocking and fp in entries:
+            matched.add(fp)
+            out.append(replace(f, baselined=True))
+        else:
+            out.append(f)
+    stale = sorted(set(entries) - matched)
+    return out, stale

@@ -1,0 +1,123 @@
+"""polylint CLI: ``python -m polykey_tpu.analysis``.
+
+Exit codes: 0 clean (suppressed/baselined findings allowed), 1 blocking
+findings, 2 usage error. ``--json`` emits one machine-readable object
+(findings + summary) for CI annotation tooling.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from .baseline import (
+    DEFAULT_BASELINE,
+    apply_baseline,
+    load_baseline,
+    write_baseline,
+)
+from .core import DEFAULT_TARGETS, all_rules, run_paths
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m polykey_tpu.analysis",
+        description="polylint: project-invariant static analysis for the "
+                    "TPU serving stack",
+    )
+    parser.add_argument(
+        "targets", nargs="*", default=None,
+        help=f"files/directories to lint (default: {' '.join(DEFAULT_TARGETS)})",
+    )
+    parser.add_argument(
+        "--root", default=".",
+        help="repo root paths are reported relative to (default: cwd)",
+    )
+    parser.add_argument(
+        "--baseline", default=DEFAULT_BASELINE, metavar="FILE",
+        help="grandfathering baseline file (missing file = empty baseline)",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline file entirely",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="grandfather every current blocking finding into --baseline",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit findings + summary as one JSON object",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule table and exit",
+    )
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.id}  {rule.name:<26} {rule.description}")
+        return 0
+
+    root = Path(args.root).resolve()
+    if not root.is_dir():
+        print(f"polylint: --root {args.root} is not a directory",
+              file=sys.stderr)
+        return 2
+    targets = args.targets or None
+    try:
+        findings = run_paths(root, targets)
+    except FileNotFoundError as e:
+        print(f"polylint: {e}", file=sys.stderr)
+        return 2
+
+    baseline_path = root / args.baseline
+    if args.write_baseline:
+        count = write_baseline(baseline_path, findings)
+        print(f"polylint: wrote {count} baseline entr"
+              f"{'y' if count == 1 else 'ies'} to {baseline_path}")
+        return 0
+
+    stale: list[str] = []
+    if not args.no_baseline:
+        findings, stale = apply_baseline(findings, load_baseline(baseline_path))
+
+    blocking = [f for f in findings if f.blocking]
+    suppressed = sum(1 for f in findings if f.suppressed)
+    baselined = sum(1 for f in findings if f.baselined)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_json() for f in findings],
+            "summary": {
+                "blocking": len(blocking),
+                "suppressed": suppressed,
+                "baselined": baselined,
+                "stale_baseline_entries": stale,
+                "files_clean": not blocking,
+            },
+        }, indent=2))
+    else:
+        for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+            if f.blocking:
+                print(f.render())
+        parts = [f"{len(blocking)} blocking"]
+        if suppressed:
+            parts.append(f"{suppressed} suppressed")
+        if baselined:
+            parts.append(f"{baselined} baselined")
+        print(f"polylint: {', '.join(parts)}")
+        if stale:
+            print(
+                f"polylint: {len(stale)} stale baseline entr"
+                f"{'y' if len(stale) == 1 else 'ies'} (fixed findings) — "
+                "re-run with --write-baseline to prune",
+            )
+    return 1 if blocking else 0

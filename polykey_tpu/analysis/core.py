@@ -1,0 +1,294 @@
+"""polylint core: file model, rule registry, suppressions, runner.
+
+Rules operate on a ``FileContext`` — parsed AST plus a tokenize-derived
+comment map (comments matter here: a justification comment is part of
+the ``except`` contract, and suppressions live in comments). Everything
+is stdlib-only so the CLI runs in the dependency-free CI lint job.
+
+Suppression syntax (shown here in the docstring because a literal
+example in a comment would parse as a live suppression)::
+
+    x = np.asarray(d)  # polylint: disable=PL001(deliberate resolve point)
+
+A suppression on a comment-only line applies to the next code line (for
+statements too long to carry a trailing comment). Reasons are mandatory;
+multiple rules separate with commas::
+
+    # polylint: disable=PL001(sync ok), PL003(error surfaces via queue)
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, replace
+from pathlib import Path
+from typing import Iterable, Iterator, Optional
+
+SUPPRESS_RE = re.compile(r"polylint:\s*disable=(?P<entries>.+)$")
+# The reason may itself contain one level of balanced parentheses
+# ("async copy (D2H) landed"); deeper nesting is not supported.
+ENTRY_RE = re.compile(
+    r"(?P<rule>PL\d{3})\s*"
+    r"(?:\((?P<reason>[^()]*(?:\([^()]*\)[^()]*)*)\))?"
+)
+
+
+@dataclass
+class Suppression:
+    rule: str
+    reason: str
+    target_line: int      # code line this suppression covers
+    comment_line: int     # where the comment physically sits
+    used: bool = False
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str             # "PL003"
+    path: str             # repo-relative posix path
+    line: int             # 1-based
+    message: str
+    snippet: str = ""     # stripped source line (feeds the baseline hash)
+    suppressed: bool = False
+    reason: str = ""      # suppression reason when suppressed
+    baselined: bool = False
+
+    @property
+    def blocking(self) -> bool:
+        return not (self.suppressed or self.baselined)
+
+    def render(self) -> str:
+        tag = ""
+        if self.suppressed:
+            tag = f"  [suppressed: {self.reason}]"
+        elif self.baselined:
+            tag = "  [baselined]"
+        return f"{self.path}:{self.line}: {self.rule} {self.message}{tag}"
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "suppressed": self.suppressed,
+            "baselined": self.baselined,
+        }
+
+
+class FileContext:
+    """One parsed source file: AST, raw lines, comment map, suppressions."""
+
+    def __init__(self, path: Path, rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=rel)
+        # line -> comment text (without '#'), via tokenize so '#' inside
+        # string literals can't masquerade as comments.
+        self.comments: dict[int, str] = {}
+        # lines carrying at least one non-comment, non-NL token — used to
+        # distinguish trailing comments from comment-only lines.
+        self.code_lines: set[int] = set()
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string.lstrip("#").strip()
+                elif tok.type not in (
+                    tokenize.NL, tokenize.NEWLINE, tokenize.INDENT,
+                    tokenize.DEDENT, tokenize.ENDMARKER,
+                ):
+                    self.code_lines.add(tok.start[0])
+        except tokenize.TokenError:
+            pass  # partial comment map; the AST parse already succeeded
+        self.suppressions: list[Suppression] = []
+        self.bad_suppressions: list[Finding] = []
+        self._parse_suppressions()
+
+    # -- helpers rules use ---------------------------------------------------
+
+    def finding(self, rule: str, node, message: str) -> Finding:
+        line = node if isinstance(node, int) else node.lineno
+        snippet = self.lines[line - 1].strip() if 0 < line <= len(self.lines) else ""
+        return Finding(rule=rule, path=self.rel, line=line,
+                       message=message, snippet=snippet)
+
+    def has_justification(self, start: int, end: int) -> bool:
+        """A non-suppression comment anywhere on lines [start, end]."""
+        for line in range(start, end + 1):
+            text = self.comments.get(line)
+            if text is not None and not SUPPRESS_RE.search(text):
+                return True
+        return False
+
+    # -- suppressions --------------------------------------------------------
+
+    def _parse_suppressions(self) -> None:
+        for line, text in sorted(self.comments.items()):
+            m = SUPPRESS_RE.search(text)
+            if m is None:
+                continue
+            target = line
+            if line not in self.code_lines:
+                # Comment-only line: covers the next code line.
+                nxt = line + 1
+                while nxt <= len(self.lines) and nxt not in self.code_lines:
+                    nxt += 1
+                target = nxt
+            entries = m.group("entries")
+            matched_spans: list[tuple[int, int]] = []
+            for em in ENTRY_RE.finditer(entries):
+                matched_spans.append(em.span())
+                rule, reason = em.group("rule"), (em.group("reason") or "").strip()
+                if not reason:
+                    self.bad_suppressions.append(self.finding(
+                        "PL000", line,
+                        f"suppression for {rule} is missing its "
+                        f"(reason) — write disable={rule}(why this is safe)",
+                    ))
+                    continue
+                self.suppressions.append(Suppression(
+                    rule=rule, reason=reason,
+                    target_line=target, comment_line=line,
+                ))
+            leftover = "".join(
+                entries[i] for i in range(len(entries))
+                if not any(a <= i < b for a, b in matched_spans)
+            ).strip(" ,")
+            if leftover:
+                self.bad_suppressions.append(self.finding(
+                    "PL000", line,
+                    f"malformed suppression entry {leftover!r} "
+                    "(expected PLxxx(reason))",
+                ))
+
+    def apply_suppressions(self, findings: list[Finding]) -> list[Finding]:
+        out: list[Finding] = []
+        for f in findings:
+            hit: Optional[Suppression] = None
+            for s in self.suppressions:
+                if s.rule == f.rule and s.target_line == f.line:
+                    hit = s
+                    break
+            if hit is not None:
+                hit.used = True
+                out.append(replace(f, suppressed=True, reason=hit.reason))
+            else:
+                out.append(f)
+        known = {r.id for r in all_rules()}
+        for s in self.suppressions:
+            if s.rule not in known:
+                out.append(self.finding(
+                    "PL000", s.comment_line,
+                    f"suppression names unknown rule {s.rule}",
+                ))
+            elif not s.used:
+                out.append(self.finding(
+                    "PL000", s.comment_line,
+                    f"unused suppression for {s.rule} — the rule no longer "
+                    "fires here; delete the comment",
+                ))
+        out.extend(self.bad_suppressions)
+        return out
+
+
+# -- rule registry ------------------------------------------------------------
+
+
+class Rule:
+    """Base rule. Subclasses set id/name/description and implement check();
+    applies() scopes by repo-relative path."""
+
+    id: str = "PL000"
+    name: str = "unnamed"
+    description: str = ""
+
+    def applies(self, rel: str) -> bool:
+        return True
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+_REGISTRY: dict[str, Rule] = {}
+
+
+def register(cls: type[Rule]) -> type[Rule]:
+    inst = cls()
+    if inst.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {inst.id}")
+    _REGISTRY[inst.id] = inst
+    return cls
+
+
+def all_rules() -> list[Rule]:
+    return [_REGISTRY[k] for k in sorted(_REGISTRY)]
+
+
+# -- runner -------------------------------------------------------------------
+
+DEFAULT_TARGETS = ("polykey_tpu", "bench.py", "scripts")
+_EXCLUDE_DIRS = {"__pycache__"}
+# Generated protobuf stubs and this package's test fixtures are not ours
+# to lint.
+_EXCLUDE_PREFIXES = ("polykey_tpu/proto/",)
+
+
+def iter_py_files(root: Path, targets: Iterable[str]) -> Iterator[Path]:
+    for target in targets:
+        p = root / target
+        if p.is_file() and p.suffix == ".py":
+            yield p
+        elif p.is_dir():
+            for sub in sorted(p.rglob("*.py")):
+                if _EXCLUDE_DIRS.isdisjoint(sub.parts):
+                    yield sub
+        else:
+            # A typo'd target must not let the gate pass with 0 files
+            # linted ("0 blocking" on nothing looks like success).
+            raise FileNotFoundError(
+                f"lint target {target!r} is neither a .py file nor a "
+                f"directory under {root}"
+            )
+
+
+def check_file(path: Path, root: Path,
+               rules: Optional[list[Rule]] = None) -> list[Finding]:
+    rel = path.resolve().relative_to(root.resolve()).as_posix()
+    if rel.startswith(_EXCLUDE_PREFIXES):
+        return []
+    source = path.read_text(encoding="utf-8")
+    try:
+        ctx = FileContext(path, rel, source)
+    except SyntaxError as e:
+        return [Finding(rule="PL000", path=rel, line=e.lineno or 1,
+                        message=f"syntax error: {e.msg}")]
+    findings: list[Finding] = []
+    for rule in (rules if rules is not None else all_rules()):
+        if rule.applies(rel):
+            findings.extend(rule.check(ctx))
+    findings = ctx.apply_suppressions(findings)
+    return sorted(findings, key=lambda f: (f.line, f.rule))
+
+
+def run_paths(root: Path, targets: Optional[Iterable[str]] = None,
+              rules: Optional[list[Rule]] = None) -> list[Finding]:
+    """Lint every .py file under `targets` (repo defaults when None).
+    Explicit targets must exist (FileNotFoundError otherwise — a typo'd
+    path must not pass as '0 findings'); defaults tolerate absentees so
+    partial trees (tests, subprojects) still lint."""
+    if targets is None:
+        targets = [t for t in DEFAULT_TARGETS if (root / t).exists()]
+        if not targets:
+            raise FileNotFoundError(
+                f"none of the default lint targets "
+                f"({', '.join(DEFAULT_TARGETS)}) exist under {root}"
+            )
+    findings: list[Finding] = []
+    for path in iter_py_files(root, targets):
+        findings.extend(check_file(path, root, rules))
+    return findings

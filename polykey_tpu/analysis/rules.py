@@ -1,0 +1,635 @@
+"""The polylint rule set — every rule encodes a real codebase invariant.
+
+| Rule  | Invariant                                                        |
+|-------|------------------------------------------------------------------|
+| PL001 | host syncs only at annotated resolve points in hot-path functions|
+| PL002 | time.time() stamps events; durations subtract monotonic clocks   |
+| PL003 | except Exception must log, re-raise, use the error, or justify   |
+| PL004 | nothing blocks lexically inside a ``with ...lock:`` body         |
+| PL005 | threads set daemon= or are joined by an owning stop()/shutdown() |
+| PL006 | jit boundaries stay pure; donated buffers are reassigned         |
+| PL007 | metric families are snake_case with unit suffixes (obs/ contract)|
+
+Static analysis trades recall for precision: each rule documents the
+lexical approximation it makes, and the escape hatch for deliberate
+violations is always an explicit ``# polylint: disable=PLxxx(reason)``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, Optional
+
+from .core import FileContext, Finding, Rule, register
+
+
+# -- shared AST helpers -------------------------------------------------------
+
+
+def dotted(node: ast.AST) -> str:
+    """Dotted path of a Name/Attribute chain ('' when not a plain chain)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else ""
+    return ""
+
+
+def call_name(call: ast.Call) -> str:
+    return dotted(call.func)
+
+
+def iter_functions(tree: ast.AST) -> Iterator[ast.FunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def walk_no_nested_functions(body: list[ast.stmt]) -> Iterator[ast.AST]:
+    """Walk statements without descending into nested function/class defs
+    (their bodies execute elsewhere, not lexically here)."""
+    stack: list[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if not isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef, ast.Lambda)):
+                stack.append(child)
+
+
+# -- PL001: host-sync-in-hot-path --------------------------------------------
+
+
+@register
+class HostSyncInHotPath(Rule):
+    """Host↔device syncs (np.asarray / device_get / .item() /
+    block_until_ready, and int()/float() over device handles) stall the
+    lookahead pipeline. Inside hot-path functions of engine/, models/ and
+    ops/ they are only legal at deliberate, annotated resolve points
+    (engine.py _resolve_slot/_process_step/_process_spec).
+
+    Approximation: "hot path" = function names matching
+    ^_?(resolve|process|dispatch|decode|step); int()/float() fire only
+    when their argument subtree contains a flagged sync call or a name
+    ending in _dev/_device (the repo's device-handle convention).
+    """
+
+    id = "PL001"
+    name = "host-sync-in-hot-path"
+    description = ("host sync in a hot-path function without an explicit "
+                   "polylint annotation")
+
+    HOT_RE = re.compile(r"^_?(resolve|process|dispatch|decode|step)")
+    SYNC_CALLS = {
+        "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+        "jax.device_get", "jax.block_until_ready",
+    }
+    SYNC_ATTRS = {"item", "block_until_ready"}
+    DEV_NAME_RE = re.compile(r"(_dev|_device)$")
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith(("polykey_tpu/engine/", "polykey_tpu/models/",
+                               "polykey_tpu/ops/"))
+
+    def _is_sync_call(self, node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        if call_name(node) in self.SYNC_CALLS:
+            return True
+        return (isinstance(node.func, ast.Attribute)
+                and node.func.attr in self.SYNC_ATTRS
+                and not node.args and not node.keywords)
+
+    def _touches_device(self, node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if self._is_sync_call(sub):
+                return True
+            if isinstance(sub, ast.Name) and self.DEV_NAME_RE.search(sub.id):
+                return True
+            if isinstance(sub, ast.Attribute) \
+                    and self.DEV_NAME_RE.search(sub.attr):
+                return True
+        return False
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for fn in iter_functions(ctx.tree):
+            if not self.HOT_RE.match(fn.name):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                if self._is_sync_call(node):
+                    what = name or f".{node.func.attr}()"  # type: ignore[union-attr]
+                    yield ctx.finding(
+                        self.id, node,
+                        f"host sync ({what}) in hot-path function "
+                        f"'{fn.name}' — annotate deliberate resolve points "
+                        "with # polylint: disable=PL001(reason)",
+                    )
+                elif name in ("int", "float") and node.args \
+                        and self._touches_device(node.args[0]):
+                    yield ctx.finding(
+                        self.id, node,
+                        f"{name}() over a device value in hot-path function "
+                        f"'{fn.name}' forces a blocking transfer — resolve "
+                        "via the async-copy path or annotate",
+                    )
+
+
+# -- PL002: wall-clock-for-durations ------------------------------------------
+
+
+@register
+class WallClockForDurations(Rule):
+    """time.time() may stamp events (cross-process correlation) but never
+    be subtracted: NTP steps the wall clock and produces negative or
+    wildly wrong latencies. Durations use time.monotonic() — the
+    obs/trace.py precedent (Span start/end are monotonic; the flight
+    recorder stamps events with wall time separately).
+
+    Approximation: flags a `-` BinOp whose operand is a time.time() call
+    or a name assigned from time.time() anywhere in the same file.
+    """
+
+    id = "PL002"
+    name = "wall-clock-for-durations"
+    description = "time.time() used in duration arithmetic"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        wall_names: set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
+                    and call_name(node.value) == "time.time":
+                for target in node.targets:
+                    path = dotted(target)
+                    if path:
+                        wall_names.add(path)
+
+        def is_wall(expr: ast.AST) -> bool:
+            for sub in ast.walk(expr):
+                if isinstance(sub, ast.Call) and call_name(sub) == "time.time":
+                    return True
+                if dotted(sub) in wall_names:
+                    return True
+            return False
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub) \
+                    and (is_wall(node.left) or is_wall(node.right)):
+                yield ctx.finding(
+                    self.id, node,
+                    "duration computed from time.time() — wall clocks step "
+                    "under NTP; use time.monotonic() for intervals",
+                )
+
+
+# -- PL003: silent-except ------------------------------------------------------
+
+
+@register
+class SilentExcept(Rule):
+    """An ``except Exception`` that neither logs, re-raises, uses the
+    caught error, nor carries a justification comment sits between a
+    request and a silent wedge: the failure vanishes and the client
+    hangs to its deadline. The handler must do ONE of: re-raise, call a
+    logger (.error/.warning/...), reference the bound exception (e.g.
+    push it into the request's out queue), or carry a comment explaining
+    why swallowing is safe (suppression comments don't count — they
+    suppress other rules, they don't justify this one).
+    """
+
+    id = "PL003"
+    name = "silent-except"
+    description = "except Exception swallows the error with no trace"
+
+    LOG_ATTRS = {"debug", "info", "warning", "warn", "error", "exception",
+                 "critical", "log"}
+
+    def _handler_is_broad(self, handler: ast.ExceptHandler) -> bool:
+        if handler.type is None:
+            return True                     # bare except
+        return isinstance(handler.type, ast.Name) \
+            and handler.type.id in ("Exception", "BaseException")
+
+    def _body_handles(self, handler: ast.ExceptHandler) -> bool:
+        for node in ast.walk(handler):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call):
+                func = node.func
+                if isinstance(func, ast.Attribute) \
+                        and func.attr in self.LOG_ATTRS:
+                    return True
+                if call_name(node).startswith(("logging.", "traceback.")):
+                    return True
+            if handler.name and isinstance(node, ast.Name) \
+                    and node.id == handler.name \
+                    and isinstance(node.ctx, ast.Load):
+                return True
+        return False
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._handler_is_broad(node):
+                continue
+            if self._body_handles(node):
+                continue
+            end = node.body[-1].end_lineno or node.lineno
+            if ctx.has_justification(node.lineno, end):
+                continue
+            yield ctx.finding(
+                self.id, node,
+                "broad except swallows the error silently — log it, "
+                "re-raise, surface it to the caller, or add a "
+                "justification comment",
+            )
+
+
+# -- PL004: blocking-call-under-lock ------------------------------------------
+
+
+@register
+class BlockingUnderLock(Rule):
+    """The engine/gateway locks guard metrics and queue state shared with
+    gRPC handler threads; a blocking wait inside a ``with ...lock:`` body
+    (sleep, join, event wait, gRPC call, blocking queue get/put) turns
+    every reader into a convoy and can deadlock shutdown. Queue get/put
+    fire only when the receiver looks like a queue or a blocking
+    timeout=/block= keyword is present — dict.get under a lock is fine.
+    """
+
+    id = "PL004"
+    name = "blocking-call-under-lock"
+    description = "blocking call lexically inside a lock body"
+
+    BLOCK_ATTRS = {"sleep", "wait", "join", "result", "acquire"}
+    QUEUE_HINT_RE = re.compile(r"(queue|_q$|submit)", re.IGNORECASE)
+
+    def _lock_expr(self, item: ast.withitem) -> bool:
+        return "lock" in dotted(item.context_expr).lower()
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            if not any(self._lock_expr(item) for item in node.items):
+                continue
+            for sub in walk_no_nested_functions(node.body):
+                if not isinstance(sub, ast.Call):
+                    continue
+                name = call_name(sub)
+                func = sub.func
+                attr = func.attr if isinstance(func, ast.Attribute) else ""
+                blocking = (
+                    name == "time.sleep"
+                    or name.startswith("grpc.")
+                    or attr in self.BLOCK_ATTRS
+                )
+                if not blocking and attr in ("get", "put"):
+                    receiver = dotted(func.value) if isinstance(func, ast.Attribute) else ""
+                    has_block_kw = any(
+                        kw.arg in ("timeout", "block") for kw in sub.keywords
+                    )
+                    blocking = bool(self.QUEUE_HINT_RE.search(receiver)) \
+                        or has_block_kw
+                if blocking:
+                    yield ctx.finding(
+                        self.id, sub,
+                        f"blocking call ({name or attr}) inside a lock "
+                        "body — move the wait outside the critical section",
+                    )
+
+
+# -- PL005: thread-hygiene -----------------------------------------------------
+
+
+@register
+class ThreadHygiene(Rule):
+    """Every threading.Thread must either set daemon= at construction or
+    be joined by an owning stop()/shutdown() path — otherwise process
+    exit hangs on a forgotten worker (the engine/watchdog/exposition
+    precedent: all three are daemons AND joined on shutdown).
+
+    Approximation: a Thread construction without daemon= passes if the
+    variable/attribute it is assigned to has .join() called on it
+    anywhere in the module, or feeds a loop whose variable is joined
+    (``for t in threads: t.join()``).
+    """
+
+    id = "PL005"
+    name = "thread-hygiene"
+    description = "thread neither daemon nor joined by an owner"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        join_receivers: set[str] = set()
+        loop_iters: dict[str, str] = {}    # loop var -> iterated name
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "join":
+                receiver = dotted(node.func.value)
+                if receiver:
+                    join_receivers.add(receiver)
+            if isinstance(node, ast.For):
+                var, it = dotted(node.target), dotted(node.iter)
+                if var and it:
+                    loop_iters[var] = it
+        # daemon-flag assignment after construction: x.daemon = True
+        daemon_assigned: set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign):
+                for target in node.targets:
+                    if isinstance(target, ast.Attribute) \
+                            and target.attr == "daemon":
+                        base = dotted(target.value)
+                        if base:
+                            daemon_assigned.add(base)
+
+        def joined(path: str) -> bool:
+            if path in join_receivers or path in daemon_assigned:
+                return True
+            # for t in <path>: t.join()
+            return any(it == path and var in join_receivers
+                       for var, it in loop_iters.items())
+
+        for stmt in ast.walk(ctx.tree):
+            if not isinstance(stmt, (ast.Assign, ast.Expr, ast.AnnAssign)):
+                continue
+            for call in ast.walk(stmt):
+                if not isinstance(call, ast.Call):
+                    continue
+                name = call_name(call)
+                if not (name.endswith(".Thread") or name == "Thread"):
+                    continue
+                if any(kw.arg == "daemon" for kw in call.keywords):
+                    continue
+                targets: list[str] = []
+                if isinstance(stmt, ast.Assign):
+                    targets = [dotted(t) for t in stmt.targets]
+                elif isinstance(stmt, ast.AnnAssign) and stmt.target is not None:
+                    targets = [dotted(stmt.target)]
+                if any(t and joined(t) for t in targets):
+                    continue
+                yield ctx.finding(
+                    self.id, call,
+                    "threading.Thread without daemon= and no owning "
+                    ".join() in this module — set daemon=True or join it "
+                    "from a stop()/shutdown() path",
+                )
+
+
+# -- PL006: jit-boundary purity ------------------------------------------------
+
+
+@register
+class JitBoundaryPurity(Rule):
+    """Functions handed to jax.jit trace once and replay: closing over
+    mutable ``self`` state, calling the Python/NumPy RNG, or reading
+    clocks bakes trace-time values into the compiled executable (or
+    recompiles per instance). Separately, buffers listed in
+    donate_argnames are dead after the call — every call site must
+    reassign the donated expression from the jit's outputs (the engine's
+    ``..., self.paged = self._jit_...(..., self.paged, ...)`` chain).
+
+    Approximation: purity checks cover functions defined in the same
+    module as their jax.jit site (decorator, partial(jax.jit, ...), or
+    jax.jit(fn, ...)); donation checks cover jit handles assigned to
+    attributes in the same module and require the donated Name/Attribute
+    to be an assignment target somewhere in the calling function.
+    """
+
+    id = "PL006"
+    name = "jit-boundary-purity"
+    description = "impure jit-compiled function or unreassigned donated buffer"
+
+    IMPURE_CALL_PREFIXES = ("random.", "np.random.", "numpy.random.", "time.")
+
+    def _jit_decorated(self, fn: ast.FunctionDef) -> bool:
+        for dec in fn.decorator_list:
+            if dotted(dec) == "jax.jit":
+                return True
+            if isinstance(dec, ast.Call):
+                if call_name(dec) == "jax.jit":
+                    return True
+                if call_name(dec) in ("partial", "functools.partial") \
+                        and dec.args and dotted(dec.args[0]) == "jax.jit":
+                    return True
+        return False
+
+    def _purity_findings(self, ctx: FileContext,
+                         fn: ast.FunctionDef) -> Iterator[Finding]:
+        params = {a.arg for a in fn.args.posonlyargs + fn.args.args
+                  + fn.args.kwonlyargs}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and node.id == "self" \
+                    and "self" not in params:
+                yield ctx.finding(
+                    self.id, node,
+                    f"jit-compiled '{fn.name}' closes over mutable self "
+                    "state — pass device state explicitly",
+                )
+                break
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                if name.startswith(self.IMPURE_CALL_PREFIXES):
+                    yield ctx.finding(
+                        self.id, node,
+                        f"jit-compiled '{fn.name}' calls {name}() — the "
+                        "value is baked in at trace time; use jax.random "
+                        "keys / pass clocks as arguments",
+                    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        module_fns = {
+            fn.name: fn for fn in ctx.tree.body
+            if isinstance(fn, ast.FunctionDef)
+        }
+        checked: set[str] = set()
+        # (a) decorated functions
+        for fn in iter_functions(ctx.tree):
+            if self._jit_decorated(fn):
+                checked.add(fn.name)
+                yield from self._purity_findings(ctx, fn)
+        # (b) jax.jit(fn, ...) call sites + donation contracts
+        donating: dict[str, tuple[ast.FunctionDef, list[int]]] = {}
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and call_name(node) == "jax.jit"):
+                continue
+            if not node.args:
+                continue
+            target_fn: Optional[ast.FunctionDef] = None
+            arg0 = node.args[0]
+            if isinstance(arg0, ast.Name) and arg0.id in module_fns:
+                target_fn = module_fns[arg0.id]
+                if target_fn.name not in checked:
+                    checked.add(target_fn.name)
+                    yield from self._purity_findings(ctx, target_fn)
+            elif isinstance(arg0, ast.Lambda):
+                for sub in ast.walk(arg0):
+                    if isinstance(sub, ast.Name) and sub.id == "self":
+                        yield ctx.finding(
+                            self.id, arg0,
+                            "lambda passed to jax.jit closes over self — "
+                            "hoist it to a pure function",
+                        )
+                        break
+            if target_fn is None:
+                continue
+            donated = self._donated_indices(node, target_fn)
+            if not donated:
+                continue
+            handle = self._assigned_handle(ctx, node)
+            if handle:
+                donating[handle] = (target_fn, donated)
+        if donating:
+            yield from self._check_donation_sites(ctx, donating)
+
+    def _donated_indices(self, jit_call: ast.Call,
+                         fn: ast.FunctionDef) -> list[int]:
+        param_names = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+        indices: list[int] = []
+        for kw in jit_call.keywords:
+            if kw.arg == "donate_argnames" \
+                    and isinstance(kw.value, (ast.Tuple, ast.List)):
+                for el in kw.value.elts:
+                    if isinstance(el, ast.Constant) \
+                            and el.value in param_names:
+                        indices.append(param_names.index(el.value))
+            elif kw.arg == "donate_argnums" \
+                    and isinstance(kw.value, (ast.Tuple, ast.List)):
+                for el in kw.value.elts:
+                    if isinstance(el, ast.Constant) \
+                            and isinstance(el.value, int):
+                        indices.append(el.value)
+        return indices
+
+    def _assigned_handle(self, ctx: FileContext,
+                         jit_call: ast.Call) -> Optional[str]:
+        """Attribute name the jax.jit(...) result is bound to
+        (``self._jit_prefill = jax.jit(...)`` -> '_jit_prefill')."""
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and node.value is jit_call:
+                for target in node.targets:
+                    if isinstance(target, ast.Attribute):
+                        return target.attr
+                    if isinstance(target, ast.Name):
+                        return target.id
+        return None
+
+    def _check_donation_sites(
+        self, ctx: FileContext,
+        donating: dict[str, tuple[ast.FunctionDef, list[int]]],
+    ) -> Iterator[Finding]:
+        for fn in iter_functions(ctx.tree):
+            assigned: set[str] = set()
+            calls: list[ast.Call] = []
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    for target in node.targets:
+                        for sub in ast.walk(target):
+                            path = dotted(sub)
+                            if path:
+                                assigned.add(path)
+                elif isinstance(node, ast.Call):
+                    calls.append(node)
+            for call in calls:
+                func = call.func
+                attr = func.attr if isinstance(func, ast.Attribute) \
+                    else (func.id if isinstance(func, ast.Name) else "")
+                if attr not in donating:
+                    continue
+                target_fn, donated = donating[attr]
+                for idx in donated:
+                    if idx >= len(call.args):
+                        continue       # passed by keyword / starred: skip
+                    path = dotted(call.args[idx])
+                    if not path:
+                        continue       # complex expression: skip
+                    if path not in assigned:
+                        pname = ([a.arg for a in target_fn.args.posonlyargs
+                                  + target_fn.args.args][idx]
+                                 if idx < len(target_fn.args.args) else idx)
+                        yield ctx.finding(
+                            self.id, call,
+                            f"'{path}' is donated to {attr}() (param "
+                            f"{pname!r}) but never reassigned from its "
+                            "outputs in this function — the buffer is "
+                            "dead after the call",
+                        )
+
+
+# -- PL007: prometheus-naming --------------------------------------------------
+
+
+@register
+class PrometheusNaming(Rule):
+    """Metric families follow the obs/ contract: snake_case throughout,
+    counters end in ``_total``, histograms carry an explicit unit suffix
+    (``_ms``/``_bytes``/``_seconds``). A family that breaks the pattern
+    breaks every recording rule and dashboard written against the
+    convention. Checks literal names at Counter/Gauge/HistogramMetric
+    construction, registry .counter/.gauge/.histogram/.get_or_create,
+    and the render_counter/render_gauge/render_histogram helpers.
+    """
+
+    id = "PL007"
+    name = "prometheus-naming"
+    description = "metric family violates the obs/ naming contract"
+
+    SNAKE_RE = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)*$")
+    HIST_SUFFIXES = ("_ms", "_bytes", "_seconds", "_us", "_total")
+    KIND_BY_CLASS = {"Counter": "counter", "Gauge": "gauge",
+                     "HistogramMetric": "histogram"}
+
+    def _metric_sites(self, tree: ast.AST) -> Iterator[tuple[ast.Call, str, str]]:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            tail = name.rsplit(".", 1)[-1] if name else ""
+            kind: Optional[str] = None
+            name_arg: Optional[ast.expr] = None
+            if tail in ("render_counter", "render_gauge", "render_histogram"):
+                kind = tail.split("_", 1)[1]
+                name_arg = node.args[0] if node.args else None
+            elif tail in ("counter", "gauge", "histogram") \
+                    and isinstance(node.func, ast.Attribute):
+                kind = tail
+                name_arg = node.args[0] if node.args else None
+            elif tail == "get_or_create" and len(node.args) >= 2:
+                cls = dotted(node.args[0])
+                kind = self.KIND_BY_CLASS.get(cls.rsplit(".", 1)[-1])
+                name_arg = node.args[1]
+            elif tail in self.KIND_BY_CLASS and isinstance(node.func, ast.Name):
+                kind = self.KIND_BY_CLASS[tail]
+                name_arg = node.args[0] if node.args else None
+            if kind and isinstance(name_arg, ast.Constant) \
+                    and isinstance(name_arg.value, str):
+                yield node, kind, name_arg.value
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node, kind, name in self._metric_sites(ctx.tree):
+            if not self.SNAKE_RE.match(name):
+                yield ctx.finding(
+                    self.id, node,
+                    f"metric family {name!r} is not snake_case",
+                )
+                continue
+            if kind == "counter" and not name.endswith("_total"):
+                yield ctx.finding(
+                    self.id, node,
+                    f"counter family {name!r} must end in _total",
+                )
+            elif kind == "histogram" and not name.endswith(self.HIST_SUFFIXES):
+                yield ctx.finding(
+                    self.id, node,
+                    f"histogram family {name!r} needs a unit suffix "
+                    f"({'/'.join(self.HIST_SUFFIXES)})",
+                )

@@ -1305,6 +1305,9 @@ class InferenceEngine:
 
     def _resolve_slot(self, slot_idx: int, slot: _Slot) -> None:
         try:
+            # Deliberate resolve point: the copy was started async at merge
+            # time (copy_to_host_async), so this sync is local by now.
+            # polylint: disable=PL001(first-token resolve point; async copy landed)
             token = int(np.asarray(slot.token_dev).reshape(-1)[slot.token_row])
         except Exception as e:
             slot.token_dev = None
@@ -1500,6 +1503,9 @@ class InferenceEngine:
             # later) the read is then local.
             packed_dev.copy_to_host_async()
         except Exception:
+            # Best-effort copy hint only: np.asarray at process time syncs
+            # regardless, so a backend without async copies loses overlap,
+            # not correctness.
             pass
         return ("plain", packed_dev, self._snapshot_requests())
 
@@ -1581,6 +1587,7 @@ class InferenceEngine:
             # entirely so the drain costs no host↔device roundtrip.
             return
         t_sync = time.monotonic()
+        # polylint: disable=PL001(block resolve point; one packed D2H read per block)
         packed = np.asarray(data)     # [K, B]; blocks until block done
 
         emitted = 0
@@ -1643,6 +1650,8 @@ class InferenceEngine:
             packed_dev.copy_to_host_async()
             stats_dev.copy_to_host_async()
         except Exception:
+            # Best-effort copy hint only: _process_spec's np.asarray syncs
+            # regardless; backends without async copies lose overlap only.
             pass
         return packed_dev, stats_dev
 
@@ -1653,7 +1662,9 @@ class InferenceEngine:
         the dial needs."""
         packed_dev, stats_dev = data
         t_sync = time.monotonic()
+        # polylint: disable=PL001(spec-round resolve point; packed D2H read)
         packed = np.asarray(packed_dev)  # [B, gamma+1]; blocks until done
+        # polylint: disable=PL001(device-owned acceptance stats feed the gamma dial)
         accepted, proposed = (int(v) for v in np.asarray(stats_dev))
 
         emitted = 0
@@ -1754,8 +1765,17 @@ class InferenceEngine:
                     dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
                     dev["active"], dev["caps"], np.int32(slot_idx),
                 )
-            except Exception:
-                self._dev_dirty = True   # fall back to a full re-upload
+            except Exception as e:
+                # Retire is an optimization; the dirty flag's full mirror
+                # re-upload is the correct fallback — but a recurring
+                # failure here means every finish flushes the pipeline,
+                # so leave a trace for the postmortem reader.
+                if self.logger is not None:
+                    self.logger.warn(
+                        "lane retire failed; falling back to full "
+                        "mirror re-upload", slot=slot_idx, error=str(e),
+                    )
+                self._dev_dirty = True
         if error is not None:
             request.out.put(("error", error))
             self.metrics.on_finish(request.timings, failed=True)

@@ -75,6 +75,8 @@ def detect_chip() -> Optional[ChipSpec]:
         if "v5p" in kind or "v5" in kind:
             return CHIP_SPECS["tpu-v5p"]
     except Exception:
+        # No devices / unqueryable backend: roofline annotation is
+        # optional context, None disables it without failing the bench.
         return None
     return None
 

@@ -98,6 +98,8 @@ def _incoming_trace_id(context) -> str | None:
     try:
         metadata = context.invocation_metadata() or ()
     except Exception:
+        # In-process stubs and test doubles may not implement
+        # invocation_metadata; a fresh trace id is minted downstream.
         return None
     for key, value in metadata:
         if key == _TRACE_ID_KEY and isinstance(value, str) \
