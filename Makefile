@@ -8,7 +8,8 @@ CXXFLAGS ?= -O2 -std=c++17 -Wall -Wextra
 BUILD_DIR := build
 
 .PHONY: help run run-client test test-models native protos clean bench dryrun \
-	kernel-check tunnel-probe bench-tokenizer tpu-watch metrics-smoke
+	kernel-check tunnel-probe bench-tokenizer tpu-watch metrics-smoke \
+	chaos-smoke print-chaos
 
 help: ## Show available targets
 	@grep -E '^[a-zA-Z_-]+:.*?## .*$$' $(MAKEFILE_LIST) | \
@@ -47,6 +48,18 @@ bench: ## Run the benchmark harness (prints one JSON line)
 
 metrics-smoke: ## Boot the stack on CPU, scrape /metrics, assert required families
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/metrics_smoke.py
+
+# Deterministic fault-injection suite (ISSUE 3): deadline drops, load
+# shedding, watchdog trip → supervised restart, client retries, health
+# transitions — all on CPU with test-scaled timeouts.
+CHAOS_TESTS := tests/test_chaos.py tests/test_faults.py tests/test_health.py \
+	tests/test_client_retry.py
+
+chaos-smoke: ## Run the fault-injection/resilience test suite on CPU
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest $(CHAOS_TESTS) -q
+
+print-chaos: ## Print the chaos test file list (CI's single source of truth)
+	@echo $(CHAOS_TESTS)
 
 kernel-check: ## Compile + compare the Pallas kernels on real TPU
 	$(PYTHON) scripts/tpu_kernel_check.py
@@ -120,8 +133,9 @@ scan: ## Security scan (Trivy fs over the tree + lockfile, CRITICAL/HIGH gate)
 	  --scanners vuln,secret \
 	  --severity CRITICAL,HIGH
 
-ci-check: ## Run the CI pipeline locally: lint+polylint, tests, native(+asan), scan
+ci-check: ## Run the CI pipeline locally: lint+polylint, chaos, tests, native(+asan), scan
 	@$(MAKE) lint
+	@$(MAKE) chaos-smoke
 	@$(MAKE) test
 	@$(MAKE) native
 	@$(MAKE) native-asan

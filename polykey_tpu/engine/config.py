@@ -60,6 +60,17 @@ def enable_persistent_compile_cache() -> Optional[str]:
             "jax_persistent_cache_min_compile_time_secs",
             _env_float("POLYKEY_COMPILE_CACHE_MIN_SECS", 1.0),
         )
+        try:
+            # JAX initializes its compilation cache lazily ONCE: if any
+            # jit ran before this call (warmup, an embedder, a test
+            # module), the dir update above is silently ignored until
+            # the cache object is reset. Best-effort — the attribute is
+            # jax-internal and the cache stays an optimization.
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:
+            pass  # older/newer jax without reset_cache: dir may still apply
     except Exception:
         return None       # cache is an optimization, never a failure
     _compile_cache_dir = cache_dir
@@ -212,6 +223,22 @@ class EngineConfig:
     watchdog_timeout_s: float = 300.0
     request_timeout_s: float = 300.0
 
+    # -- Overload safety (ISSUE 3) -------------------------------------------
+    # Bound on the submit queue: requests beyond it are shed immediately
+    # with RESOURCE_EXHAUSTED + a retry-after-ms hint (engine.submit)
+    # instead of queueing into unbounded latency. 0 → unbounded (bench /
+    # soak harnesses that deliberately flood the queue).
+    max_queue_depth: int = 256
+    # Supervised restarts (engine/supervisor.py): a watchdog trip or
+    # engine-loop crash triggers an in-process restart — fresh engine,
+    # re-armed watchdog, health back to SERVING — up to
+    # `max_engine_restarts` times within `restart_window_s` before the
+    # supervisor gives up and leaves the process NOT_SERVING for the
+    # platform to recycle (compose healthcheck / k8s restart policy).
+    supervise: bool = True
+    max_engine_restarts: int = 3
+    restart_window_s: float = 600.0
+
     @property
     def pages_per_seq(self) -> int:
         return self.max_seq_len // self.page_size
@@ -281,6 +308,20 @@ class EngineConfig:
             request_timeout_s=_env_float(
                 "POLYKEY_REQUEST_TIMEOUT", cls.request_timeout_s
             ),
+            max_queue_depth=_env_int(
+                "POLYKEY_MAX_QUEUE", cls.max_queue_depth
+            ),
+            # Default ON; POLYKEY_SUPERVISE=0 pins the one-shot behavior
+            # (process restart is then the only recovery path).
+            supervise=os.environ.get(
+                "POLYKEY_SUPERVISE", "1"
+            ).lower() in ("1", "true"),
+            max_engine_restarts=_env_int(
+                "POLYKEY_MAX_RESTARTS", cls.max_engine_restarts
+            ),
+            restart_window_s=_env_float(
+                "POLYKEY_RESTART_WINDOW", cls.restart_window_s
+            ),
         )
 
     def validate(self) -> None:
@@ -317,6 +358,12 @@ class EngineConfig:
             )
         if self.top_p_candidates < 0:
             raise ValueError("top_p_candidates must be >= 0 (0 → exact)")
+        if self.max_queue_depth < 0:
+            raise ValueError("max_queue_depth must be >= 0 (0 → unbounded)")
+        if self.max_engine_restarts < 0:
+            raise ValueError("max_engine_restarts must be >= 0")
+        if self.restart_window_s <= 0:
+            raise ValueError("restart_window_s must be > 0")
         for name in ("tp", "dp", "ep", "sp", "pp", "num_slices"):
             if getattr(self, name) < 1:
                 raise ValueError(f"{name} must be >= 1")

@@ -53,6 +53,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..faults import get_injector
 from ..models.config import ModelConfig, get_config
 from ..models.transformer import forward_paged, init_params, unembed
 from ..parallel.mesh import MeshConfig, create_mesh
@@ -87,6 +88,13 @@ class GenRequest:
     # Seeds are taken mod 2**64. None → a fresh root from the engine's
     # seed RNG.
     seed: Optional[int] = None
+    # Absolute monotonic deadline stamped by the gateway from the RPC's
+    # time_remaining() (None → no deadline). The engine drops expired
+    # requests at dequeue (before prefill) and at decode-block
+    # boundaries, failing them with a "deadline exceeded" error the
+    # gateway maps to DEADLINE_EXCEEDED — expired work never reaches the
+    # device.
+    deadline: Optional[float] = None
     out: queue.Queue = field(default_factory=queue.Queue)
     cancelled: threading.Event = field(default_factory=threading.Event)
     timings: RequestTimings = field(default_factory=RequestTimings)
@@ -280,6 +288,21 @@ class EngineDeadError(RuntimeError):
     pass
 
 
+class EngineOverloadedError(RuntimeError):
+    """Admission shed this request (queue bound or estimated-delay
+    check). `retry_after_ms` is the engine's best guess at when a retry
+    could be admitted — the gateway ships it as trailing metadata."""
+
+    def __init__(self, message: str, retry_after_ms: int = 100):
+        super().__init__(message)
+        self.retry_after_ms = retry_after_ms
+
+
+# Error-message prefix contract with the gateway: engine failures that
+# begin with this map to gRPC DEADLINE_EXCEEDED (tpu_service).
+DEADLINE_MSG = "deadline exceeded"
+
+
 class InferenceEngine:
     def __init__(
         self,
@@ -292,11 +315,27 @@ class InferenceEngine:
     ):
         config.validate()
         self.config = config
+        # Constructor inputs AS PASSED (before checkpoint load / quantize /
+        # shard mutate the local): the supervisor's default restart factory
+        # replays them so a restarted engine is built from the same
+        # weights/seed, not a fresh random init (None → the checkpoint or
+        # random-init path reruns, which is already faithful). Pinning the
+        # raw params tree costs its host memory for the engine's lifetime,
+        # so it happens only when supervision can actually consume it.
+        self._ctor_args = {
+            "params": params if config.supervise else None,
+            "seed": seed,
+            "draft_params": draft_params if config.supervise else None,
+        }
         self.model_cfg = get_config(config.model)
         self.tokenizer = load_tokenizer(config.tokenizer)
         self.metrics = EngineMetrics()
         self.health = health
         self.logger = logger
+        # Fault injection (polykey_tpu/faults.py): None unless
+        # POLYKEY_FAULTS is set, so every injection point below is one
+        # attribute load + `is None` — nothing on the hot path when off.
+        self._faults = get_injector()
         self._dtype = jnp.dtype(config.dtype)
 
         # --- Serving mesh: tp shards heads/hidden (Megatron specs,
@@ -638,6 +677,31 @@ class InferenceEngine:
             raise EngineDeadError(self.dead)
         if self._stop.is_set():
             raise EngineDeadError("engine is shut down")
+        # Bounded admission with load shedding: over-limit submissions
+        # fail in O(1) with a retry-after hint instead of queueing into
+        # unbounded latency — overload degrades to fast rejections.
+        limit = self.config.max_queue_depth
+        if limit > 0 and self._submit.qsize() >= limit:
+            self.metrics.on_shed()
+            raise EngineOverloadedError(
+                f"submit queue full ({limit} waiting)",
+                retry_after_ms=self._retry_after_ms(),
+            )
+        if request.deadline is not None:
+            # Deadline-aware admission: if the estimated queue delay
+            # already blows the request's budget, shedding now is
+            # strictly better than burning a slot on work the client
+            # will throw away. Estimate is qsize × EWMA(service time) /
+            # slots — zero until the first completed request, so cold
+            # engines never false-positive.
+            est = self._estimated_queue_delay_s()
+            if est > 0.0 and time.monotonic() + est >= request.deadline:
+                self.metrics.on_shed()
+                raise EngineOverloadedError(
+                    f"estimated queue delay {est:.2f}s exceeds request "
+                    "deadline",
+                    retry_after_ms=self._retry_after_ms(),
+                )
         self.metrics.on_admit()
         self._submit.put(request)
         self._wake.set()
@@ -647,6 +711,39 @@ class InferenceEngine:
         # terminal event is harmless, readers stop at the first one).
         if self.dead is not None or self._stop.is_set():
             self._fail_pending(self.dead or "engine is shut down")
+
+    def _estimated_queue_delay_s(self) -> float:
+        """Expected wait before a newly queued request is admitted: with
+        S slots draining in parallel and an EWMA per-request service
+        time, the queue drains at roughly S requests per EWMA."""
+        ewma = self.metrics.service_time_ewma_s()
+        if ewma <= 0.0:
+            return 0.0
+        slots = max(1, self.config.max_decode_slots)
+        return self._submit.qsize() * ewma / slots
+
+    def _retry_after_ms(self) -> int:
+        """Shed hint: about one slot-drain interval, floored at 50 ms so
+        clients never busy-spin, defaulting to 100 ms on a cold engine."""
+        ewma = self.metrics.service_time_ewma_s()
+        if ewma <= 0.0:
+            return 100
+        slots = max(1, self.config.max_decode_slots)
+        return max(50, int(1000.0 * ewma / slots))
+
+    @staticmethod
+    def _deadline_expired(request: GenRequest) -> bool:
+        return (
+            request.deadline is not None
+            and time.monotonic() >= request.deadline
+        )
+
+    def _expire(self, request: GenRequest, phase: str) -> None:
+        """Fail an expired request that never held (or no longer holds)
+        a slot. Slot-holding expiries go through _finish instead."""
+        self.metrics.on_deadline_expired(phase)
+        request.out.put(("error", f"{DEADLINE_MSG} while {phase}"))
+        self.metrics.on_finish(request.timings, failed=True)
 
     def stats(self) -> dict:
         snap = self.metrics.snapshot()
@@ -831,6 +928,11 @@ class InferenceEngine:
                     return admitted
                 if request.cancelled.is_set():
                     continue
+                if self._deadline_expired(request):
+                    # Dropped at dequeue: the request never tokenizes,
+                    # never allocates pages, never reaches the device.
+                    self._expire(request, "queued")
+                    continue
                 try:
                     prep = self._prepare_request(free_slots[0], request)
                     admitted = True
@@ -879,6 +981,8 @@ class InferenceEngine:
         cfg = self.config
         request.timings.prefill_start = time.monotonic()
 
+        if self._faults is not None:
+            self._faults.maybe_raise("tokenizer-error")
         prompt_ids = self.tokenizer.encode(request.prompt)
         max_new = max(
             1,
@@ -908,6 +1012,10 @@ class InferenceEngine:
             matched = self._prefix.lookup(ids)
         need = -(-(total_len + self._gamma_max) // cfg.page_size) - len(matched)
         try:
+            if self._faults is not None:
+                # Inside the try: the AllocationError path below must
+                # still release the prefix-cache lookup's page refs.
+                self._faults.maybe_raise("alloc-fail", AllocationError)
             try:
                 fresh = self.allocator.alloc(need)
             except AllocationError:
@@ -1018,6 +1126,8 @@ class InferenceEngine:
             put(temp), put(top_p), put(top_k),
         )
         try:
+            if self._faults is not None:
+                self._faults.maybe_raise("prefill-error")
             with jax.profiler.TraceAnnotation("polykey/prefill"):
                 if self._spec:
                     # Spec burst admissions batch exactly like plain ones
@@ -1218,6 +1328,8 @@ class InferenceEngine:
             put(np.asarray([request.top_p], dtype=np.float32)),
             put(np.asarray([self._eff_top_k(request)], dtype=np.int32)),
         )
+        if self._faults is not None:
+            self._faults.maybe_raise("prefill-error")
         with jax.profiler.TraceAnnotation("polykey/prefill"):
             if self._spec:
                 first_token, self.paged, self.d_paged = self._jit_spec_prefill(
@@ -1366,6 +1478,11 @@ class InferenceEngine:
         if request.cancelled.is_set():
             self._finish(slot_idx, error="cancelled")
             return
+        if self._deadline_expired(request):
+            # Expired mid-prefill: remaining chunks never dispatch.
+            self.metrics.on_deadline_expired("prefill")
+            self._finish(slot_idx, error=f"{DEADLINE_MSG} during prefill")
+            return
         C = self._chunk
         prompt_len = len(slot.pending)
         take = min(C, prompt_len - slot.filled)
@@ -1408,6 +1525,13 @@ class InferenceEngine:
         returns an opaque record for _process_step. Between dispatch and
         process the engine resolves pending prefills, overlapping their
         device time with the block's."""
+        if self._faults is not None:
+            # Stand-ins for a wedged (step-stall) or degraded (slow-step)
+            # device call: they block the engine thread exactly where the
+            # real dispatch would, so the watchdog's no-progress clock
+            # sees the genuine failure shape.
+            self._faults.maybe_sleep("step-stall")
+            self._faults.maybe_sleep("slow-step")
         if self._dev_dirty:
             # Rare (init / retire-failure recovery): mirrors must be
             # complete before they become the device state — deliver any
@@ -1597,6 +1721,12 @@ class InferenceEngine:
             if slot.request.cancelled.is_set():
                 self._finish(i, error="cancelled")
                 continue
+            if self._deadline_expired(slot.request):
+                # Block-boundary deadline drop: the lane retires now, so
+                # no further block computes for a client that is gone.
+                self.metrics.on_deadline_expired("decode")
+                self._finish(i, error=f"{DEADLINE_MSG} mid-decode")
+                continue
             if slot.token_dev is not None:
                 # First token precedes block tokens in the client stream
                 # (its copy landed with the prefill, before this block).
@@ -1673,6 +1803,10 @@ class InferenceEngine:
                 continue
             if slot.request.cancelled.is_set():
                 self._finish(i, error="cancelled")
+                continue
+            if self._deadline_expired(slot.request):
+                self.metrics.on_deadline_expired("decode")
+                self._finish(i, error=f"{DEADLINE_MSG} mid-decode")
                 continue
             if slot.token_dev is not None:
                 self._resolve_slot(i, slot)

@@ -64,6 +64,16 @@ class EngineMetrics:
         self.itl_hist = Histogram()
         self.drafts_accepted = 0
         self.drafts_proposed = 0
+        # Overload accounting (ISSUE 3): sheds at admission, deadline
+        # expiries by phase. Exported as polykey_requests_shed_total and
+        # polykey_deadline_expired_total{phase=...}.
+        self.requests_shed = 0
+        self.deadline_expired = {"queued": 0, "prefill": 0, "decode": 0}
+        # EWMA of per-request service time (admission → finish), the
+        # input to the estimated-queue-delay admission check: with S
+        # slots draining in parallel, one queued request waits roughly
+        # qsize × ewma / S before admission. 0.0 until the first finish.
+        self._service_ewma_s = 0.0
         self._window_start = time.monotonic()
         self._window_tokens = 0
         self.tokens_per_sec = 0.0
@@ -71,6 +81,18 @@ class EngineMetrics:
     def on_admit(self) -> None:
         with self._lock:
             self.requests_admitted += 1
+
+    def on_shed(self) -> None:
+        with self._lock:
+            self.requests_shed += 1
+
+    def on_deadline_expired(self, phase: str) -> None:
+        with self._lock:
+            self.deadline_expired[phase] += 1
+
+    def service_time_ewma_s(self) -> float:
+        with self._lock:
+            return self._service_ewma_s
 
     def on_step(self, num_tokens: int) -> None:
         with self._lock:
@@ -107,6 +129,13 @@ class EngineMetrics:
                 self.requests_failed += 1
             else:
                 self.requests_completed += 1
+                if timings.finished and timings.prefill_start:
+                    dur = timings.finished - timings.prefill_start
+                    if dur > 0:
+                        self._service_ewma_s = (
+                            dur if self._service_ewma_s == 0.0
+                            else 0.8 * self._service_ewma_s + 0.2 * dur
+                        )
             if ttft > 0:
                 self.ttft_ms_sum += ttft
                 self.ttft_ms_count += 1
@@ -141,6 +170,10 @@ class EngineMetrics:
                 "requests_admitted": self.requests_admitted,
                 "requests_completed": self.requests_completed,
                 "requests_failed": self.requests_failed,
+                "requests_shed": self.requests_shed,
+                "deadline_expired_queued": self.deadline_expired["queued"],
+                "deadline_expired_prefill": self.deadline_expired["prefill"],
+                "deadline_expired_decode": self.deadline_expired["decode"],
                 "tokens_generated": self.tokens_generated,
                 "decode_steps": self.decode_steps,
                 "tokens_per_sec": round(self.tokens_per_sec, 2),

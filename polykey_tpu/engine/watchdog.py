@@ -7,6 +7,14 @@ hang, deadlocked transfer). The watchdog notices missing progress while work
 is pending, flips gRPC health to NOT_SERVING (so orchestration stops routing
 and restarts per policy), and fails in-flight requests cleanly rather than
 letting clients hit their deadlines.
+
+The watchdog is RE-ARMABLE (ISSUE 3): a trip latches `tripped` and goes
+quiet, but the thread keeps running, so the supervisor
+(engine/supervisor.py) can hand it the restarted engine via `rearm()` —
+trip state resets, health resumes SERVING, and the fresh engine is
+watched from its first step. Without a supervisor the old one-shot
+behavior is unchanged: tripped stays latched and the platform restarts
+the NOT_SERVING process.
 """
 
 from __future__ import annotations
@@ -42,13 +50,28 @@ class Watchdog:
     def stop(self) -> None:
         self._stop.set()
 
+    def rearm(self, engine=None) -> None:
+        """Point the watchdog at a (restarted) engine and resume
+        watching. Resumes health to SERVING — the supervisor calls this
+        as the last step of a successful restart, when the fresh engine
+        is ready for traffic."""
+        if engine is not None:
+            self.engine = engine
+        self.tripped = False
+        if self.health is not None:
+            self.health.resume_serving()
+
     def _run(self) -> None:
-        timeout = self.engine.config.watchdog_timeout_s
         while not self._stop.wait(self.check_interval_s):
-            if not self.engine.busy:
+            # Read the reference once per tick: rearm() swaps it from the
+            # supervisor thread.
+            engine = self.engine
+            if self.tripped or engine.dead is not None:
+                continue          # quiet until rearm() hands over a live engine
+            if not engine.busy:
                 continue
-            stalled_for = time.monotonic() - self.engine.last_progress
-            if stalled_for < timeout:
+            stalled_for = time.monotonic() - engine.last_progress
+            if stalled_for < engine.config.watchdog_timeout_s:
                 continue
             self.tripped = True
             message = (
@@ -64,7 +87,7 @@ class Watchdog:
                 # engine.stats() reads host mirrors and queue sizes only —
                 # non-blocking, safe while the device call is wedged.
                 try:
-                    snap = self.engine.stats()
+                    snap = engine.stats()
                     self.recorder.event(
                         "watchdog_stall",
                         message=message,
@@ -78,10 +101,10 @@ class Watchdog:
             # Only flag and flip health here; slot/allocator state belongs to
             # the engine thread. If that thread ever returns from the wedged
             # device call it sees `dead` and fails in-flight work itself; if
-            # it never returns, clients hit request_timeout_s and the
-            # platform restarts the NOT_SERVING process (compose healthcheck).
-            self.engine.dead = message
-            self.engine._wake.set()
+            # it never returns, the supervisor (when armed) fails them and
+            # restarts, else clients hit request_timeout_s and the platform
+            # restarts the NOT_SERVING process (compose healthcheck).
+            engine.dead = message
+            engine._wake.set()
             if self.health is not None:
                 self.health.shutdown()
-            return

@@ -1,0 +1,156 @@
+"""Fault injection: named failure points, armed only via POLYKEY_FAULTS.
+
+The resilience layer (deadline drops, load shedding, watchdog trip,
+supervised restart) is unreachable by well-behaved CPU tests — a tiny
+model never hangs, never exhausts its allocator, never misses a
+deadline. This module makes those paths deterministically reachable:
+the engine asks for a module-shared `FaultInjector` at construction and
+consults it at a handful of *named injection points*; the injector is
+None unless `POLYKEY_FAULTS` is set (or a test calls `install()`), so
+every call site reduces to one attribute load plus an `is None` check —
+no parsing, no dict lookups, no clock reads on the hot path.
+
+Spec grammar (comma- or semicolon-separated entries)::
+
+    POLYKEY_FAULTS="step-stall=1.5@1,slow-step=0.01"
+
+    entry   := name [ "=" value ] [ "@" count ]
+    value   := float    seconds for sleep points; ignored by raise points
+                        (default 1.0)
+    count   := int      how many times the point fires before going
+                        inert (default: unlimited)
+
+Points (all consumed by engine/engine.py):
+
+- ``step-stall``   — sleep `value` s inside the decode dispatch (a wedged
+                     device call; trips the watchdog when it exceeds
+                     `watchdog_timeout_s`).
+- ``slow-step``    — same site, meant small and recurring (degraded
+                     device / contended tunnel).
+- ``alloc-fail``   — raise AllocationError at page allocation
+                     (pool exhaustion → admission backpressure).
+- ``prefill-error``— raise RuntimeError inside the prefill dispatch
+                     (device-side compile/execute failure).
+- ``tokenizer-error`` — raise RuntimeError at prompt tokenization
+                     (malformed-input handling at admission).
+
+The injector is intentionally module-shared: a supervised restart builds
+a *fresh* engine, and a one-shot fault (``@1``) must stay spent across
+that restart or the chaos tests could never observe recovery.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+POINTS = frozenset(
+    {"step-stall", "slow-step", "alloc-fail", "prefill-error",
+     "tokenizer-error"}
+)
+
+ENV_VAR = "POLYKEY_FAULTS"
+
+
+@dataclass
+class _Fault:
+    value: float = 1.0
+    remaining: Optional[int] = None  # None → unlimited
+    fired: int = 0
+
+
+class FaultInjector:
+    """Parsed POLYKEY_FAULTS spec with thread-safe fire accounting
+    (points are consumed from the engine thread AND gRPC handler
+    threads)."""
+
+    def __init__(self, spec: str):
+        self._lock = threading.Lock()
+        self._faults: dict[str, _Fault] = {}
+        for raw in spec.replace(";", ",").split(","):
+            entry = raw.strip()
+            if not entry:
+                continue
+            count: Optional[int] = None
+            if "@" in entry:
+                entry, count_s = entry.rsplit("@", 1)
+                count = int(count_s)
+            value = 1.0
+            if "=" in entry:
+                entry, value_s = entry.split("=", 1)
+                value = float(value_s)
+            name = entry.strip()
+            if name not in POINTS:
+                raise ValueError(
+                    f"unknown fault point {name!r}; valid points: "
+                    f"{', '.join(sorted(POINTS))}"
+                )
+            self._faults[name] = _Fault(value=value, remaining=count)
+
+    def _take(self, point: str) -> Optional[float]:
+        """Consume one firing of `point`; returns its value, or None when
+        the point is unarmed or exhausted."""
+        with self._lock:
+            fault = self._faults.get(point)
+            if fault is None or fault.remaining == 0:
+                return None
+            if fault.remaining is not None:
+                fault.remaining -= 1
+            fault.fired += 1
+            return fault.value
+
+    def maybe_sleep(self, point: str) -> None:
+        """Sleep the point's value (seconds) if it fires. Sleeping stands
+        in for a wedged/slow device call, so it deliberately blocks the
+        calling thread exactly where the real stall would."""
+        value = self._take(point)
+        if value is not None and value > 0:
+            time.sleep(value)
+
+    def maybe_raise(self, point: str, exc_type: type = RuntimeError) -> None:
+        if self._take(point) is not None:
+            raise exc_type(f"injected fault: {point}")
+
+    def fired(self, point: str) -> int:
+        with self._lock:
+            fault = self._faults.get(point)
+            return fault.fired if fault is not None else 0
+
+
+_injector: Optional[FaultInjector] = None
+_initialized = False
+_guard = threading.Lock()
+
+
+def get_injector() -> Optional[FaultInjector]:
+    """The shared injector, lazily built from POLYKEY_FAULTS on first
+    call. Returns None (and caches the None) when the env var is unset —
+    the zero-overhead guarantee call sites rely on."""
+    global _injector, _initialized
+    with _guard:
+        if not _initialized:
+            _initialized = True
+            spec = os.environ.get(ENV_VAR, "")
+            if spec:
+                _injector = FaultInjector(spec)
+        return _injector
+
+
+def install(spec: str) -> FaultInjector:
+    """Programmatic arm (tests): replaces the shared injector."""
+    global _injector, _initialized
+    with _guard:
+        _injector = FaultInjector(spec)
+        _initialized = True
+        return _injector
+
+
+def clear() -> None:
+    """Disarm and forget: the next get_injector() re-reads the env."""
+    global _injector, _initialized
+    with _guard:
+        _injector = None
+        _initialized = False

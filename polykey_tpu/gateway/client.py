@@ -10,19 +10,31 @@ tool execution) are the acceptance criterion (reference README.md:84-101).
 
 Extension: ``--tool`` selects the tool and ``--stream`` exercises the
 server-streaming RPC (prints tokens as they arrive, then TTFT/throughput).
+
+Resilience (ISSUE 3): calls retry on UNAVAILABLE (engine restarting
+under supervision) and RESOURCE_EXHAUSTED (admission shed) with
+exponential backoff + full jitter, honoring the server's
+``retry-after-ms`` trailing-metadata hint when present. DEADLINE_EXCEEDED
+is never retried (the budget is spent by definition), and a stream is
+never retried once any chunk has arrived (the server already did work
+and partial output was observed — a retry would silently duplicate it).
 """
 
 from __future__ import annotations
 
 import argparse
 import io
+import random
 import signal
 import sys
 import threading
 import time
-from typing import Optional
+from dataclasses import dataclass, field
+from typing import Callable, Optional
 
 import grpc
+
+from .errors import RETRY_AFTER_MS_KEY
 
 from ..proto import common_v2_pb2 as cmn
 from ..proto import polykey_v2_pb2 as pk
@@ -39,10 +51,65 @@ _CHANNEL_OPTIONS = [
     ("grpc.max_send_message_length", 4 * 1024 * 1024),
 ]
 
+RETRYABLE_CODES = frozenset({
+    grpc.StatusCode.UNAVAILABLE,        # engine restarting / not up yet
+    grpc.StatusCode.RESOURCE_EXHAUSTED,  # admission shed; retry-after hints
+})
+
+
+def retry_after_ms_from(err: grpc.RpcError) -> Optional[int]:
+    """The server's retry-after-ms trailing-metadata hint, if any."""
+    try:
+        metadata = err.trailing_metadata() or ()
+    except Exception:
+        return None  # not a grpc.Call (test doubles): no trailers to read
+    for key, value in metadata:
+        if key == RETRY_AFTER_MS_KEY:
+            try:
+                return int(value)
+            except ValueError:
+                return None
+    return None
+
+
+@dataclass
+class RetryPolicy:
+    """Exponential backoff with full jitter over the retryable codes.
+
+    The server's retry-after-ms hint, when present, replaces the
+    computed backoff (it knows the queue's drain rate; the client
+    doesn't) — scaled by a small random factor so a thundering herd of
+    shed clients doesn't return in lockstep. `sleep` is injectable so
+    tests assert the schedule without real waiting."""
+
+    max_attempts: int = 4
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    multiplier: float = 2.0
+    sleep: Callable[[float], None] = field(default=time.sleep)
+
+    def should_retry(self, code: grpc.StatusCode, attempt: int) -> bool:
+        return code in RETRYABLE_CODES and attempt + 1 < self.max_attempts
+
+    def delay_s(self, attempt: int, retry_after_ms: Optional[int]) -> float:
+        if retry_after_ms is not None:
+            return (retry_after_ms / 1000.0) * (1.0 + 0.25 * random.random())
+        cap = min(self.max_delay_s,
+                  self.base_delay_s * self.multiplier ** attempt)
+        return cap * (0.5 + 0.5 * random.random())
+
+
+_DEFAULT_RETRY = RetryPolicy()
+
 
 class Client:
-    def __init__(self, cfg: Config, logger: Logger):
+    def __init__(self, cfg: Config, logger: Logger,
+                 retry: Optional[RetryPolicy] = _DEFAULT_RETRY):
         self.logger = logger
+        # retry=None disables retries entirely (at-most-once semantics
+        # for non-idempotent tools); the default policy retries only
+        # codes where the server did not start the work.
+        self.retry = retry
         self.channel = self._create_channel(cfg)
         self.stub = PolykeyServiceStub(self.channel)
 
@@ -85,6 +152,20 @@ class Client:
     def close(self) -> None:
         self.channel.close()
 
+    def _backoff(self, e: grpc.RpcError, attempt: int) -> bool:
+        """Decide + perform the retry wait for a failed attempt. Returns
+        False when the error is terminal (caller re-raises)."""
+        code = e.code()
+        if self.retry is None or not self.retry.should_retry(code, attempt):
+            return False
+        delay = self.retry.delay_s(attempt, retry_after_ms_from(e))
+        self.logger.warn(
+            "gRPC call retrying", code=code.name, attempt=attempt + 1,
+            delay_ms=round(delay * 1e3, 1),
+        )
+        self.retry.sleep(delay)
+        return True
+
     def execute_tool(self, request: pk.ExecuteToolRequest, timeout: float = 30.0):
         self.logger.info(
             "Executing tool",
@@ -92,13 +173,19 @@ class Client:
             secret_id=request.secret_id if request.HasField("secret_id") else None,
             has_metadata=request.HasField("metadata"),
         )
-        try:
-            resp = self.stub.ExecuteTool(request, timeout=timeout)
-        except grpc.RpcError as e:
-            self.logger.error(
-                "gRPC call failed", code=e.code().name, message=e.details()
-            )
-            raise
+        attempt = 0
+        while True:
+            try:
+                resp = self.stub.ExecuteTool(request, timeout=timeout)
+                break
+            except grpc.RpcError as e:
+                if self._backoff(e, attempt):
+                    attempt += 1
+                    continue
+                self.logger.error(
+                    "gRPC call failed", code=e.code().name, message=e.details()
+                )
+                raise
         self._log_response(resp)
         return resp
 
@@ -109,21 +196,33 @@ class Client:
             secret_id=request.secret_id if request.HasField("secret_id") else None,
             has_metadata=request.HasField("metadata"),
         )
-        text, usage, status = [], None, None
-        try:
-            for chunk in self.stub.ExecuteToolStream(request, timeout=timeout):
-                if chunk.delta:
-                    text.append(chunk.delta)
-                if chunk.final:
-                    if chunk.HasField("status"):
-                        status = chunk.status
-                    if chunk.HasField("usage"):
-                        usage = chunk.usage
-        except grpc.RpcError as e:
-            self.logger.error(
-                "gRPC call failed", code=e.code().name, message=e.details()
-            )
-            raise
+        attempt = 0
+        while True:
+            # Fresh accumulators per attempt: a retried stream must not
+            # concatenate output from a failed one.
+            text, usage, status = [], None, None
+            received = False
+            try:
+                for chunk in self.stub.ExecuteToolStream(request, timeout=timeout):
+                    received = True
+                    if chunk.delta:
+                        text.append(chunk.delta)
+                    if chunk.final:
+                        if chunk.HasField("status"):
+                            status = chunk.status
+                        if chunk.HasField("usage"):
+                            usage = chunk.usage
+                break
+            except grpc.RpcError as e:
+                # Mid-stream failures are terminal: chunks were already
+                # observed, so a retry would silently replay output.
+                if not received and self._backoff(e, attempt):
+                    attempt += 1
+                    continue
+                self.logger.error(
+                    "gRPC call failed", code=e.code().name, message=e.details()
+                )
+                raise
         if status is not None:
             self.logger.info(
                 "Tool execution completed",

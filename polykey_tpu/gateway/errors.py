@@ -1,0 +1,103 @@
+"""Typed RPC failures + per-RPC deadline propagation.
+
+The reference maps every service error to code Unknown (a bare Go error
+through the grpc-go machinery). Overload-safe serving needs a richer
+contract, and this module is the one place it lives so the handler layer
+(server.py), the TPU backend (tpu_service.py), and the resilient client
+(client.py) can't drift apart:
+
+- ``RpcStatusError`` subclasses carry the gRPC status code the handler
+  should abort with, plus optional trailing metadata
+  (``ResourceExhaustedError`` ships the ``retry-after-ms`` hint that
+  tells well-behaved clients when to come back);
+- the RPC deadline rides a thread-local from the handler (which owns the
+  ``ServicerContext``) down to the backend (which doesn't — the Service
+  seam is context-free by reference parity), as ``current_span`` already
+  does for tracing.
+
+Retryability contract (client.py honors it): UNAVAILABLE and
+RESOURCE_EXHAUSTED are retryable — the work was never started (shed at
+admission) or the backend is restarting; DEADLINE_EXCEEDED is never
+retryable — the budget is gone by definition.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import grpc
+
+RETRY_AFTER_MS_KEY = "retry-after-ms"
+
+
+class RpcStatusError(RuntimeError):
+    """A service failure with an explicit gRPC status code. server.py
+    aborts with `code` (and any `trailing_metadata`) instead of the
+    default Unknown mapping."""
+
+    code = grpc.StatusCode.UNKNOWN
+
+    def trailing_metadata(self) -> tuple[tuple[str, str], ...]:
+        return ()
+
+
+class DeadlineExceededError(RpcStatusError):
+    """The request's deadline passed before the work finished (or could
+    start). Never retryable: the client's budget is spent."""
+
+    code = grpc.StatusCode.DEADLINE_EXCEEDED
+
+
+class ResourceExhaustedError(RpcStatusError):
+    """Admission shed the request (queue bound or estimated-delay check).
+    Retryable after `retry_after_ms` — shipped as trailing metadata so
+    clients that can't parse details still get the hint."""
+
+    code = grpc.StatusCode.RESOURCE_EXHAUSTED
+
+    def __init__(self, message: str, retry_after_ms: Optional[int] = None):
+        super().__init__(message)
+        self.retry_after_ms = retry_after_ms
+
+    def trailing_metadata(self) -> tuple[tuple[str, str], ...]:
+        if self.retry_after_ms is None:
+            return ()
+        return ((RETRY_AFTER_MS_KEY, str(int(self.retry_after_ms))),)
+
+
+class UnavailableError(RpcStatusError):
+    """The backend cannot take work right now (engine dead / restarting /
+    shut down). Retryable: a supervised restart usually brings it back."""
+
+    code = grpc.StatusCode.UNAVAILABLE
+
+
+# -- RPC deadline propagation (handler thread-local) -------------------------
+
+_local = threading.local()
+
+
+def deadline_from_context(context) -> Optional[float]:
+    """Absolute monotonic deadline from a ServicerContext, or None when
+    the client set no deadline (gRPC's time_remaining() is None then)."""
+    try:
+        remaining = context.time_remaining()
+    except Exception:
+        return None  # in-process stubs/doubles without time_remaining
+    if remaining is None:
+        return None
+    return time.monotonic() + remaining
+
+
+def set_rpc_deadline(deadline: Optional[float]) -> None:
+    """Publish the current RPC's absolute monotonic deadline for the
+    backend (handler entry sets it, handler exit clears it — threads are
+    pooled, so a missed clear would leak one RPC's deadline into the
+    next; both handlers clear in ``finally``)."""
+    _local.deadline = deadline
+
+
+def rpc_deadline() -> Optional[float]:
+    return getattr(_local, "deadline", None)

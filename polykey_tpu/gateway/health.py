@@ -50,6 +50,18 @@ class HealthService(HealthServicer):
         with self._cond:
             self._shutdown = False
 
+    def resume_serving(self) -> None:
+        """Un-latch shutdown and flip every registered status back to
+        SERVING — the supervised-restart recovery path (ISSUE 3): the
+        engine supervisor calls this once a fresh engine is ready, so
+        orchestration resumes routing without a process restart. Watch
+        streams see the NOT_SERVING → SERVING transition."""
+        with self._cond:
+            self._shutdown = False
+            for service in self._statuses:
+                self._statuses[service] = SERVING
+            self._cond.notify_all()
+
     # -- RPC methods --------------------------------------------------------
 
     def Check(self, request, context):

@@ -56,11 +56,21 @@ _GO_CODE_NAMES = {
 
 
 class _RecordingContext:
-    """ServicerContext proxy that remembers the status code the handler set."""
+    """ServicerContext proxy that remembers the status code the handler
+    set, and MERGES trailing metadata across callers: grpc's
+    set_trailing_metadata replaces wholesale, so the handler layer
+    attaching a retry-after-ms hint must not clobber the interceptor's
+    x-trace-id echo (or vice versa). Last value per key wins."""
 
     def __init__(self, context):
         self._ctx = context
         self.recorded_code = None
+        self._trailing: dict[str, str] = {}
+
+    def set_trailing_metadata(self, metadata):
+        for key, value in metadata:
+            self._trailing[key] = value
+        return self._ctx.set_trailing_metadata(tuple(self._trailing.items()))
 
     def set_code(self, code):
         self.recorded_code = code
@@ -191,8 +201,11 @@ class LoggingInterceptor(grpc.ServerInterceptor):
     def _wrap_unary(self, behavior, method):
         def wrapped(request, context):
             start = time.monotonic()
-            trace_id, span = self._begin(method, context)
+            # The recording proxy wraps BEFORE _begin so the trace-id
+            # trailer lands in its merge map; handler-set trailers
+            # (retry-after-ms) then add to it instead of replacing it.
             rec = _RecordingContext(context)
+            trace_id, span = self._begin(method, rec)
             try:
                 response = behavior(request, rec)
             except BaseException as e:
@@ -206,8 +219,8 @@ class LoggingInterceptor(grpc.ServerInterceptor):
     def _wrap_stream(self, behavior, method):
         def wrapped(request, context):
             start = time.monotonic()
-            trace_id, span = self._begin(method, context)
             rec = _RecordingContext(context)
+            trace_id, span = self._begin(method, rec)
             try:
                 yield from behavior(request, rec)
             except BaseException as e:

@@ -35,6 +35,7 @@ from ..proto.polykey_v2_grpc import (
     add_PolykeyServiceServicer_to_server,
 )
 from ..obs import MetricsHTTPServer, Observability
+from . import errors
 from .health import HealthService
 from .interceptor import LoggingInterceptor
 from .jsonlog import Logger
@@ -78,21 +79,56 @@ class PolykeyServer(PolykeyServiceServicer):
             request.metadata if request.HasField("metadata") else None,
         )
 
+    def _abort_status(self, rpc: str, context, e: errors.RpcStatusError):
+        """Abort with the typed error's code + trailing metadata (the
+        retry-after-ms contract rides the ResourceExhaustedError
+        trailer; the interceptor's recording context merges it with the
+        x-trace-id echo). Sheds and deadline expiries are EXPECTED
+        flow-control outcomes that spike exactly when the server is
+        overloaded — they log at warn so the O(1) fast-reject path can't
+        drown real errors in ERROR-level log volume."""
+        expected = e.code in (
+            grpc.StatusCode.RESOURCE_EXHAUSTED,
+            grpc.StatusCode.DEADLINE_EXCEEDED,
+        )
+        log = self.logger.warn if expected else self.logger.error
+        log(f"Service {rpc} failed", error=str(e), code=e.code.name)
+        metadata = e.trailing_metadata()
+        if metadata:
+            try:
+                context.set_trailing_metadata(metadata)
+            except Exception:
+                pass  # in-process doubles without trailer support
+        context.abort(e.code, str(e))
+
     def ExecuteTool(self, request, context):
         self._log_call("ExecuteTool", request)
+        # Deadline propagation (ISSUE 3): the Service seam is
+        # context-free (reference parity), so the RPC's remaining budget
+        # rides a thread-local the backend stamps onto GenRequest.
+        errors.set_rpc_deadline(errors.deadline_from_context(context))
         try:
             return self.service.execute_tool(*self._unpack(request))
+        except errors.RpcStatusError as e:
+            self._abort_status("ExecuteTool", context, e)
         except Exception as e:
             self.logger.error("Service ExecuteTool failed", error=str(e))
             context.abort(grpc.StatusCode.UNKNOWN, str(e))
+        finally:
+            errors.set_rpc_deadline(None)  # handler threads are pooled
 
     def ExecuteToolStream(self, request, context):
         self._log_call("ExecuteToolStream", request)
+        errors.set_rpc_deadline(errors.deadline_from_context(context))
         try:
             yield from self.service.execute_tool_stream(*self._unpack(request))
+        except errors.RpcStatusError as e:
+            self._abort_status("ExecuteToolStream", context, e)
         except Exception as e:
             self.logger.error("Service ExecuteToolStream failed", error=str(e))
             context.abort(grpc.StatusCode.UNKNOWN, str(e))
+        finally:
+            errors.set_rpc_deadline(None)
 
 
 def normalize_address(addr: str) -> str:

@@ -33,12 +33,20 @@ import time
 from typing import Iterator, Optional
 
 from ..engine.config import EngineConfig, enable_persistent_compile_cache
-from ..engine.engine import GenRequest, InferenceEngine
+from ..engine.engine import (
+    DEADLINE_MSG,
+    EngineDeadError,
+    EngineOverloadedError,
+    GenRequest,
+    InferenceEngine,
+)
+from ..engine.supervisor import EngineSupervisor
 from ..engine.tokenizer import ByteTokenizer, IncrementalDetokenizer
 from ..engine.watchdog import Watchdog
 from ..obs import Observability, current_span, engine_collector
 from ..proto import common_v2_pb2 as cmn
 from ..proto import polykey_v2_pb2 as pk
+from . import errors
 from .mock_service import MockService
 from .service import Service
 from google.protobuf import struct_pb2
@@ -57,10 +65,14 @@ class TpuService(Service):
     ):
         self.engine = engine
         self.watchdog = watchdog
+        # Set by create() when supervision is on; the supervisor swaps
+        # `self.engine` to the fresh instance after every restart.
+        self.supervisor: Optional[EngineSupervisor] = None
         self.secrets = secrets      # gateway.security.SecretStore or None
         self.logger = logger
         self.obs = obs
         self.stall_counter = None
+        self.restart_counter = None
         self._mock = MockService()
         self._profile_dir: Optional[str] = None
         if obs is not None:
@@ -72,38 +84,81 @@ class TpuService(Service):
             # accounting never depends on who registered the gauge.
             from ..obs import Counter, Gauge
 
+            # Scrape through `self.engine`, not the constructor arg: a
+            # supervised restart swaps the attribute, and the collector
+            # must follow to the live engine.
             up_gauge, created = obs.registry.get_or_create(
                 Gauge,
                 "polykey_engine_up",
                 "1 while the engine thread is alive.",
-                fn=lambda: 0.0 if engine.dead else 1.0,
+                fn=lambda: 0.0 if self.engine.dead else 1.0,
             )
             if created:
-                obs.registry.register_collector(engine_collector(engine))
+                obs.registry.register_collector(
+                    engine_collector(lambda: self.engine)
+                )
             self.stall_counter, _ = obs.registry.get_or_create(
                 Counter,
                 "polykey_watchdog_stalls_total",
                 "Watchdog trips on a wedged engine step loop.",
+            )
+            self.restart_counter, _ = obs.registry.get_or_create(
+                Counter,
+                "polykey_engine_restarts_total",
+                "Supervised in-process engine restarts.",
             )
 
     @classmethod
     def create(
         cls, engine: InferenceEngine, health=None, logger=None,
         secrets=None, obs: Optional[Observability] = None,
+        engine_factory=None,
     ) -> "TpuService":
-        """Build a service with its watchdog fully wired. The watchdog is
-        built after the service so its observability hooks (flight-
-        recorder events + stall counter) come from the shared bundle —
-        the ONE place this wiring lives (from_env and the metrics-smoke
-        probe both call it, so they can't drift apart)."""
+        """Build a service with its watchdog — and, when
+        `engine.config.supervise` (the default), its supervisor — fully
+        wired. Everything is built after the service so the
+        observability hooks (flight-recorder events, stall + restart
+        counters) come from the shared bundle — the ONE place this
+        wiring lives (from_env and the metrics-smoke probe both call it,
+        so they can't drift apart). `engine_factory` overrides how a
+        replacement engine is built on supervised restart (default:
+        reconstruct from the same config)."""
         service = cls(engine, None, secrets=secrets, logger=logger, obs=obs)
+        recorder = obs.recorder if obs is not None else None
         watchdog = Watchdog(
             engine, health=health, logger=logger,
-            recorder=obs.recorder if obs is not None else None,
+            recorder=recorder,
             stall_counter=service.stall_counter,
         )
         watchdog.start()
         service.watchdog = watchdog
+        if engine.config.supervise:
+            config = engine.config
+            # The default factory replays the original constructor inputs
+            # (raw params/seed/draft_params captured at engine init): a
+            # restart must rebuild the SAME model — silently swapping in
+            # a fresh random init would serve garbage with 200s.
+            ctor = engine._ctor_args
+            factory = engine_factory or (
+                lambda: InferenceEngine(
+                    config, params=ctor["params"], health=health,
+                    logger=logger, seed=ctor["seed"],
+                    draft_params=ctor["draft_params"],
+                )
+            )
+            supervisor = EngineSupervisor(
+                engine, factory,
+                watchdog=watchdog, health=health, logger=logger,
+                recorder=recorder,
+                restart_counter=service.restart_counter,
+                max_restarts=config.max_engine_restarts,
+                restart_window_s=config.restart_window_s,
+            )
+            supervisor.add_restart_listener(
+                lambda fresh: setattr(service, "engine", fresh)
+            )
+            supervisor.start()
+            service.supervisor = supervisor
         return service
 
     @classmethod
@@ -149,6 +204,8 @@ class TpuService(Service):
             )
 
     def close(self) -> None:
+        if self.supervisor is not None:
+            self.supervisor.stop()
         if self.watchdog is not None:
             self.watchdog.stop()
         self.engine.shutdown()
@@ -163,6 +220,10 @@ class TpuService(Service):
         cfg = self.engine.config
         return GenRequest(
             prompt=prompt,
+            # The RPC's remaining budget, published thread-locally by the
+            # handler (gateway.errors): the engine drops the request the
+            # moment it can no longer finish in time.
+            deadline=errors.rpc_deadline(),
             max_new_tokens=int(params.get("max_tokens", cfg.default_max_new_tokens)),
             # Clamp client-supplied knobs into sane ranges rather than letting
             # degenerate values (negative temp, top_p=0) reach the sampler.
@@ -202,6 +263,33 @@ class TpuService(Service):
             )
         return int(sv)
 
+    def _submit(self, request: GenRequest) -> None:
+        """Submit with the overload contract mapped to typed RPC errors:
+        sheds → RESOURCE_EXHAUSTED (+ retry-after-ms trailer), dead /
+        restarting engine → UNAVAILABLE (retryable — the supervisor is
+        probably already bringing a fresh engine up)."""
+        try:
+            self.engine.submit(request)
+        except EngineOverloadedError as e:
+            raise errors.ResourceExhaustedError(
+                str(e), retry_after_ms=e.retry_after_ms
+            ) from e
+        except EngineDeadError as e:
+            raise errors.UnavailableError(str(e)) from e
+
+    @staticmethod
+    def _engine_error(message: str) -> Exception:
+        """Map an engine failure event to the RPC status contract:
+        deadline expiries → DEADLINE_EXCEEDED (never retryable); engine
+        lifecycle failures (dead / shut down / restarting — all begin
+        "engine") → UNAVAILABLE (retryable); anything else keeps the
+        reference's Unknown mapping."""
+        if message.startswith(DEADLINE_MSG):
+            return errors.DeadlineExceededError(message)
+        if message.startswith("engine"):
+            return errors.UnavailableError(message)
+        return RuntimeError(message)
+
     def _drain(self, request: GenRequest, timeout: float):
         """Yield engine events until done/error; raises on timeout."""
         while True:
@@ -209,7 +297,9 @@ class TpuService(Service):
                 kind, value = request.out.get(timeout=timeout)
             except queue.Empty:
                 request.cancelled.set()
-                raise TimeoutError("generation timed out") from None
+                raise errors.DeadlineExceededError(
+                    "generation timed out"
+                ) from None
             yield kind, value
             if kind in ("done", "error"):
                 return
@@ -294,7 +384,7 @@ class TpuService(Service):
                     yield "delta", buf
                     buf = ""
             elif kind == "error":
-                raise RuntimeError(value)
+                raise self._engine_error(value)
             else:
                 timings = value
         if stopped:
@@ -310,7 +400,7 @@ class TpuService(Service):
                 ):
                     if kind in ("done", "error"):
                         break
-            except TimeoutError:
+            except errors.DeadlineExceededError:
                 pass
             timings = request.timings
         else:
@@ -416,6 +506,9 @@ class TpuService(Service):
                 "use stats, metrics_text, or trace"
             )
         stats = self.engine.stats()
+        if self.supervisor is not None:
+            stats["engine_restarts"] = self.supervisor.restarts
+            stats["supervisor_gave_up"] = self.supervisor.gave_up
         if self.obs is not None:
             last = self.obs.recorder.last(self._is_llm_trace)
             if last is not None:
@@ -443,7 +536,7 @@ class TpuService(Service):
         request = self._build_request(parameters)
         request.trace = span
         stops = self._parse_stops(params)
-        self.engine.submit(request)
+        self._submit(request)
 
         if not stops:
             # No stop scanning → no per-token decode: collect ids and
@@ -456,7 +549,7 @@ class TpuService(Service):
                 if kind == "token":
                     token_ids.append(value)
                 elif kind == "error":
-                    raise RuntimeError(value)
+                    raise self._engine_error(value)
             t0 = time.monotonic()
             text = self.engine.tokenizer.decode(token_ids)
             if request.trace is not None:
@@ -494,7 +587,7 @@ class TpuService(Service):
         request = self._build_request(parameters)
         request.trace = span
         stops = self._parse_stops(params)
-        self.engine.submit(request)
+        self._submit(request)
 
         timings = None
         try:

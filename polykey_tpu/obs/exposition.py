@@ -23,20 +23,31 @@ from .prometheus import (
     Registry,
     render_counter,
     render_gauge,
+    render_header,
     render_histogram,
+    render_sample,
 )
 
 
-def engine_collector(engine):
+def engine_collector(engine_or_provider):
     """Scrape-time collector over a live InferenceEngine: counters and
     gauges come from `engine.stats()` (the engine's public surface, so a
     rename of its internals can't 500 the scrape); the latency families
     read `engine.metrics.ttft_hist` / `.itl_hist` directly — those two
     attributes are part of EngineMetrics' public contract (this collector
     and the snapshot percentiles both depend on them). Registered once
-    per engine via `Registry.register_collector`."""
+    per engine via `Registry.register_collector`.
+
+    Accepts either an engine or a zero-arg provider returning one — a
+    supervised restart (engine/supervisor.py) swaps the live engine out
+    from under the registry, and the scrape must follow to the fresh
+    instance instead of reading the corpse forever."""
 
     def collect() -> list[str]:
+        engine = (
+            engine_or_provider()
+            if callable(engine_or_provider) else engine_or_provider
+        )
         snap = engine.stats()
         lines: list[str] = []
         lines += render_counter(
@@ -54,6 +65,25 @@ def engine_collector(engine):
             "stop-sequence matches and client disconnects).",
             snap["requests_failed"],
         )
+        lines += render_counter(
+            "polykey_requests_shed_total",
+            "Requests rejected at admission (queue bound or "
+            "estimated-delay check) with RESOURCE_EXHAUSTED.",
+            snap["requests_shed"],
+        )
+        # One family, one sample per expiry phase: queued (dropped at
+        # dequeue, never prefilled), prefill (mid-chunked-prefill),
+        # decode (block-boundary drop).
+        lines += render_header(
+            "polykey_deadline_expired_total",
+            "Requests dropped because their deadline passed, by phase.",
+            "counter",
+        )
+        for phase in ("queued", "prefill", "decode"):
+            lines.append(render_sample(
+                "polykey_deadline_expired_total", {"phase": phase},
+                snap[f"deadline_expired_{phase}"],
+            ))
         lines += render_counter(
             "polykey_decode_tokens_total",
             "Tokens emitted by the decode loop.", snap["tokens_generated"],

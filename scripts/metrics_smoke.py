@@ -42,6 +42,12 @@ REQUIRED_FAMILIES = (
     "polykey_engine_up",
     "polykey_watchdog_stalls_total",
     "polykey_pages_free",
+    # Overload-safety families (ISSUE 3): present (at 0) even on a
+    # healthy stack, so dashboards/alerts can be written before the
+    # first incident.
+    "polykey_requests_shed_total",
+    'polykey_deadline_expired_total{phase="queued"}',
+    "polykey_engine_restarts_total",
 )
 
 CONFIG = EngineConfig(

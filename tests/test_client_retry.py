@@ -1,0 +1,194 @@
+"""Client resilience tests (gateway/client.py) against a flaky
+in-process server: exponential backoff with jitter on UNAVAILABLE and
+RESOURCE_EXHAUSTED, the retry-after-ms trailing-metadata hint honored,
+DEADLINE_EXCEEDED never retried, and streams never retried once a chunk
+has been observed."""
+
+import io
+import types
+
+import grpc
+import pytest
+
+from polykey_tpu.gateway import client as client_mod
+from polykey_tpu.gateway import errors
+from polykey_tpu.gateway import server as gateway_server
+from polykey_tpu.gateway.client import Client, RetryPolicy
+from polykey_tpu.gateway.jsonlog import Logger
+from polykey_tpu.gateway.service import Service
+from polykey_tpu.proto import common_v2_pb2 as cmn
+from polykey_tpu.proto import polykey_v2_pb2 as pk
+
+
+class _ScriptedService(Service):
+    """Pops one action per call: an exception instance to raise, or None
+    to succeed. Stream calls optionally yield a delta BEFORE raising to
+    model mid-stream failure."""
+
+    def __init__(self, script, fail_mid_stream=False):
+        self.script = list(script)
+        self.fail_mid_stream = fail_mid_stream
+        self.calls = 0
+
+    def _next_action(self):
+        self.calls += 1
+        return self.script.pop(0) if self.script else None
+
+    def execute_tool(self, tool_name, parameters, secret_id, metadata):
+        action = self._next_action()
+        if action is not None:
+            raise action
+        return pk.ExecuteToolResponse(
+            status=cmn.Status(code=200, message="ok"),
+            string_output="flaky success",
+        )
+
+    def execute_tool_stream(self, tool_name, parameters, secret_id, metadata):
+        action = self._next_action()
+        if action is not None and self.fail_mid_stream:
+            yield pk.ExecuteToolStreamChunk(delta="partial")
+        if action is not None:
+            raise action
+        yield pk.ExecuteToolStreamChunk(delta="whole")
+        yield pk.ExecuteToolStreamChunk(
+            final=True, status=cmn.Status(code=200, message="ok")
+        )
+
+
+@pytest.fixture()
+def flaky_stack():
+    """(make_client, service_holder): boots a server around a scripted
+    service and builds a Client with a recording no-op sleep."""
+    started = []
+
+    def make(script, fail_mid_stream=False, max_attempts=4):
+        service = _ScriptedService(script, fail_mid_stream=fail_mid_stream)
+        server, _, port = gateway_server.build_server(
+            service, Logger(stream=io.StringIO()), address="127.0.0.1:0"
+        )
+        server.start()
+        sleeps: list[float] = []
+        policy = RetryPolicy(
+            max_attempts=max_attempts, base_delay_s=0.01,
+            sleep=sleeps.append,
+        )
+        cfg = types.SimpleNamespace(
+            server_address=f"127.0.0.1:{port}", timeout=5.0
+        )
+        cli = Client(cfg, Logger(stream=io.StringIO()), retry=policy)
+        started.append((server, cli))
+        return cli, service, sleeps
+
+    yield make
+    for server, cli in started:
+        cli.close()
+        server.stop(grace=None)
+
+
+def _request():
+    return pk.ExecuteToolRequest(tool_name="example_tool")
+
+
+def test_unary_retries_unavailable_then_succeeds(flaky_stack):
+    cli, service, sleeps = flaky_stack(
+        [errors.UnavailableError("engine restarting"),
+         errors.UnavailableError("engine restarting")]
+    )
+    resp = cli.execute_tool(_request(), timeout=5)
+    assert resp.string_output == "flaky success"
+    assert service.calls == 3
+    assert len(sleeps) == 2
+    # Exponential: the second wait's jitter floor exceeds half the first
+    # attempt's cap (0.01 * 2**1 * 0.5 >= 0.01 * 0.5 * 2).
+    assert all(delay > 0 for delay in sleeps)
+
+
+def test_unary_honors_retry_after_hint(flaky_stack):
+    cli, service, sleeps = flaky_stack(
+        [errors.ResourceExhaustedError("queue full", retry_after_ms=80)]
+    )
+    resp = cli.execute_tool(_request(), timeout=5)
+    assert resp.string_output == "flaky success"
+    assert service.calls == 2
+    assert len(sleeps) == 1
+    # Hint replaces computed backoff: 80ms scaled by at most +25% jitter.
+    assert 0.08 <= sleeps[0] <= 0.08 * 1.25 + 1e-9
+
+
+def test_unary_never_retries_deadline_exceeded(flaky_stack):
+    cli, service, sleeps = flaky_stack(
+        [errors.DeadlineExceededError("deadline exceeded while queued")]
+    )
+    with pytest.raises(grpc.RpcError) as err:
+        cli.execute_tool(_request(), timeout=5)
+    assert err.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+    assert service.calls == 1
+    assert sleeps == []
+
+
+def test_unary_gives_up_after_max_attempts(flaky_stack):
+    cli, service, sleeps = flaky_stack(
+        [errors.UnavailableError("down")] * 5, max_attempts=3
+    )
+    with pytest.raises(grpc.RpcError) as err:
+        cli.execute_tool(_request(), timeout=5)
+    assert err.value.code() == grpc.StatusCode.UNAVAILABLE
+    assert service.calls == 3
+    assert len(sleeps) == 2
+
+
+def test_stream_retries_before_first_chunk(flaky_stack):
+    cli, service, sleeps = flaky_stack(
+        [errors.UnavailableError("engine restarting")]
+    )
+    text = cli.execute_tool_stream(_request(), timeout=5)
+    assert text == "whole"
+    assert service.calls == 2
+    assert len(sleeps) == 1
+
+
+def test_stream_never_retries_mid_stream(flaky_stack):
+    cli, service, sleeps = flaky_stack(
+        [errors.UnavailableError("engine died mid-decode")],
+        fail_mid_stream=True,
+    )
+    with pytest.raises(grpc.RpcError) as err:
+        cli.execute_tool_stream(_request(), timeout=5)
+    assert err.value.code() == grpc.StatusCode.UNAVAILABLE
+    # A chunk was observed: retrying would replay output. One call only.
+    assert service.calls == 1
+    assert sleeps == []
+
+
+def test_retry_none_disables_retries():
+    # retry=None → at-most-once: a retryable code still fails immediately
+    # (non-idempotent tool calls must not silently duplicate work).
+    service = _ScriptedService([errors.UnavailableError("down")])
+    server, _, port = gateway_server.build_server(
+        service, Logger(stream=io.StringIO()), address="127.0.0.1:0"
+    )
+    server.start()
+    cfg = types.SimpleNamespace(server_address=f"127.0.0.1:{port}", timeout=5.0)
+    cli = Client(cfg, Logger(stream=io.StringIO()), retry=None)
+    try:
+        with pytest.raises(grpc.RpcError) as err:
+            cli.execute_tool(_request(), timeout=5)
+        assert err.value.code() == grpc.StatusCode.UNAVAILABLE
+        assert service.calls == 1
+    finally:
+        cli.close()
+        server.stop(grace=None)
+
+
+def test_retry_after_parse_helpers():
+    class _Err:
+        def __init__(self, md):
+            self._md = md
+
+        def trailing_metadata(self):
+            return self._md
+
+    assert client_mod.retry_after_ms_from(_Err((("retry-after-ms", "120"),))) == 120
+    assert client_mod.retry_after_ms_from(_Err((("other", "1"),))) is None
+    assert client_mod.retry_after_ms_from(_Err((("retry-after-ms", "nan!"),))) is None
+    assert client_mod.retry_after_ms_from(_Err(None)) is None

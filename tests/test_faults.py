@@ -1,0 +1,120 @@
+"""Unit tests for the fault-injection subsystem (polykey_tpu/faults.py).
+
+The contract under test: POLYKEY_FAULTS unset ⇒ no injector exists at
+all (the zero-overhead guarantee — engine injection points reduce to an
+`is None` check); specs parse strictly (unknown points fail fast); fire
+counts are exact and thread-safe; the module-shared injector survives
+`get_injector()` round-trips so counts persist across engine restarts.
+"""
+
+import threading
+import time
+
+import pytest
+
+from polykey_tpu import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector(monkeypatch):
+    monkeypatch.delenv(faults.ENV_VAR, raising=False)
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def test_unset_env_means_no_injector():
+    # The zero-overhead guarantee: nothing armed, nothing constructed —
+    # engine call sites see None and skip all fault work.
+    assert faults.get_injector() is None
+    # The None is cached; repeated calls stay cheap and stable.
+    assert faults.get_injector() is None
+
+
+def test_env_spec_arms_injector(monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, "slow-step=0.01@3")
+    faults.clear()  # force a re-read of the env
+    inj = faults.get_injector()
+    assert inj is not None
+    assert inj._take("slow-step") == 0.01
+    # Same shared instance on every call (counts persist across engines).
+    assert faults.get_injector() is inj
+
+
+def test_spec_grammar_defaults():
+    inj = faults.install("step-stall")
+    assert inj._take("step-stall") == 1.0          # default value
+    assert inj._take("step-stall") == 1.0          # default: unlimited
+
+
+def test_spec_count_exhausts():
+    inj = faults.install("alloc-fail@2")
+    assert inj._take("alloc-fail") is not None
+    assert inj._take("alloc-fail") is not None
+    assert inj._take("alloc-fail") is None
+    assert inj.fired("alloc-fail") == 2
+
+
+def test_spec_multiple_entries_and_separators():
+    inj = faults.install("step-stall=2.5@1; slow-step=0.1, prefill-error@4")
+    assert inj._take("step-stall") == 2.5
+    assert inj._take("slow-step") == 0.1
+    assert inj._take("prefill-error") == 1.0
+    assert inj._take("tokenizer-error") is None    # unarmed point
+
+
+def test_unknown_point_rejected():
+    with pytest.raises(ValueError, match="unknown fault point"):
+        faults.install("step-stal=1")              # typo must fail fast
+
+
+def test_maybe_raise_and_type():
+    inj = faults.install("tokenizer-error@1")
+    with pytest.raises(RuntimeError, match="injected fault"):
+        inj.maybe_raise("tokenizer-error")
+    inj.maybe_raise("tokenizer-error")             # exhausted: no-op
+
+    class Boom(Exception):
+        pass
+
+    inj2 = faults.install("alloc-fail@1")
+    with pytest.raises(Boom):
+        inj2.maybe_raise("alloc-fail", Boom)
+
+
+def test_maybe_sleep_sleeps_roughly_value():
+    inj = faults.install("slow-step=0.05@1")
+    t0 = time.monotonic()
+    inj.maybe_sleep("slow-step")
+    assert time.monotonic() - t0 >= 0.04
+    t0 = time.monotonic()
+    inj.maybe_sleep("slow-step")                   # exhausted: instant
+    assert time.monotonic() - t0 < 0.04
+
+
+def test_take_is_thread_safe_and_exact():
+    inj = faults.install("prefill-error@50")
+    hits = []
+
+    def worker():
+        for _ in range(50):
+            if inj._take("prefill-error") is not None:
+                hits.append(1)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(hits) == 50                         # exactly the budget
+    assert inj.fired("prefill-error") == 50
+
+
+def test_clear_rearms_env_read(monkeypatch):
+    faults.install("slow-step=1")
+    faults.clear()
+    assert faults.get_injector() is None           # env unset
+    monkeypatch.setenv(faults.ENV_VAR, "slow-step=2")
+    faults.clear()
+    inj = faults.get_injector()
+    assert inj is not None and inj._take("slow-step") == 2.0
