@@ -9,7 +9,7 @@ BUILD_DIR := build
 
 .PHONY: help run run-client test test-models native protos clean bench dryrun \
 	kernel-check tunnel-probe bench-tokenizer tpu-watch metrics-smoke \
-	chaos-smoke print-chaos
+	chaos-smoke print-chaos occupancy-smoke occupancy-soak
 
 help: ## Show available targets
 	@grep -E '^[a-zA-Z_-]+:.*?## .*$$' $(MAKEFILE_LIST) | \
@@ -57,6 +57,22 @@ CHAOS_TESTS := tests/test_chaos.py tests/test_faults.py tests/test_health.py \
 
 chaos-smoke: ## Run the fault-injection/resilience test suite on CPU
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest $(CHAOS_TESTS) -q
+
+# Occupancy discipline (ISSUE 4): Poisson soak at CI scale — 8 slots,
+# 10 s window, measured lanes >= 0.7 x slots (the 48-slot acceptance
+# run measured 0.82+; see perf/occupancy_soak_*.json). Artifact goes to
+# /tmp so CI runs never dirty the repo.
+occupancy-smoke: ## Poisson-load occupancy soak at CI scale (gated >= 0.7)
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/occupancy_soak.py \
+	  --slots 8 --duration 10 --min-occupancy 0.7 \
+	  --out /tmp/occupancy_smoke.json
+
+# Timestamped output so a rerun never clobbers a committed, cited
+# acceptance artifact (the script's date-only default would).
+occupancy-soak: ## The full 48-slot / 60 s acceptance soak (writes perf/)
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/occupancy_soak.py \
+	  --slots 48 --duration 60 --min-occupancy 0.8 \
+	  --out perf/occupancy_soak_$$(date -u +%Y%m%d_%H%M%S).json
 
 print-chaos: ## Print the chaos test file list (CI's single source of truth)
 	@echo $(CHAOS_TESTS)
@@ -133,9 +149,10 @@ scan: ## Security scan (Trivy fs over the tree + lockfile, CRITICAL/HIGH gate)
 	  --scanners vuln,secret \
 	  --severity CRITICAL,HIGH
 
-ci-check: ## Run the CI pipeline locally: lint+polylint, chaos, tests, native(+asan), scan
+ci-check: ## Run the CI pipeline locally: lint+polylint, chaos, occupancy, tests, native(+asan), scan
 	@$(MAKE) lint
 	@$(MAKE) chaos-smoke
+	@$(MAKE) occupancy-smoke
 	@$(MAKE) test
 	@$(MAKE) native
 	@$(MAKE) native-asan

@@ -1,0 +1,54 @@
+"""Version-compat shims over the installed JAX.
+
+The codebase targets current JAX spellings (``jax.shard_map``,
+pallas-TPU ``CompilerParams``); some images pin older releases (this
+container ships 0.4.37) where the identical functionality lives under
+legacy names (``jax.experimental.shard_map.shard_map`` with
+``check_rep``/``auto``, ``pltpu.TPUCompilerParams``). Each shim prefers
+the modern API and degrades to the legacy one, so the code reads
+current while running on both — the "stub or gate missing deps"
+discipline, applied to API renames.
+
+Kept deliberately tiny and argument-explicit: a shim that forwards
+**kwargs blindly would hide real signature drift until runtime on the
+OTHER jax version.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True,
+              axis_names=None):
+    """``jax.shard_map`` when available, else the legacy
+    ``jax.experimental.shard_map.shard_map``.
+
+    Maps the modern kwargs onto the legacy ones: ``check_vma`` was
+    named ``check_rep``; partial-manual mode was expressed as ``auto``
+    (the complement set — axes NOT manually mapped) instead of
+    ``axis_names`` (the axes that ARE)."""
+    if hasattr(jax, "shard_map"):
+        kw = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        return jax.shard_map(f, **kw)
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    kw = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              check_rep=check_vma)
+    if axis_names is not None:
+        kw["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return _legacy(f, **kw)
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` (current) or ``pltpu.TPUCompilerParams``
+    (legacy) — same fields either way."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)

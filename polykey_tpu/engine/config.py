@@ -111,6 +111,20 @@ class EngineConfig:
     max_new_tokens_cap: int = 1024
     default_max_new_tokens: int = 64
 
+    # Interleaved-prefill token budget (ISSUE 4, Sarathi-style): while any
+    # decode lane is live, at most ~this many prefill tokens (burst
+    # admission groups + long-prompt chunks) dispatch per engine-loop
+    # iteration, i.e. between two decode blocks — so a prefill burst or a
+    # long-prompt admission can no longer starve the decode lookahead
+    # pipeline and blow ITL. 0 → auto (2 × the prefill chunk). The budget
+    # is a soft bound at dispatch granularity: one admission group or one
+    # chunk always proceeds per iteration (progress floor), and the last
+    # unit may overshoot — worst case per iteration is
+    # budget + largest_bucket + chunk. With NO live decode lanes the
+    # budget is waived entirely (there is no ITL to protect; cold bursts
+    # should fill all slots at once). POLYKEY_PREFILL_BUDGET.
+    prefill_budget: int = 0
+
     # Automatic prefix caching (engine/prefix_cache.py): requests sharing a
     # page-aligned prompt prefix reuse its KV pages and prefill only the
     # suffix. prefix_cache_pages caps the cache's own page references
@@ -265,6 +279,9 @@ class EngineConfig:
                 int(x) for x in buckets.split(",")
             ) if buckets else cls.prefill_buckets,
             prefill_chunk=_env_int("POLYKEY_PREFILL_CHUNK", cls.prefill_chunk),
+            prefill_budget=_env_int(
+                "POLYKEY_PREFILL_BUDGET", cls.prefill_budget
+            ),
             max_new_tokens_cap=_env_int(
                 "POLYKEY_MAX_NEW_TOKENS_CAP", cls.max_new_tokens_cap
             ),
@@ -345,6 +362,10 @@ class EngineConfig:
             )
         if self.prefill_chunk < 0:
             raise ValueError("prefill_chunk must be >= 0 (0 → max bucket)")
+        if self.prefill_budget < 0:
+            raise ValueError(
+                "prefill_budget must be >= 0 (0 → 2 x prefill chunk)"
+            )
         if self.decode_block_steps < 1:
             raise ValueError("decode_block_steps must be >= 1")
         if self.lookahead_blocks < 1:

@@ -509,6 +509,18 @@ class InferenceEngine:
             )
 
         self._chunk = config.prefill_chunk or max(config.prefill_buckets)
+        # Interleaved-prefill budget (config.prefill_budget; 0 → auto):
+        # prefill tokens allowed per loop iteration while decode lanes
+        # are live. Floored at one chunk so a budget below the dispatch
+        # granularity still makes progress (the knob bounds stall length,
+        # it must never deadlock a long prompt).
+        self._prefill_budget = max(
+            config.prefill_budget or 2 * self._chunk, self._chunk
+        )
+        # Round-robin cursor over slots with pending chunked prefill —
+        # budgeted chunk advancement must not starve the highest-index
+        # pending slot when the budget covers fewer chunks than slots.
+        self._chunk_rr = 0
         self._block_steps = config.decode_block_steps
         # Load-adaptive block size (config.adaptive_block): the solo block
         # is a distinct static `steps` value, so it gets its own compile —
@@ -756,8 +768,15 @@ class InferenceEngine:
                 "pages_total": self.config.num_pages,
                 "queued": self._submit.qsize(),
                 "inflight_blocks": len(self._inflight_q),
+                "prefill_budget": self._prefill_budget,
             }
         )
+        if snap.get("avg_lanes") is not None:
+            # Measured occupancy fraction: step-weighted mean live lanes
+            # over the slot count (the ≥0.8 target ISSUE 4 soaks against).
+            snap["occupancy"] = round(
+                snap["avg_lanes"] / max(1, self.config.max_decode_slots), 4
+            )
         if self._spec:
             snap["spec_gamma"] = self._gamma   # live dial value
         if self._prefix is not None:
@@ -809,24 +828,35 @@ class InferenceEngine:
                     self._fail_all(self.dead)
                     return
                 # Admit every waiting request a free slot can take, every
-                # iteration. Burst admissions cost one batched prefill
-                # dispatch per bucket group (_dispatch_prefill_group), so
-                # the decode stall is bounded by a few group prefills —
-                # NOT one per request. The old `limit=1 if active` policy
-                # equilibrated occupancy at ~max_new/K lanes (a request
-                # retires every K steps for every one admitted): measured
+                # iteration — under the interleaved-prefill TOKEN BUDGET
+                # (config.prefill_budget) whenever decode lanes are live.
+                # Burst admissions cost one batched prefill dispatch per
+                # bucket group (_dispatch_prefill_group) and long prompts
+                # advance in chunks, all scheduled BETWEEN decode-block
+                # dispatches; the budget bounds how many prefill tokens
+                # ride any one gap, so a prompt burst can no longer stall
+                # in-flight decode beyond ~budget tokens of prefill work
+                # (Sarathi-style chunked interleaving; ISSUE 4). With no
+                # live lanes the budget is waived — there is no ITL to
+                # protect and cold bursts should fill every slot at once.
+                # (History: the old `limit=1 if active` admission policy
+                # equilibrated occupancy at ~max_new/K lanes — measured
                 # 5/32 live lanes and 230 tok/s where full slots give
-                # ~2,000 (r03 loop-trace, PERF.md). Long prompts still
-                # advance one chunk per iteration (chunked prefill).
+                # ~2,000; r03 loop-trace, PERF.md.)
+                decode_live = bool(self._active.any())
+                budget = self._prefill_budget if decode_live else None
                 t0 = _t()
-                worked = self._admit()
+                worked, spent = self._admit(budget=budget)
                 _acc("admit", t0)
-                chunk_slot = self._chunk_pending_slot()
-                if chunk_slot is not None:
-                    t0 = _t()
-                    self._prefill_one_chunk(chunk_slot)
+                t0 = _t()
+                remaining = None if budget is None else max(0, budget - spent)
+                chunked = self._advance_chunked_prefills(remaining)
+                if chunked:
                     _acc("chunk", t0)
                     worked = True
+                self.metrics.on_prefill_interleave(
+                    spent + chunked, decode_live
+                )
                 if self._dev_dirty and self._inflight_q:
                     # Rare full transition (init/recovery): a mirror upload
                     # may never rewind live device state, so the whole
@@ -900,32 +930,41 @@ class InferenceEngine:
                 return b
         return None
 
-    def _admit(self, limit: Optional[int] = None) -> bool:
+    def _admit(self, budget: Optional[int] = None) -> tuple[bool, int]:
         """Admit waiting requests into free slots. Short prompts are
         gathered into per-bucket groups and prefilled in ONE batched
         dispatch per group (burst admissions — e.g. cold start — pay one
         device call instead of one per request; spec engines batch the
         same way, prefilling both pools per dispatch); long prompts
-        register for chunked prefill."""
+        register for chunked prefill.
+
+        `budget` (tokens, None → unbounded) is the interleaved-prefill
+        discipline: each short admission charges its padded bucket width
+        (the prefill tokens its group dispatch will compute); once spent
+        reaches the budget, the rest of the queue WAITS for the next
+        loop iteration — i.e. for the next decode block to dispatch
+        first. Long-prompt registrations charge nothing here; their
+        chunks are budgeted as they dispatch
+        (_advance_chunked_prefills). Returns (admitted_any, spent)."""
         admitted = False
-        count = 0
+        spent = 0
         trace = getattr(self, "_trace_acc", None)
         groups: dict[int, list] = {}    # bucket → [(slot_idx, slot, ids)]
         try:
-            while limit is None or count < limit:
+            while budget is None or spent < budget:
                 free_slots = [
                     i for i, s in enumerate(self._slots) if s is None
                 ]
                 if not free_slots:
                     if trace is not None:
                         trace["adm_noslot"] = trace.get("adm_noslot", 0) + 1
-                    return admitted
+                    return admitted, spent
                 try:
                     request = self._submit.get_nowait()
                 except queue.Empty:
                     if trace is not None:
                         trace["adm_empty"] = trace.get("adm_empty", 0) + 1
-                    return admitted
+                    return admitted, spent
                 if request.cancelled.is_set():
                     continue
                 if self._deadline_expired(request):
@@ -936,11 +975,15 @@ class InferenceEngine:
                 try:
                     prep = self._prepare_request(free_slots[0], request)
                     admitted = True
-                    count += 1
                     if trace is not None:
                         trace["adm_ok"] = trace.get("adm_ok", 0) + 1
                     if prep is not None:
                         bucket = prep[0]
+                        # Budget charge = the bucket width (known only
+                        # after tokenize), so the LAST admission may
+                        # overshoot by one bucket — the budget is a soft
+                        # bound at dispatch granularity (config).
+                        spent += bucket
                         groups.setdefault(bucket, []).append(prep[1:])
                         if len(groups[bucket]) >= _MAX_PREFILL_GROUP:
                             self._dispatch_prefill_group(
@@ -952,11 +995,11 @@ class InferenceEngine:
                     if trace is not None:
                         trace["adm_alloc"] = trace.get("adm_alloc", 0) + 1
                     self._requeue_front(request)
-                    return admitted
+                    return admitted, spent
                 except Exception as e:
                     request.out.put(("error", f"admission failed: {e}"))
                     self.metrics.on_finish(request.timings, failed=True)
-            return admitted
+            return admitted, spent
         finally:
             for bucket, group in groups.items():
                 self._dispatch_prefill_group(bucket, group)
@@ -1463,11 +1506,30 @@ class InferenceEngine:
             self._process_step(self._inflight_q.popleft())
         self._resolve_prefills(block=True)
 
-    def _chunk_pending_slot(self) -> Optional[int]:
-        for i, s in enumerate(self._slots):
-            if s is not None and s.pending is not None:
-                return i
-        return None
+    def _advance_chunked_prefills(self, budget: Optional[int]) -> int:
+        """Advance slots mid-chunked-prefill, round-robin from the
+        `_chunk_rr` cursor, one chunk per slot per call, until the token
+        budget is spent (None → every pending slot advances one chunk —
+        the no-live-decode fast path). The FIRST chunk always dispatches
+        regardless of budget (progress floor: the budget bounds decode
+        stalls, it must never wedge a long prompt). Returns prefill
+        tokens dispatched."""
+        spent = 0
+        B = len(self._slots)
+        for off in range(B):
+            i = (self._chunk_rr + off) % B
+            s = self._slots[i]
+            if s is None or s.pending is None:
+                continue
+            if budget is not None and spent > 0 and spent >= budget:
+                # Leave the cursor ON the starved slot so it goes first
+                # next iteration.
+                self._chunk_rr = i
+                return spent
+            self._prefill_one_chunk(i)
+            spent += self._chunk
+        self._chunk_rr = (self._chunk_rr + 1) % B
+        return spent
 
     def _prefill_one_chunk(self, slot_idx: int) -> None:
         """Advance a long-prompt slot by one fixed-size chunk; the final
@@ -1567,6 +1629,10 @@ class InferenceEngine:
             self._depth_target = min(
                 self._depth, max(1, self._remaining_budget(act))
             )
+            # Occupancy tracker: a spec round's scan length is gamma
+            # draft steps + one verify — the step weight that makes its
+            # lane-seconds comparable to a plain K-step block's.
+            self.metrics.on_dispatch(int(act.sum()), self._gamma + 1)
             return (
                 "spec",
                 self._dispatch_spec(dev, spec_candidates),
@@ -1595,6 +1661,7 @@ class InferenceEngine:
             64, self._depth * (self._block_steps // max(1, steps)),
             blocks_needed,
         )
+        self.metrics.on_dispatch(int(act.sum()), steps)
         with jax.profiler.TraceAnnotation("polykey/decode"):
             (packed_dev, last_dev, seq_dev, act_dev,
              self.paged) = self._jit_decode(

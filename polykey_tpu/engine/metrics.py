@@ -21,6 +21,16 @@ from dataclasses import dataclass, field
 
 from ..obs.histogram import Histogram
 
+# Bucket bounds for the live-lanes-per-block histogram: lane counts are
+# small integers bounded by max_decode_slots, so a fixed power-of-two-ish
+# ladder up to 512 covers every plausible slot configuration with ~16
+# buckets (O(1) memory, same Prometheus rendering as the latency
+# histograms). Exact occupancy ratios come from the counters, not the
+# histogram — this exists for the distribution's SHAPE (is the engine
+# bimodal between empty and full, or genuinely holding N lanes?).
+LANE_BUCKETS = (1, 2, 4, 8, 12, 16, 24, 32, 48, 64, 96, 128, 192, 256,
+                384, 512)
+
 
 @dataclass
 class RequestTimings:
@@ -77,6 +87,74 @@ class EngineMetrics:
         self._window_start = time.monotonic()
         self._window_tokens = 0
         self.tokens_per_sec = 0.0
+        # Occupancy tracker (ISSUE 4): always-on per-dispatch live-lane
+        # accounting, replacing the opt-in POLYKEY_LOOP_TRACE counters as
+        # the source of truth for avg_lanes. One locked add per dispatched
+        # block (the engine loop runs a handful of dispatches per second
+        # at steady state — negligible next to the device call it rides):
+        #   blocks_dispatched — decode blocks / spec rounds dispatched
+        #   lanes_dispatched  — Σ live lanes at dispatch (block-weighted)
+        #   lane_steps        — Σ lanes × steps   (step-weighted; what the
+        #                       roofline's bytes/token actually amortizes
+        #                       over, since a K-step block reads weights K
+        #                       times at that occupancy)
+        #   steps_dispatched  — Σ steps
+        # avg_lanes in snapshots is the STEP-weighted mean; an EWMA of
+        # lanes-per-block gives the "now" gauge for dashboards.
+        self.blocks_dispatched = 0
+        self.lanes_dispatched = 0
+        self.lane_steps = 0
+        self.steps_dispatched = 0
+        self._lanes_ewma = 0.0
+        self.lanes_hist = Histogram(bounds=LANE_BUCKETS)
+        # Interleaved-prefill accounting: total prefill tokens dispatched
+        # and the worst single-iteration injection observed WHILE decode
+        # lanes were live — the bound the stall test pins (engine loop
+        # charges per iteration; see EngineConfig.prefill_budget for the
+        # overshoot semantics).
+        self.prefill_tokens_total = 0
+        self.interleave_max_tokens = 0
+
+    def on_prefill_interleave(self, tokens: int, decode_live: bool) -> None:
+        """Prefill tokens dispatched in one engine-loop iteration;
+        `decode_live` marks iterations where decode lanes were active at
+        admission time (only those can stall a running stream)."""
+        if tokens <= 0:
+            return
+        with self._lock:
+            self.prefill_tokens_total += tokens
+            if decode_live and tokens > self.interleave_max_tokens:
+                self.interleave_max_tokens = tokens
+
+    def on_dispatch(self, lanes: int, steps: int) -> None:
+        """One decode block (or spec round) dispatched with `lanes` live
+        decode lanes for `steps` device steps."""
+        with self._lock:
+            self.blocks_dispatched += 1
+            self.lanes_dispatched += lanes
+            self.lane_steps += lanes * steps
+            self.steps_dispatched += steps
+            self._lanes_ewma = (
+                float(lanes) if self.blocks_dispatched == 1
+                else 0.9 * self._lanes_ewma + 0.1 * lanes
+            )
+        self.lanes_hist.observe(float(lanes))
+
+    def lanes_snapshot(self) -> dict:
+        """Occupancy counters alone — cheap enough for harnesses to poll
+        around a measurement window and diff (occupancy_soak, bench)."""
+        with self._lock:
+            return {
+                "blocks_dispatched": self.blocks_dispatched,
+                "lanes_dispatched": self.lanes_dispatched,
+                "lane_steps": self.lane_steps,
+                "steps_dispatched": self.steps_dispatched,
+                "avg_lanes": (
+                    round(self.lane_steps / self.steps_dispatched, 2)
+                    if self.steps_dispatched else None
+                ),
+                "lanes_ewma": round(self._lanes_ewma, 2),
+            }
 
     def on_admit(self) -> None:
         with self._lock:
@@ -178,7 +256,19 @@ class EngineMetrics:
                 "decode_steps": self.decode_steps,
                 "tokens_per_sec": round(self.tokens_per_sec, 2),
                 "mean_ttft_ms": round(mean_ttft, 2),
+                "blocks_dispatched": self.blocks_dispatched,
+                "lane_steps": self.lane_steps,
+                "steps_dispatched": self.steps_dispatched,
+                "lanes_ewma": round(self._lanes_ewma, 2),
+                "prefill_tokens_total": self.prefill_tokens_total,
+                "interleave_max_tokens": self.interleave_max_tokens,
             }
+            if self.steps_dispatched:
+                # Step-weighted measured occupancy — the number roofline
+                # grading consumes (avg_lanes_source: "measured").
+                snap["avg_lanes"] = round(
+                    self.lane_steps / self.steps_dispatched, 2
+                )
             drafts_proposed = self.drafts_proposed
             drafts_accepted = self.drafts_accepted
         if self.ttft_hist.count:

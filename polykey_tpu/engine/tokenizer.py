@@ -26,11 +26,25 @@ class Tokenizer(Protocol):
 
 
 class ByteTokenizer:
-    """UTF-8 bytes with 3 specials. Vocab: 0=PAD, 1=BOS, 2=EOS, 3+b=byte b."""
+    """UTF-8 bytes with 3 specials. Vocab: 0=PAD, 1=BOS, 2=EOS, 3+b=byte b.
+
+    ``eos_id`` is -1 — the "no EOS" sentinel (models/generate.py
+    convention): the engine's stop condition ``token == eos_id`` then
+    never fires. Id 2 stays RESERVED in the vocab layout (a trained
+    byte-level checkpoint that wants an EOS can claim it and serve
+    through HFTokenizer-style config), but this hermetic tokenizer only
+    ever fronts random-init or synthetic-corpus models, which emit any
+    low id with ~uniform probability — nothing ever TRAINS id 2 to mean
+    "stop", so honoring it made every exact-budget test and every bench
+    stream length a per-prompt coin flip (root cause of the seed-carried
+    test_int8_kv_engine_serves failure: the fp32 engine and the
+    non-paged golden forward produce the IDENTICAL 8-token stream ending
+    in id 2 — the early stop was faithful decoding of a meaningless
+    "EOS", not an int8-KV defect)."""
 
     pad_id = 0
     bos_id = 1
-    eos_id = 2
+    eos_id = -1          # no EOS: id 2 is reserved but never honored
     vocab_size = 259
 
     def encode(self, text: str) -> list[int]:

@@ -39,7 +39,21 @@ class SecretCipher:
             raise CipherError(
                 f"key must be exactly {KEY_SIZE} bytes, got {len(key)}"
             )
-        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+        try:
+            from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+        except ImportError as e:
+            # Gate, don't crash opaquely: some images omit the optional
+            # `cryptography` wheel. The import is lazy (here, not module
+            # top) precisely so a deployment that never configures a
+            # secret key pays nothing and never sees this; one that DOES
+            # gets an actionable error instead of a bare ImportError
+            # from deep inside a request path.
+            raise CipherError(
+                "the 'cryptography' package is not installed; the "
+                "AES-256-GCM secret store is unavailable in this "
+                "environment (install cryptography>=41 to enable "
+                "POLYKEY_SECRET_KEY / POLYKEY_SECRETS_FILE)"
+            ) from e
 
         self._aead = AESGCM(key)
 

@@ -113,6 +113,55 @@ def engine_collector(engine_or_provider):
             "Decode throughput over the last ~1s window.",
             snap["tokens_per_sec"],
         )
+        # Occupancy tracker (ISSUE 4): measured live-lane accounting —
+        # the counters avg_lanes derives from (lane_steps / steps), the
+        # EWMA "now" gauge, and the per-block distribution. These are
+        # what replaces avg_lanes_source: "assumed_full" in roofline
+        # grading.
+        lines += render_counter(
+            "polykey_dispatched_blocks_total",
+            "Decode blocks / spec rounds dispatched.",
+            snap["blocks_dispatched"],
+        )
+        lines += render_counter(
+            "polykey_dispatched_steps_total",
+            "Device decode steps dispatched (spec rounds weigh gamma+1).",
+            snap["steps_dispatched"],
+        )
+        lines += render_counter(
+            "polykey_lane_steps_total",
+            "Live-lane-steps dispatched (sum of lanes x steps per block); "
+            "divided by polykey_dispatched_steps_total gives measured "
+            "average occupancy.",
+            snap["lane_steps"],
+        )
+        lines += render_gauge(
+            "polykey_live_lanes",
+            "EWMA of live decode lanes per dispatched block.",
+            snap["lanes_ewma"],
+        )
+        lines += render_gauge(
+            "polykey_decode_slots",
+            "Configured decode slots (occupancy denominator).",
+            snap["slots_total"],
+        )
+        lines += render_counter(
+            "polykey_prefill_tokens_total",
+            "Prefill tokens dispatched (bucket groups + chunks).",
+            snap["prefill_tokens_total"],
+        )
+        lines += render_gauge(
+            "polykey_prefill_interleave_max_tokens",
+            "Worst single-iteration prefill injection while decode lanes "
+            "were live (bounded by the prefill budget + one dispatch).",
+            snap["interleave_max_tokens"],
+        )
+        # polylint: disable=PL007(lanes are a unitless count, not a ms/bytes quantity)
+        lines += render_histogram(
+            "polykey_live_lanes_per_block",
+            "Live decode lanes at block dispatch.",
+            engine.metrics.lanes_hist,
+        )
         lines += render_histogram(
             "polykey_ttft_ms",
             "Time to first token (enqueue to first emit), ms.",

@@ -45,6 +45,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from ..compat import shard_map, tpu_compiler_params
 
 _NEG_INF = -1e30
 
@@ -353,7 +354,7 @@ def _decode_call(
             jax.ShapeDtypeStruct((B, Hq, _STAT_MINOR), jnp.float32),
             jax.ShapeDtypeStruct((B, Hq, _STAT_MINOR), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
@@ -515,7 +516,7 @@ def paged_attention_decode(
             (P(None, None, "tp", None), P(None, None, "tp"))
             if quantized else P(None, None, "tp", None)
         )
-        sm = jax.shard_map(
+        sm = shard_map(
             inner_sm,
             mesh=mesh,
             in_specs=(

@@ -57,6 +57,27 @@ from jax.sharding import Mesh
 from .mesh import AXIS_NAMES, MeshConfig
 
 
+def _runtime_initialized() -> bool:
+    """Is jax.distributed already up? `jax.distributed.is_initialized`
+    only exists on newer JAX; older releases (e.g. the 0.4.37 this image
+    ships) expose the same fact via the distributed global state's
+    client handle. Either probe failing closed (False) is safe: the
+    caller's `initialize` raises a clear error on double-init."""
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    try:
+        from jax._src import distributed as _dist
+
+        return _dist.global_state.client is not None
+    except Exception:
+        # jax-internal layout moved (no public probe exists on this
+        # version): treat as not-initialized — the only consequence is
+        # that initialize() runs and raises its own clear double-init
+        # error, which is strictly more informative than failing here.
+        return False
+
+
 def initialize_from_env(logger=None) -> bool:
     """Bring up the multi-process runtime if configured; returns True when
     jax.distributed was initialized (idempotent; safe single-host no-op)."""
@@ -97,7 +118,7 @@ def initialize_from_env(logger=None) -> bool:
             f"(coordinator={coordinator!r}, "
             f"num_processes={num_procs!r}, process_id={proc_id!r})"
         )
-    if jax.distributed.is_initialized():
+    if _runtime_initialized():
         # Keep the documented idempotency on the explicit path too (ADVICE
         # r4: a second _default_service build in one process would crash).
         return True

@@ -1,0 +1,177 @@
+"""Equal-slots KV-dtype sweep: fp pools vs int8-KV pools (VERDICT r5 #7).
+
+The open KV-dtype default decision needs two inputs: the equal-slots
+comparison that isolates the dtype's own cost/benefit (dequant work vs
+halved KV reads), and the capacity win (int8 halves pool HBM → more
+slots). The TPU halves run in `scripts/tpu_experiments.sh` the next
+hardware window (b_kv8_slots48 / b_kv8_slots64); THIS script is the
+CPU-runnable half: it drives the identical engine machinery (quantized
+pools + scale pools through admission, batched prefill, blocked decode,
+retirement) under an equal-slots closed loop and records the measured
+delta, so the decision rule in PERF.md is pre-registered against
+working, measured code rather than a hypothesis.
+
+Honesty note baked into the artifact: CPU tok/s says nothing about TPU
+HBM bandwidth (the int8 win's entire mechanism); the CPU delta measures
+the machinery's overhead on a platform where the bandwidth term is
+absent — expect int8 to LOSE slightly here. The decision itself is taken
+on hardware numbers per the rule in PERF.md.
+
+Run:  JAX_PLATFORMS=cpu python scripts/kv_dtype_sweep.py
+Env:  KV_SWEEP_SLOTS (default 16), KV_SWEEP_REQUESTS (default 4x slots),
+      KV_SWEEP_MAX_NEW (default 32).
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def bench_one(kv_dtype: str, slots: int, n_req: int, max_new: int) -> dict:
+    from polykey_tpu.engine.config import EngineConfig
+    from polykey_tpu.engine.engine import GenRequest, InferenceEngine
+
+    cfg = EngineConfig(
+        model="tiny-llama",
+        dtype="float32",
+        kv_dtype=kv_dtype,
+        max_decode_slots=slots,
+        page_size=16,
+        num_pages=slots * 16 + 64,
+        max_seq_len=256,
+        prefill_buckets=(32, 64),
+        max_new_tokens_cap=max_new,
+        decode_block_steps=4,
+        lookahead_blocks=2,
+        compile_warmup=False,
+        max_queue_depth=0,
+        supervise=False,
+    )
+    rng = np.random.default_rng(41)
+
+    def prompt() -> str:
+        n = int(rng.integers(8, 60))
+        return "".join(chr(c) for c in rng.integers(97, 123, n))
+
+    engine = InferenceEngine(cfg)
+    try:
+        # Warmup burst (compiles), then the measured closed loop at
+        # in-flight 2x slots (the saturation depth PERF.md r3 settled).
+        lock = threading.Lock()
+        errs: list = []
+
+        def closed_loop(n: int, depth: int) -> float:
+            sem = threading.Semaphore(depth)
+
+            def drain(r):
+                try:
+                    while True:
+                        kind, v = r.out.get(timeout=300.0)
+                        if kind == "done":
+                            return
+                        if kind == "error":
+                            with lock:
+                                errs.append(v)
+                            return
+                finally:
+                    sem.release()
+
+            t0 = time.monotonic()
+            threads = []
+            for _ in range(n):
+                sem.acquire()
+                r = GenRequest(prompt=prompt(), max_new_tokens=max_new)
+                engine.submit(r)
+                th = threading.Thread(target=drain, args=(r,), daemon=True)
+                th.start()
+                threads.append(th)
+            for th in threads:
+                th.join(timeout=300.0)
+            return time.monotonic() - t0
+
+        closed_loop(slots, slots)                 # warm
+        snap0 = engine.metrics.lanes_snapshot()
+        tok0 = engine.stats()["tokens_generated"]
+        elapsed = closed_loop(n_req, 2 * slots)
+        snap1 = engine.metrics.lanes_snapshot()
+        tok1 = engine.stats()["tokens_generated"]
+        if errs:
+            raise RuntimeError(f"{len(errs)} requests failed: {errs[0]}")
+        steps = snap1["steps_dispatched"] - snap0["steps_dispatched"]
+        lane_steps = snap1["lane_steps"] - snap0["lane_steps"]
+        return {
+            "kv_dtype": kv_dtype or "fp(float32)",
+            "slots": slots,
+            "requests": n_req,
+            "tok_s": round((tok1 - tok0) / elapsed, 1),
+            "avg_lanes": round(lane_steps / steps, 2) if steps else None,
+            "elapsed_s": round(elapsed, 2),
+        }
+    finally:
+        engine.shutdown()
+
+
+def main() -> None:
+    slots = int(os.environ.get("KV_SWEEP_SLOTS", "16"))
+    n_req = int(os.environ.get("KV_SWEEP_REQUESTS", str(4 * slots)))
+    max_new = int(os.environ.get("KV_SWEEP_MAX_NEW", "32"))
+
+    runs = []
+    for kv in ("", "int8"):
+        r = bench_one(kv, slots, n_req, max_new)
+        log(f"{r['kv_dtype']}: {r['tok_s']} tok/s "
+            f"(lanes {r['avg_lanes']}/{slots})")
+        runs.append(r)
+
+    fp, q8 = runs
+    result = {
+        "experiment": "kv_dtype_equal_slots_cpu",
+        "platform": jax.devices()[0].platform,
+        "model": "tiny-llama",
+        "max_new": max_new,
+        "runs": runs,
+        "int8_vs_fp": round(q8["tok_s"] / fp["tok_s"], 3),
+        "note": (
+            "CPU machinery check for the KV-dtype decision: measures the "
+            "quantize/dequant overhead on a platform WITHOUT the HBM "
+            "bandwidth term that motivates int8 KV. The default is "
+            "decided on the TPU runs (tpu_experiments.sh b_kv8_slots48/"
+            "64) per the rule pre-registered in PERF.md."
+        ),
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    perf = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "perf")
+    ts = time.strftime("%Y%m%d_%H%M%S")
+    out_path = os.path.join(perf, f"bench_exp_kv_cpu_{ts}.json")
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+        f.write("\n")
+    log(f"wrote {out_path}")
+    with open(os.path.join(perf, "experiments.log"), "a") as f:
+        f.write(
+            f"{time.strftime('%Y-%m-%dT%H:%M:%S+00:00', time.gmtime())} "
+            f"exp kv_dtype_equal_slots_cpu slots={slots}: "
+            f"fp {fp['tok_s']} tok/s vs int8-KV {q8['tok_s']} tok/s "
+            f"(ratio {result['int8_vs_fp']}) -> {os.path.basename(out_path)}\n"
+        )
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

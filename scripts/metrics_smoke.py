@@ -48,6 +48,13 @@ REQUIRED_FAMILIES = (
     "polykey_requests_shed_total",
     'polykey_deadline_expired_total{phase="queued"}',
     "polykey_engine_restarts_total",
+    # Occupancy tracker (ISSUE 4): measured live-lane families the
+    # roofline/occupancy dashboards are built on.
+    "polykey_live_lanes",
+    "polykey_lane_steps_total",
+    "polykey_dispatched_steps_total",
+    "polykey_live_lanes_per_block_bucket",
+    "polykey_prefill_tokens_total",
 )
 
 CONFIG = EngineConfig(

@@ -104,6 +104,16 @@ def serve(config, params, draft_params, prompts, max_new, temperature):
 
     eng = InferenceEngine(config, params=params, draft_params=draft_params)
     try:
+        # Warm request OUTSIDE the timed window: compile_warmup is off
+        # (dozens of tiny-engine configs in one sweep), so without this
+        # every config's dt is dominated by its own XLA compiles and the
+        # tok/s column measures the compiler, not serving.
+        warm = GenRequest(prompt=prompts[0], max_new_tokens=4,
+                          temperature=temperature,
+                          top_p=0.95 if temperature > 0 else 1.0)
+        eng.submit(warm)
+        while warm.out.get(timeout=600.0)[0] == "token":
+            pass
         reqs = [
             GenRequest(prompt=p, max_new_tokens=max_new,
                        temperature=temperature,
@@ -168,6 +178,13 @@ def main() -> None:
         prefill_buckets=(64,),
         max_new_tokens_cap=max_new,
         compile_warmup=False,
+        # Without the top-k prefilter, spec engines route any top_p<1
+        # batch through the PLAIN decode step (engine._dispatch_step's
+        # all_untruncated gate) — the sampled-temperature rows would
+        # measure the fallback and report alpha=None. 32 candidates at a
+        # 259-vocab byte model keeps truncated rejection sampling exact
+        # in practice while exercising the REAL spec serving path.
+        top_p_candidates=32,
     )
 
     results = {"train_steps": steps, "requests": n_req, "max_new": max_new,

@@ -63,6 +63,15 @@ while true; do
     # failed composes metric=bench_failed — neither is terminal success.
     if grep -q '"platform": "tpu"' "perf/bench_watcher_${ts}.json" \
         && ! grep -q '"metric": "bench_failed"' "perf/bench_watcher_${ts}.json"; then
+      # Window queue (VERDICT r5 next #7): with the baseline landed,
+      # launch the lever sweep (slots / int4 / int8-KV — the KV-dtype
+      # default decision's hardware half) in the SAME window. The
+      # runner polls for bench_watcher_*.json, which now exists, so it
+      # starts immediately; detached so the watcher can exit.
+      if ! ps -eo args | grep -q "[t]pu_experiments.sh"; then
+        setsid nohup bash scripts/tpu_experiments.sh >/dev/null 2>&1 &
+        echo "$(date -Is) launched tpu_experiments.sh (lever sweep) in this window"
+      fi
       break
     fi
     if grep -q '"platform": "tpu"' "perf/bench_watcher_${ts}.json"; then

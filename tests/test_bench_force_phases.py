@@ -1,0 +1,78 @@
+"""CPU dress rehearsal for the TPU-gated bench phases (VERDICT r5 #3).
+
+One subprocess bench run with POLYKEY_BENCH_FORCE_PHASES=1 must produce
+EVERY phase key — including the previously TPU-only C/C2/D/D2/E — with
+no error inside any entry. This is outage insurance: r3 lost its only
+hardware window ever to a harness-level failure, and before this smoke
+the forced phases' harness code had never executed end-to-end anywhere.
+
+The run stays honest: platform is "cpu", so the composed headline must
+be no_tpu_evidence — a forced run can never masquerade as measurement.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Keys a forced CPU run must land (B/B2 stay TPU-only: fabricating an
+# 8B tree is not tiny-scale and proves nothing extra about the harness).
+EXPECTED_KEYS = (
+    "gateway_echo",
+    "engine_1b",
+    "prefix_cache",
+    "grpc_e2e",
+    "engine_longctx",
+    "engine_longctx_xl",
+    "engine_moe",
+    "engine_spec",
+    "engine_gemma_spec",
+)
+
+
+def test_forced_run_yields_every_phase_key():
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "POLYKEY_BENCH_FORCE_PHASES": "1",
+        "POLYKEY_BENCH_ISOLATE": "0",
+        "POLYKEY_BENCH_NO_REPLAY": "1",
+        "POLYKEY_BENCH_PROBE_TRIES": "1",
+        "POLYKEY_BENCH_PROBE_TIMEOUT": "20",
+        # Tiny load: the smoke proves the harness paths run, not numbers.
+        "POLYKEY_BENCH_REQUESTS": "2",
+        "POLYKEY_BENCH_NEW_TOKENS": "4",
+    })
+    # A-tok depends on the local tokenizer asset; when absent the phase
+    # records an exclusion note, which is a valid (non-error) entry.
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        timeout=1500,
+    )
+    lines = proc.stdout.decode(errors="replace").strip().splitlines()
+    assert lines, f"bench produced no output; stderr tail: " \
+                  f"{proc.stderr.decode(errors='replace')[-2000:]}"
+    artifact = json.loads(lines[-1])
+    details = artifact.get("details", {})
+
+    missing = [k for k in EXPECTED_KEYS if k not in details]
+    assert not missing, (
+        f"forced run missing phase keys {missing}; "
+        f"stderr tail: {proc.stderr.decode(errors='replace')[-2000:]}"
+    )
+    errors = {
+        k: details[k]["error"] for k in EXPECTED_KEYS
+        if isinstance(details.get(k), dict) and "error" in details[k]
+    }
+    assert not errors, f"forced phases errored: {errors}"
+
+    # Engine phases carry the measured-lanes export (ISSUE 4).
+    for k in ("engine_longctx", "engine_moe", "engine_spec"):
+        assert "avg_lanes" in details[k], f"{k} lacks avg_lanes"
+
+    # Honesty: a CPU-forced run must not headline a number.
+    assert artifact["metric"] == "no_tpu_evidence"
+    assert details.get("platform") == "cpu"

@@ -50,7 +50,15 @@ def test_two_process_train_and_serve_matches_single_process():
             out, err = p.communicate(timeout=300)
             if p.returncode != 0:
                 msg = err.decode(errors="replace")[-2000:]
-                if "distributed" in msg and "unavailable" in msg.lower():
+                if (
+                    ("distributed" in msg and "unavailable" in msg.lower())
+                    # jaxlib builds without CPU multi-process collectives
+                    # (e.g. the 0.4.37 in this image) refuse at dispatch
+                    # time — a runtime capability gap, not a regression
+                    # in the code under test.
+                    or "Multiprocess computations aren't implemented"
+                    in msg
+                ):
                     pytest.skip(f"multi-process runtime unavailable: {msg}")
                 raise AssertionError(
                     f"worker rc={p.returncode}\nstdout={out.decode()}\n"

@@ -6,6 +6,7 @@ roundtrip, batch loops — plus the consumption the reference never built:
 the encrypted-at-rest SecretStore and its resolution through TpuService.
 """
 
+import importlib.util
 import io
 import json
 import os
@@ -23,6 +24,29 @@ from polykey_tpu.gateway.security import (
 KEY = bytes(range(32))
 
 
+def test_missing_cryptography_is_a_clear_gated_error():
+    """Images without the optional `cryptography` wheel must get an
+    actionable CipherError naming the package and the knobs it powers —
+    never a bare ImportError from inside a request path. Runs on every
+    platform: with the wheel present the constructor succeeds instead."""
+    try:
+        import cryptography  # noqa: F401
+    except ImportError:
+        with pytest.raises(CipherError, match="cryptography"):
+            SecretCipher(KEY)
+    else:
+        SecretCipher(KEY)  # wheel present: construction must work
+
+
+# Everything below exercises real AES-256-GCM and requires the wheel.
+requires_crypto = pytest.mark.skipif(
+    importlib.util.find_spec("cryptography") is None,
+    reason="optional dependency: this image ships no cryptography wheel "
+           "(the gated-error path is covered above)",
+)
+
+
+@requires_crypto
 def test_key_must_be_32_bytes():
     for bad in (b"", b"short", bytes(31), bytes(33)):
         with pytest.raises(CipherError):
@@ -30,12 +54,14 @@ def test_key_must_be_32_bytes():
     SecretCipher(bytes(KEY_SIZE))  # exact size accepted
 
 
+@requires_crypto
 def test_roundtrip():
     c = SecretCipher(KEY)
     for pt in (b"", b"x", b"hello secret world", os.urandom(4096)):
         assert c.decrypt(c.encrypt(pt)) == pt
 
 
+@requires_crypto
 def test_nonce_prepended_framing():
     c = SecretCipher(KEY)
     blob = c.encrypt(b"payload")
@@ -50,6 +76,7 @@ def test_nonce_prepended_framing():
         == b"payload"
 
 
+@requires_crypto
 def test_tamper_detected():
     c = SecretCipher(KEY)
     blob = bytearray(c.encrypt(b"payload"))
@@ -58,24 +85,28 @@ def test_tamper_detected():
         c.decrypt(bytes(blob))
 
 
+@requires_crypto
 def test_short_ciphertext_rejected():
     c = SecretCipher(KEY)
     with pytest.raises(CipherError):
         c.decrypt(b"tiny")
 
 
+@requires_crypto
 def test_wrong_key_fails():
     a, b = SecretCipher(KEY), SecretCipher(bytes(reversed(KEY)))
     with pytest.raises(CipherError):
         b.decrypt(a.encrypt(b"payload"))
 
 
+@requires_crypto
 def test_batch_roundtrip():
     c = SecretCipher(KEY)
     pts = [b"one", b"two", b"", os.urandom(100)]
     assert c.decrypt_batch(c.encrypt_batch(pts)) == pts
 
 
+@requires_crypto
 def test_from_hex():
     c = SecretCipher.from_hex(KEY.hex())
     assert c.decrypt(c.encrypt(b"x")) == b"x"
@@ -85,6 +116,7 @@ def test_from_hex():
         SecretCipher.from_hex("ab" * 16)  # 16 bytes, not 32
 
 
+@requires_crypto
 def test_secret_store_roundtrip(tmp_path):
     store = SecretStore(SecretCipher(KEY))
     store.put("api-key-1", "s3cr3t-value")
@@ -105,6 +137,7 @@ def test_secret_store_roundtrip(tmp_path):
     assert reloaded.resolve("api-key-2") == "другой секрет"
 
 
+@requires_crypto
 def test_secret_store_from_env(tmp_path, monkeypatch):
     path = str(tmp_path / "secrets.json")
     store = SecretStore(SecretCipher(KEY))
@@ -121,6 +154,7 @@ def test_secret_store_from_env(tmp_path, monkeypatch):
     assert SecretStore.from_env() is None
 
 
+@requires_crypto
 def test_tpu_service_resolves_secret(tmp_path):
     # The dev client's canonical request carries secret_id="secret-123"
     # (dev_client/main.go:238-258); with a store mounted the service logs
