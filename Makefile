@@ -102,7 +102,7 @@ multiproc-demo: ## 2-process jax.distributed train+serve on localhost CPU
 	bash scripts/run_multiproc_demo.sh
 
 # -- local CI reproduction (reference Makefile:217-308 scan/ci-check family) --
-.PHONY: lint polylint native-asan scan ci-check
+.PHONY: lint polylint graphlint native-asan scan ci-check
 
 lint: ## Lint: ruff (pinned ruff.toml, same config as CI) + polylint
 	@if command -v ruff >/dev/null 2>&1; then \
@@ -115,6 +115,14 @@ lint: ## Lint: ruff (pinned ruff.toml, same config as CI) + polylint
 
 polylint: ## Project-invariant static analysis (stdlib-only, always runs)
 	$(PYTHON) -m polykey_tpu.analysis
+
+# The second analysis tier (ISSUE 5): traces the real engine/model step
+# functions on a CPU backend and verifies compiled-graph contracts —
+# recompile stability (GL001), donation aliasing (GL002), dtype policy
+# (GL003), host-transfer discipline (GL004), kernel block/sharding
+# layout (GL005). ~1-2 min: it compile-warms two tiny engines.
+graphlint: ## Compiled-graph contract analysis (CPU-backed; ~1-2 min)
+	JAX_PLATFORMS=cpu $(PYTHON) -m polykey_tpu.analysis graph
 
 ASAN_FLAGS := -g -O1 -fsanitize=address,undefined -fno-omit-frame-pointer
 
@@ -149,8 +157,9 @@ scan: ## Security scan (Trivy fs over the tree + lockfile, CRITICAL/HIGH gate)
 	  --scanners vuln,secret \
 	  --severity CRITICAL,HIGH
 
-ci-check: ## Run the CI pipeline locally: lint+polylint, chaos, occupancy, tests, native(+asan), scan
+ci-check: ## Run the CI pipeline locally: lint+polylint+graphlint, chaos, occupancy, tests, native(+asan), scan
 	@$(MAKE) lint
+	@$(MAKE) graphlint
 	@$(MAKE) chaos-smoke
 	@$(MAKE) occupancy-smoke
 	@$(MAKE) test

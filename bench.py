@@ -200,9 +200,14 @@ def _artifact_timestamp(path: str, line: dict) -> float:
         except ValueError:
             pass
     try:
+        # Absolute pathspec: with -C pointing at the artifact's own dir, a
+        # RELATIVE path (a relative POLYKEY_BENCH_PERF_DIR spells one)
+        # would resolve against that dir, match nothing, and silently
+        # fall through to mtime — the exact checkout-reset failure this
+        # fallback chain exists to guard against (ADVICE r5).
         out = subprocess.run(
             ["git", "-C", os.path.dirname(os.path.abspath(path)),
-             "log", "-1", "--format=%at", "--", path],
+             "log", "-1", "--format=%at", "--", os.path.abspath(path)],
             capture_output=True, text=True, timeout=15)
         if out.returncode == 0 and out.stdout.strip():
             return float(out.stdout.strip())
@@ -245,6 +250,15 @@ def _scan_artifacts(perf_dir: str, max_age_s: float,
     return path, line, ts
 
 
+def _replay_bound_s() -> float:
+    """Current-round replay age bound in seconds (default 14 h ≈ one
+    round). One parse shared by _latest_tpu_artifact (artifact selection)
+    and _prior_round_tpu_artifact (within_current_round_bound labeling):
+    the two must agree or cross-round evidence gets current-round wording."""
+    return 3600 * float(
+        os.environ.get("POLYKEY_BENCH_REPLAY_MAX_AGE_H", "14"))
+
+
 def _replayable(line: dict) -> bool:
     """A TPU-backed, non-failed, not-already-replayed bench line."""
     det = line.get("details", {})
@@ -273,9 +287,7 @@ def _latest_tpu_artifact() -> tuple[str, dict] | None:
       file can never masquerade as this round's measurement."""
     perf_dir = os.environ.get("POLYKEY_BENCH_PERF_DIR") or os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "perf")
-    max_age_s = 3600 * float(
-        os.environ.get("POLYKEY_BENCH_REPLAY_MAX_AGE_H", "14"))
-    found = _scan_artifacts(perf_dir, max_age_s,
+    found = _scan_artifacts(perf_dir, _replay_bound_s(),
                             include_prefix="bench_watcher_")
     if found is None:
         return None
@@ -310,26 +322,50 @@ def _prior_round_tpu_artifact() -> tuple[str, dict, dict] | None:
     path, line, ts = found
 
     name = os.path.basename(path)
-    m = re.search(r"_r(\d+)", name)
-    rnd = f"r{int(m.group(1)):02d}" if m else "unknown"
     rev = ""
+    committed_at = None
     try:
+        # Commit metadata in one probe: short hash + author time of the
+        # commit that ADDED the artifact. Absolute pathspec for the same
+        # reason as _artifact_timestamp (a relative perf dir must not
+        # silently miss).
         out = subprocess.run(
             ["git", "-C", os.path.dirname(os.path.abspath(__file__)),
-             "log", "--diff-filter=A", "--format=%h", "-1", "--",
-             os.path.relpath(path,
-                             os.path.dirname(os.path.abspath(__file__)))],
+             "log", "--diff-filter=A", "--format=%h %at", "-1", "--",
+             os.path.abspath(path)],
             capture_output=True, text=True, timeout=15)
-        rev = out.stdout.strip()
+        if out.returncode == 0 and out.stdout.strip():
+            parts = out.stdout.split()
+            rev = parts[0]
+            if len(parts) > 1:
+                committed_at = float(parts[1])
     except Exception:
         # Provenance is best-effort: "unknown" engine_rev below is the
         # explicit degraded value when git isn't available.
         pass
+    # Round label, most-trustworthy first: an explicit _rNN filename tag,
+    # else the ADDING commit's date (commit metadata, ADVICE r5 — an
+    # unlabeled filename must not collapse to round "unknown" when git
+    # knows exactly which round committed it), else "unknown".
+    m = re.search(r"_r(\d+)", name)
+    if m:
+        rnd = f"r{int(m.group(1)):02d}"
+    elif committed_at is not None:
+        rnd = "round-of-" + time.strftime(
+            "%Y-%m-%d", time.gmtime(committed_at))
+    else:
+        rnd = "unknown"
+    # Within the current-round replay bound the evidence is THIS round's
+    # (just not watcher-named) — the caller softens its wording so the
+    # provenance text never claims a full-round outage that didn't happen.
+    # polylint: disable=PL002(artifact age vs a persisted epoch stamp needs the wall clock)
+    in_current_round = time.time() - ts <= _replay_bound_s()
     provenance = {
         "round": rnd,
         "date": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(ts)),
         "engine_rev": rev or "unknown",
         "cross_round": True,
+        "within_current_round_bound": in_current_round,
     }
     return path, line, provenance
 
@@ -877,13 +913,27 @@ def main() -> None:
                 path, os.path.dirname(os.path.abspath(__file__)))
             line["provenance"] = provenance
             line["measured_at"] = provenance["date"]
-            line["live_probe"] = (
-                "tpu backend unavailable for the ENTIRE round; this line "
-                f"replays the {provenance['round']} TPU artifact measured "
-                f"at {provenance['date']} (engine_rev "
-                f"{provenance['engine_rev']}). It is NOT a fresh "
-                "measurement of the current engine."
-            )
+            if provenance.get("within_current_round_bound"):
+                # The artifact is inside the 14 h current-round bound —
+                # real evidence from THIS round under a non-watcher
+                # filename. Claiming a full-round outage would misstate
+                # when it was measured (ADVICE r5).
+                line["live_probe"] = (
+                    "tpu backend unavailable at emit time; this line "
+                    f"replays a current-round TPU artifact "
+                    f"({provenance['round']}) measured at "
+                    f"{provenance['date']} (engine_rev "
+                    f"{provenance['engine_rev']}). It is NOT a fresh "
+                    "measurement."
+                )
+            else:
+                line["live_probe"] = (
+                    "tpu backend unavailable for the ENTIRE round; this "
+                    f"line replays the {provenance['round']} TPU artifact "
+                    f"measured at {provenance['date']} (engine_rev "
+                    f"{provenance['engine_rev']}). It is NOT a fresh "
+                    "measurement of the current engine."
+                )
             log(f"cross-round replay of TPU artifact {path} "
                 f"({provenance['round']})")
             print(json.dumps(line), flush=True)

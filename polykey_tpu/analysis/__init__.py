@@ -15,6 +15,15 @@ Usage::
     python -m polykey_tpu.analysis --json             # machine-readable
     python -m polykey_tpu.analysis --list-rules       # rule table
     python -m polykey_tpu.analysis --write-baseline   # grandfather
+    python -m polykey_tpu.analysis --prune            # drop stale baseline
+    python -m polykey_tpu.analysis graph              # graphlint (2nd tier)
+
+The second tier ("graphlint", ``analysis/graph.py``) verifies what the
+COMPILED graphs actually do — recompile stability, donation aliasing,
+dtype policy, host-transfer discipline, kernel/sharding layout — by
+tracing the real engine on a CPU backend. It needs jax and is imported
+lazily by the ``graph`` subcommand only; everything below stays
+stdlib-only.
 
 Per-line suppression (reason required; reasonless or unused suppressions
 are themselves findings)::
@@ -25,7 +34,12 @@ The package is stdlib-only by design: the CI lint job installs ruff and
 nothing else, and ``python -m polykey_tpu.analysis`` must run there.
 """
 
-from .baseline import apply_baseline, load_baseline, write_baseline
+from .baseline import (
+    apply_baseline,
+    load_baseline,
+    prune_baseline,
+    write_baseline,
+)
 from .core import (
     FileContext,
     Finding,
@@ -48,6 +62,7 @@ __all__ = [
     "apply_baseline",
     "check_file",
     "load_baseline",
+    "prune_baseline",
     "register",
     "rules",
     "run_paths",

@@ -77,6 +77,32 @@ def write_baseline(path: Path, findings: list[Finding]) -> int:
     return len(entries)
 
 
+def prune_baseline(path: Path, findings: list[Finding]) -> tuple[int, int]:
+    """Drop every baseline entry whose finding no longer exists — the
+    file was deleted, the line was fixed, or its content changed (any of
+    which breaks the fingerprint). Keeps the committed baseline honest:
+    entries only ever describe debt that is still real. Returns
+    (kept, dropped); the file is rewritten only when something dropped
+    (and never created when absent — an empty baseline has nothing to
+    prune)."""
+    if not path.is_file():
+        return 0, 0
+    baseline = load_baseline(path)
+    _, stale = apply_baseline(findings, baseline)
+    entries = baseline.get("findings", {})
+    for fp in stale:
+        entries.pop(fp, None)
+    if stale:
+        path.write_text(
+            json.dumps(
+                {"version": BASELINE_VERSION, "findings": entries},
+                indent=2, sort_keys=True,
+            ) + "\n",
+            encoding="utf-8",
+        )
+    return len(entries), len(stale)
+
+
 def apply_baseline(
     findings: list[Finding], baseline: dict
 ) -> tuple[list[Finding], list[str]]:

@@ -3,6 +3,12 @@
 Exit codes: 0 clean (suppressed/baselined findings allowed), 1 blocking
 findings, 2 usage error. ``--json`` emits one machine-readable object
 (findings + summary) for CI annotation tooling.
+
+``python -m polykey_tpu.analysis graph`` dispatches to the second
+analysis tier (graphlint, analysis/graph.py): compiled-graph contract
+checks that need jax, traced on a CPU backend. The AST tier here stays
+stdlib-only — the dispatch imports graph lazily so the dependency-free
+CI lint job is unaffected.
 """
 
 from __future__ import annotations
@@ -16,6 +22,7 @@ from .baseline import (
     DEFAULT_BASELINE,
     apply_baseline,
     load_baseline,
+    prune_baseline,
     write_baseline,
 )
 from .core import DEFAULT_TARGETS, all_rules, run_paths
@@ -48,6 +55,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="grandfather every current blocking finding into --baseline",
     )
     parser.add_argument(
+        "--prune", action="store_true",
+        help="drop baseline entries whose finding no longer exists "
+             "(deleted file / fixed line / changed content), then exit",
+    )
+    parser.add_argument(
         "--json", action="store_true", dest="as_json",
         help="emit findings + summary as one JSON object",
     )
@@ -59,6 +71,14 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: list[str] | None = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "graph":
+        # The graph tier needs jax; import only on explicit request so
+        # the AST tier keeps running in dependency-free environments.
+        from . import graph
+
+        return graph.main(argv[1:])
     args = build_parser().parse_args(argv)
 
     if args.list_rules:
@@ -72,6 +92,13 @@ def main(argv: list[str] | None = None) -> int:
               file=sys.stderr)
         return 2
     targets = args.targets or None
+    if args.prune and targets:
+        # A partial run can't tell "fixed" from "not scanned"; pruning
+        # against it would drop live baseline entries for every file
+        # outside the target list.
+        print("polylint: --prune requires a full run "
+              "(drop the explicit targets)", file=sys.stderr)
+        return 2
     try:
         findings = run_paths(root, targets)
     except FileNotFoundError as e:
@@ -79,6 +106,12 @@ def main(argv: list[str] | None = None) -> int:
         return 2
 
     baseline_path = root / args.baseline
+    if args.prune:
+        kept, dropped = prune_baseline(baseline_path, findings)
+        print(f"polylint: pruned {dropped} stale baseline entr"
+              f"{'y' if dropped == 1 else 'ies'} from {baseline_path} "
+              f"({kept} kept)")
+        return 0
     if args.write_baseline:
         count = write_baseline(baseline_path, findings)
         print(f"polylint: wrote {count} baseline entr"

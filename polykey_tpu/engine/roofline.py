@@ -43,26 +43,32 @@ from polykey_tpu.models.config import ModelConfig, get_config
 @dataclass(frozen=True)
 class ChipSpec:
     name: str
+    # No int8 OPS peak here: our int8 paths keep bf16 activations, so the
+    # MXU's 2x int8 mode never engages and bf16 peak stays the honest MFU
+    # denominator (grade() comment below) — an int8 field would invite
+    # grading against a ceiling this stack cannot reach.
     peak_bf16_flops: float     # FLOP/s
-    peak_int8_ops: float       # OP/s (MXU int8 runs at 2x on v5e)
     hbm_bytes_per_s: float
-    hbm_bytes: float
+    hbm_bytes: float           # per-chip capacity (drives hbm_weight_fraction)
 
 
 # Public spec-sheet numbers.
 CHIP_SPECS = {
-    # Cloud TPU v5e ("TPU v5 lite"): 197 bf16 TFLOP/s, 394 int8 TOP/s,
-    # 819 GB/s HBM BW, 16 GiB HBM per chip.
-    "tpu-v5e": ChipSpec("tpu-v5e", 197e12, 394e12, 819e9, 16 * 2**30),
+    # Cloud TPU v5e ("TPU v5 lite"): 197 bf16 TFLOP/s, 819 GB/s HBM BW,
+    # 16 GiB HBM per chip.
+    "tpu-v5e": ChipSpec("tpu-v5e", 197e12, 819e9, 16 * 2**30),
     # v5p for completeness (multi-host design target).
-    "tpu-v5p": ChipSpec("tpu-v5p", 459e12, 918e12, 2765e9, 95 * 2**30),
+    "tpu-v5p": ChipSpec("tpu-v5p", 459e12, 2765e9, 95 * 2**30),
 }
 
 
 def detect_chip() -> Optional[ChipSpec]:
     """Map jax.devices()[0].device_kind to a ChipSpec; None off-TPU (a
     CPU run has no meaningful roofline — mbu/mfu stay null there, but the
-    per-token byte/FLOP geometry is still emitted)."""
+    per-token byte/FLOP geometry is still emitted). Only kinds this table
+    actually knows map to a spec: an unknown v5 variant (or any future
+    chip) returns None rather than silently grading against v5p's
+    2765 GB/s roofline (ADVICE r5)."""
     try:
         import jax
 
@@ -72,7 +78,7 @@ def detect_chip() -> Optional[ChipSpec]:
         kind = d.device_kind.lower()
         if "v5 lite" in kind or "v5e" in kind:
             return CHIP_SPECS["tpu-v5e"]
-        if "v5p" in kind or "v5" in kind:
+        if "v5p" in kind:
             return CHIP_SPECS["tpu-v5p"]
     except Exception:
         # No devices / unqueryable backend: roofline annotation is
@@ -127,6 +133,26 @@ def weight_read_bytes(cfg: ModelConfig, dtype: str, quantize: bool,
     hit = min(float(cfg.num_experts),
               max(lanes, 1.0) * cfg.num_experts_per_tok)
     return dense + hit * per_expert
+
+
+def weight_resident_bytes(cfg: ModelConfig, dtype: str, quantize: bool,
+                          bits: int) -> float:
+    """HBM the model's weights OCCUPY (capacity, not per-step traffic):
+    every expert is resident even though a step streams only the hit
+    ones, and an untied embedding table sits in HBM even though decode
+    only row-gathers it. Feeds grade()'s hbm_weight_fraction — the
+    headroom number that decides how many KV pages (decode slots) a chip
+    has left."""
+    dense, per_expert = _weight_bytes_split(cfg, dtype, quantize, bits)
+    resident = dense
+    if cfg.is_moe:
+        resident += cfg.num_experts * per_expert
+    if not cfg.tie_embeddings:
+        # The input table; the LM head copy is already in dense. Stays
+        # int8 under quantization (models/quant.py).
+        table = cfg.vocab_size * cfg.hidden_size
+        resident += table * (1.0 if quantize else _bytes_per_el(dtype))
+    return resident
 
 
 def kv_bytes_per_token(cfg: ModelConfig, kv_dtype: str) -> float:
@@ -194,6 +220,16 @@ def grade(model: str, dtype: str, quantize: bool, quantize_bits: int,
     }
     if draft_model:
         out["draft_model"] = draft_model
+    if chip is not None:
+        # Capacity headroom: what fraction of this chip set's HBM the
+        # resident weights (draft included) consume — the complement is
+        # the KV-page budget that caps decode slots.
+        resident = weight_resident_bytes(cfg, dtype, quantize, quantize_bits)
+        if draft_model:
+            resident += weight_resident_bytes(
+                get_config(draft_model), dtype, quantize, quantize_bits)
+        out["hbm_weight_fraction"] = round(
+            resident / (n_chips * chip.hbm_bytes), 4)
     if chip is not None and tok_s > 0:
         hbm_bw = n_chips * chip.hbm_bytes_per_s
         peak = n_chips * chip.peak_bf16_flops

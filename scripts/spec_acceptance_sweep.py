@@ -193,8 +193,14 @@ def main() -> None:
                    draft_cfg.num_params() / target_cfg.num_params(), 4),
                "plain": {}, "sweep": []}
 
+    # Unrounded plain rates for the speedup division; the artifact keeps
+    # the rounded display value. Dividing by the rounded figure loses a
+    # pathologically slow host's whole sweep to round(0.04, 1) == 0.0
+    # (ADVICE r5).
+    plain_raw: dict[str, float] = {}
     for temp in temps:
         _, tok_s = serve(base, target_params, None, prompts, max_new, temp)
+        plain_raw[str(temp)] = tok_s
         results["plain"][str(temp)] = {"tok_s": round(tok_s, 1)}
         log(f"plain T={temp}: {tok_s:.1f} tok/s")
 
@@ -206,13 +212,16 @@ def main() -> None:
             stats, tok_s = serve(
                 cfg, target_params, draft_params, prompts, max_new, temp)
             alpha = stats.get("spec_acceptance")
+            plain_tok_s = plain_raw[str(temp)]
             entry = {
                 "gamma": gamma,
                 "temperature": temp,
                 "acceptance": alpha,
                 "tok_s": round(tok_s, 1),
-                "cpu_speedup_vs_plain": round(
-                    tok_s / results["plain"][str(temp)]["tok_s"], 3),
+                "cpu_speedup_vs_plain": (
+                    round(tok_s / plain_tok_s, 3)
+                    if plain_tok_s > 0 else None
+                ),
                 "drafts_proposed": stats.get("drafts_proposed"),
                 "drafts_accepted": stats.get("drafts_accepted"),
             }
@@ -225,8 +234,10 @@ def main() -> None:
                 entry["expected_tokens_per_round"] = round(
                     (1 - alpha ** (gamma + 1)) / (1 - alpha), 3)
             results["sweep"].append(entry)
+            speedup = entry["cpu_speedup_vs_plain"]
             log(f"gamma={gamma} T={temp}: alpha={alpha} "
-                f"{tok_s:.1f} tok/s ({entry['cpu_speedup_vs_plain']}x)")
+                f"{tok_s:.1f} tok/s "
+                f"({f'{speedup}x' if speedup is not None else 'n/a'})")
 
     out_path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), os.pardir,
@@ -239,10 +250,12 @@ def main() -> None:
     print("| gamma | T | acceptance | E[tok/round] | CPU tok/s | vs plain |")
     print("|---|---|---|---|---|---|")
     for e in results["sweep"]:
+        speedup = e["cpu_speedup_vs_plain"]
         print(f"| {e['gamma']} | {e['temperature']} | "
               f"{e['acceptance']} | "
               f"{e.get('expected_tokens_per_round', '—')} | "
-              f"{e['tok_s']} | {e['cpu_speedup_vs_plain']}x |")
+              f"{e['tok_s']} | "
+              f"{f'{speedup}x' if speedup is not None else '—'} |")
 
 
 if __name__ == "__main__":

@@ -30,6 +30,14 @@ import gc
 import pytest
 
 
+def pytest_configure(config):
+    # The tier-1 gate runs `-m 'not slow'`; anything heavier (e.g. the
+    # full-profile graphlint self-run) opts out with this marker.
+    config.addinivalue_line(
+        "markers", "slow: excluded from the fast tier-1 run (-m 'not slow')"
+    )
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_between_modules():
     """XLA's CPU JIT segfaults deterministically late in the FULL suite

@@ -11,6 +11,7 @@ round's official artifact, so they are pinned here:
 - artifacts older than the age bound are ignored.
 """
 
+import calendar
 import json
 import os
 import time
@@ -171,3 +172,77 @@ def test_compose_tpu_headline_unchanged():
     assert line["metric"] == "llama3_8b_int8_engine_tok_s_per_chip"
     assert line["value"] == 2100.0
     assert line["vs_baseline"] == 1.05
+
+
+def test_artifact_timestamp_git_time_with_relative_path(tmp_path, monkeypatch):
+    """The git-log fallback must resolve even when the artifact path is
+    RELATIVE (a relative POLYKEY_BENCH_PERF_DIR spells one): the pathspec
+    is passed absolute, so -C'ing into the artifact's dir cannot shift
+    its meaning. Regression for ADVICE r5 bench.py:148 — the old code
+    silently fell back to mtime (checkout time), the exact failure this
+    chain guards against."""
+    import subprocess
+
+    repo = tmp_path / "checkout"
+    perf = repo / "perf"
+    perf.mkdir(parents=True)
+    # Name must dodge the filename-stamp branches; no measured_at field.
+    artifact = perf / "bench_gitfallback.json"
+    artifact.write_text(json.dumps(_tpu_line()))
+    env = {
+        "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+        "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t",
+        "GIT_AUTHOR_DATE": "2026-07-01T00:00:00Z",
+        "GIT_COMMITTER_DATE": "2026-07-01T00:00:00Z",
+        "PATH": os.environ["PATH"],
+    }
+    subprocess.run(["git", "init", "-q"], cwd=repo, env=env, check=True)
+    subprocess.run(["git", "add", "."], cwd=repo, env=env, check=True)
+    subprocess.run(["git", "commit", "-q", "-m", "x"], cwd=repo, env=env,
+                   check=True)
+    # mtime says "now" (checkout-reset shape); git knows July 1.
+    committed = calendar.timegm(
+        time.strptime("2026-07-01T00:00:00Z", "%Y-%m-%dT%H:%M:%SZ"))
+    monkeypatch.chdir(repo)
+    ts = bench._artifact_timestamp("perf/bench_gitfallback.json",
+                                   _tpu_line())
+    assert abs(ts - committed) < 2, (
+        f"expected the git commit time, got {ts} (mtime fallback?)")
+
+
+def test_prior_round_label_from_commit_metadata(tmp_path, monkeypatch):
+    """An artifact without an _rNN filename tag derives its round label
+    from the commit that added it instead of collapsing to 'unknown'
+    (ADVICE r5 bench.py:281)."""
+    import subprocess as _sp
+
+    _write(tmp_path, "bench_stdout_tpu.json", _tpu_line())
+    real_run = _sp.run
+
+    def fake_run(cmd, **kwargs):
+        if "--diff-filter=A" in cmd:
+            class R:
+                returncode = 0
+                stdout = "abc1234 1753660800\n"   # 2025-07-28 UTC
+            return R()
+        return real_run(cmd, **kwargs)
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    path, line, prov = _select_prior(tmp_path, monkeypatch)
+    assert prov["round"] == "round-of-2025-07-28"
+    assert prov["engine_rev"] == "abc1234"
+
+
+def test_prior_round_current_bound_flag(tmp_path, monkeypatch):
+    """A freshly-written (current-round) artifact carries
+    within_current_round_bound=True so the replay wording never claims a
+    full-round outage; an aged one carries False."""
+    _write(tmp_path, "bench_stdout_r03.json", _tpu_line())
+    _, _, prov = _select_prior(tmp_path, monkeypatch)
+    assert prov["within_current_round_bound"] is True
+
+    for f in tmp_path.glob("*.json"):
+        f.unlink()
+    _write(tmp_path, "bench_stdout_r02.json", _tpu_line(), age_s=2 * 86400)
+    _, _, prov = _select_prior(tmp_path, monkeypatch)
+    assert prov["within_current_round_bound"] is False

@@ -3,7 +3,6 @@
 import pytest
 
 from polykey_tpu.gateway.config import (
-    Config,
     ConfigLoader,
     NetworkTester,
     RuntimeDetector,

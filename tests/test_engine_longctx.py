@@ -12,7 +12,6 @@ import queue
 import time
 
 import numpy as np
-import pytest
 
 from polykey_tpu.engine.config import EngineConfig
 from polykey_tpu.engine.engine import GenRequest, InferenceEngine

@@ -19,7 +19,6 @@ IncrementalDetokenizer round-trip on a real locally-built BPE tokenizer
 
 import dataclasses
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

@@ -543,3 +543,47 @@ def test_removing_an_engine_suppression_fails_lint(tmp_path, needle):
     target.write_text(stripped)
     findings = check_file(target, tmp_path)
     assert blocking(findings, "PL001")
+
+
+def test_baseline_prune_drops_stale_entries(tmp_path, capsys):
+    target = tmp_path / "polykey_tpu" / "engine" / "b.py"
+    target.parent.mkdir(parents=True)
+    target.write_text(SILENT + SILENT.replace("def f", "def h"))
+    baseline_path = tmp_path / "polylint-baseline.json"
+    write_baseline(baseline_path, run_paths(tmp_path, ["polykey_tpu"]))
+    assert len(load_baseline(baseline_path)["findings"]) == 2
+
+    # Fix ONE of the two grandfathered findings: its entry (and only
+    # its) must drop; the still-real one survives and keeps gating.
+    target.write_text(SILENT)
+    rc = main(["--root", str(tmp_path), "--prune"])
+    assert rc == 0
+    assert "pruned 1 stale" in capsys.readouterr().out
+    remaining = load_baseline(baseline_path)["findings"]
+    assert len(remaining) == 1
+    grandfathered, stale = apply_baseline(
+        run_paths(tmp_path, ["polykey_tpu"]), load_baseline(baseline_path)
+    )
+    assert not blocking(grandfathered)
+    assert not stale
+
+    # Nothing stale: prune is a no-op and must not rewrite or create.
+    rc = main(["--root", str(tmp_path), "--prune"])
+    assert rc == 0
+    assert "pruned 0 stale" in capsys.readouterr().out
+    assert len(load_baseline(baseline_path)["findings"]) == 1
+
+    # Explicit targets make a partial run: pruning against one would
+    # drop live entries for every unscanned file — refused.
+    rc = main(["--root", str(tmp_path), "--prune", "polykey_tpu"])
+    assert rc == 2
+    assert "full run" in capsys.readouterr().err
+
+
+def test_baseline_prune_without_baseline_file(tmp_path, capsys):
+    target = tmp_path / "polykey_tpu" / "engine" / "clean.py"
+    target.parent.mkdir(parents=True)
+    target.write_text("def f():\n    return 1\n")
+    rc = main(["--root", str(tmp_path), "--prune"])
+    assert rc == 0
+    assert not (tmp_path / "polylint-baseline.json").exists()

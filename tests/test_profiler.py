@@ -11,7 +11,7 @@ import pytest
 from google.protobuf import struct_pb2
 
 from polykey_tpu.engine.config import EngineConfig
-from polykey_tpu.engine.engine import GenRequest, InferenceEngine
+from polykey_tpu.engine.engine import InferenceEngine
 from polykey_tpu.gateway.tpu_service import TpuService
 
 CONFIG = EngineConfig(

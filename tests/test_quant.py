@@ -4,13 +4,11 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from polykey_tpu.engine.sampling import SamplingParams
 from polykey_tpu.models.config import TINY_LLAMA, TINY_MIXTRAL, TINY_GEMMA
 from polykey_tpu.models.generate import generate
 from polykey_tpu.models.quant import (
-    QuantizedTensor,
     dequantize,
     params_bytes,
     qdot,

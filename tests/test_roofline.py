@@ -119,3 +119,50 @@ def test_grade_cpu_null_utilization():
 def test_detect_chip_off_tpu():
     # Tests force JAX_PLATFORMS=cpu (conftest), so detection returns None.
     assert detect_chip() is None
+
+
+def test_grade_hbm_weight_fraction():
+    spec = CHIP_SPECS["tpu-v5e"]
+    g = grade("llama-3-8b", "bfloat16", True, 8, "int8",
+              tok_s=100.0, avg_lanes=8, avg_ctx=192, chip=spec)
+    # ~8 GB of int8-resident weights on a 16 GiB chip: roughly half the
+    # HBM is weights, the rest is the KV-page (decode slot) budget.
+    assert 0.4 < g["hbm_weight_fraction"] < 0.6
+    # bf16 doubles residency; the draft adds its own tree.
+    g_bf16 = grade("llama-3-8b", "bfloat16", False, 8, "",
+                   tok_s=100.0, avg_lanes=8, avg_ctx=192, chip=spec)
+    assert g_bf16["hbm_weight_fraction"] > 1.5 * g["hbm_weight_fraction"]
+    g_draft = grade("llama-3-8b", "bfloat16", True, 8, "int8",
+                    tok_s=100.0, avg_lanes=8, avg_ctx=192, chip=spec,
+                    draft_model="llama-3-8b")
+    assert g_draft["hbm_weight_fraction"] == pytest.approx(
+        2 * g["hbm_weight_fraction"], rel=0.01)
+    # Off-chip runs have no capacity denominator.
+    g_cpu = grade("tiny-llama", "bfloat16", False, 8, "",
+                  tok_s=100.0, avg_lanes=4, avg_ctx=24, chip=None)
+    assert "hbm_weight_fraction" not in g_cpu
+
+
+def test_detect_chip_unknown_kind_returns_none(monkeypatch):
+    """An unknown v5 variant (or any unrecognized kind) must NOT grade
+    against the v5p roofline (ADVICE r5): only explicit v5e/v5p kinds
+    map; everything else returns None and the scorecard degrades to
+    geometry-only."""
+    import jax as _jax
+
+    class _Dev:
+        def __init__(self, kind):
+            self.platform = "tpu"
+            self.device_kind = kind
+
+    for kind, expected in (
+        ("TPU v5 lite", "tpu-v5e"),
+        ("TPU v5e", "tpu-v5e"),
+        ("TPU v5p", "tpu-v5p"),
+        ("TPU v5x-mystery", None),   # old code: silently v5p
+        ("TPU v6e", None),
+        ("warp-drive", None),
+    ):
+        monkeypatch.setattr(_jax, "devices", lambda k=kind: [_Dev(k)])
+        got = detect_chip()
+        assert (got.name if got else None) == expected, kind
