@@ -688,8 +688,12 @@ class GraphEnv:
 
     def donation_sites(self):
         """Yield (label, lower_thunk, donated_big_leaf_count) for every
-        donate_argnames site: engine.py:428/435 (plain prefill/decode),
-        engine.py:603/612 (spec prefill/decode), train.py:110 (state)."""
+        donate_argnames site: engine.py plain prefill/decode, spec
+        prefill/decode, train.py train_step (state). The decode sites
+        donate the double-buffered slot state (last_tokens / seq_lens /
+        active) alongside the pools (ISSUE 6) — those leaves join the
+        big-leaf count (tiny at smoke scale, real at 48 slots) and any
+        dropped-donation warning on them fails the audit either way."""
         import jax
         import numpy as np
 
@@ -709,6 +713,11 @@ class GraphEnv:
                 put(np.ones((1,), np.float32)),
                 put(np.zeros((1,), np.int32)),
             )
+            # Donated double-buffered slot state rides the decode sites
+            # alongside the pools (ISSUE 6): count its leaves too, so an
+            # alias dropped on a 48-slot deployment's vectors is a
+            # deficit, not a rounding error.
+            slot_state = (dev["last_tokens"], dev["seq_lens"], dev["active"])
             if engine._spec:
                 pools = (engine.paged, engine.d_paged)
                 yield (
@@ -738,7 +747,7 @@ class GraphEnv:
                         eos_id=engine.tokenizer.eos_id,
                         candidates=0, mesh=engine.mesh,
                     ),
-                    count_big_leaves(pools),
+                    count_big_leaves((pools, slot_state)),
                 )
             else:
                 yield (
@@ -765,7 +774,7 @@ class GraphEnv:
                         eos_id=engine.tokenizer.eos_id,
                         candidates=cfg.top_p_candidates, mesh=engine.mesh,
                     ),
-                    count_big_leaves(engine.paged),
+                    count_big_leaves((engine.paged, slot_state)),
                 )
         train_step, state, batch = self.train_fixture()
         yield (
@@ -856,19 +865,37 @@ class RecompileStability(GraphCheck):
     id = "GL001"
     name = "recompile-stability"
     description = ("each jitted engine step compiles exactly once "
-                   "(at warm-up) across a mixed request sweep")
+                   "(at warm-up) across a mixed request sweep, at "
+                   "lookahead depths 1 and 2")
 
     def run(self, env: GraphEnv) -> list[Finding]:
         findings: list[Finding] = []
         for label, engine in env.engines():
             handles = env.jit_handles(engine)
             mix = env.request_mix(sampled=engine.config.warm_sampled_variants)
-            found, sizes = recompile_findings(
-                label, handles, lambda e=engine, m=mix: drive_engine(e, m)
-            )
+            # The sweep runs at both pipeline depths: depth 1 is the
+            # synchronous dispatch-then-read shape, depth 2 the
+            # double-buffered overlap (ISSUE 6). Double buffering is a
+            # host-side scheduling change over DONATED device buffers —
+            # it must not mint a single new executable (the donation
+            # chain keeps shapes/dtypes identical across generations).
+            # `_depth` is the knob POLYKEY_DISPATCH_LOOKAHEAD sets; the
+            # sweep restores the engine's configured depth afterwards.
+            def sweep(e=engine, m=mix):
+                configured = e._depth
+                try:
+                    errors: list[str] = []
+                    for depth in (1, 2):
+                        e._depth = depth
+                        errors.extend(drive_engine(e, m))
+                    return errors
+                finally:
+                    e._depth = configured
+
+            found, sizes = recompile_findings(label, handles, sweep)
             findings.extend(found)
             env.logs.append(
-                f"GL001 {label}: " + ", ".join(
+                f"GL001 {label} (depths 1+2): " + ", ".join(
                     f"{n}={b}->{a}" for n, (b, a) in sorted(sizes.items())
                 )
             )
@@ -963,6 +990,11 @@ class HostTransferGuard(GraphCheck):
         # Both serving variants run under the guard: the spec dispatch
         # path has its own annotated crossings (packed + stats reads),
         # and an unannotated transfer added there must trip here too.
+        # Both pipeline depths run (ISSUE 6): depth 2 exercises the
+        # batched-readback path (_process_step draining LANDED copies
+        # behind the dispatch frontier) — its reads must ride the same
+        # sanctioned _host_crossing scope as the synchronous depth-1
+        # read, or the guard trips here.
         import jax
 
         findings: list[Finding] = []
@@ -979,10 +1011,15 @@ class HostTransferGuard(GraphCheck):
             )
             previous = {o: getattr(jax.config, o) for o in direction_opts}
             previous_umbrella = jax.config.jax_transfer_guard
+            configured_depth = engine._depth
             jax.config.update("jax_transfer_guard", "disallow")
             try:
-                errors = drive_engine(engine, waves)
+                errors = []
+                for depth in (1, 2):
+                    engine._depth = depth
+                    errors.extend(drive_engine(engine, waves))
             finally:
+                engine._depth = configured_depth
                 # Umbrella first (it propagates into the directions),
                 # then each saved per-direction value on top.
                 jax.config.update("jax_transfer_guard", previous_umbrella)

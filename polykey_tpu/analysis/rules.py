@@ -9,6 +9,8 @@
 | PL005 | threads set daemon= or are joined by an owning stop()/shutdown() |
 | PL006 | jit boundaries stay pure; donated buffers are reassigned         |
 | PL007 | metric families are snake_case with unit suffixes (obs/ contract)|
+| PL008 | dispatch-side code never blocks on device results (readback is   |
+|       | the process side's job — the lookahead pipeline's contract)      |
 
 Static analysis trades recall for precision: each rule documents the
 lexical approximation it makes, and the escape hatch for deliberate
@@ -633,3 +635,114 @@ class PrometheusNaming(Rule):
                     f"histogram family {name!r} needs a unit suffix "
                     f"({'/'.join(self.HIST_SUFFIXES)})",
                 )
+
+
+# -- PL008: dispatch-side-sync -------------------------------------------------
+
+
+@register
+class DispatchSideSync(Rule):
+    """The lookahead dispatch pipeline's contract (engine.py): the
+    DISPATCH side enqueues device work and returns; readback belongs
+    only on the PROCESS side (`_process_step`/`_process_spec`), one
+    batched sanctioned `_host_crossing` per block. A blocking
+    ``device_get`` / ``block_until_ready`` / implicit sync
+    (``np.asarray`` over a device handle, ``.item()``) anywhere in
+    `_dispatch_step` / `_upload_slot_state` — or in a method they
+    transitively call — re-serializes the loop host-side and silently
+    erases the overlap the pipeline exists for (r03: 587 ms roundtrip
+    against 62 ms of device compute per block).
+
+    Approximation: the callee closure is the static same-file call
+    graph over ``self.X(...)`` and bare ``X(...)`` calls starting from
+    the root functions; cross-object calls (``self.metrics.X``) are
+    other classes' code and out of scope. PL001 already polices
+    name-matched hot functions — this rule adds the reachability
+    closure, so a helper with an innocuous name can't hide a sync on
+    the dispatch path. Deliberate sites (e.g. the dev-dirty cold-start
+    resolve) annotate with ``# polylint: disable=PL008(reason)``.
+    """
+
+    id = "PL008"
+    name = "dispatch-side-sync"
+    description = ("blocking device readback reachable from the dispatch "
+                   "side of the lookahead pipeline")
+
+    ROOTS = ("_dispatch_step", "_upload_slot_state")
+    SYNC_CALLS = HostSyncInHotPath.SYNC_CALLS
+    SYNC_ATTRS = HostSyncInHotPath.SYNC_ATTRS
+    DEV_NAME_RE = HostSyncInHotPath.DEV_NAME_RE
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith("polykey_tpu/engine/")
+
+    def _is_sync_call(self, node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        if call_name(node) in self.SYNC_CALLS:
+            return True
+        return (isinstance(node.func, ast.Attribute)
+                and node.func.attr in self.SYNC_ATTRS
+                and not node.args and not node.keywords)
+
+    def _touches_device(self, node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if self._is_sync_call(sub):
+                return True
+            if isinstance(sub, ast.Name) and self.DEV_NAME_RE.search(sub.id):
+                return True
+            if isinstance(sub, ast.Attribute) \
+                    and self.DEV_NAME_RE.search(sub.attr):
+                return True
+        return False
+
+    def _closure(self, funcs: dict) -> set[str]:
+        """Names reachable from ROOTS over the same-file call graph."""
+        seen: set[str] = set()
+        frontier = [r for r in self.ROOTS if r in funcs]
+        while frontier:
+            name = frontier.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            for node in ast.walk(funcs[name]):
+                if not isinstance(node, ast.Call):
+                    continue
+                cname = call_name(node)
+                # Same-class calls only: bare X(...) or self.X(...) with
+                # exactly one dot — self.metrics.X(...) is another
+                # object's method and must NOT pull a same-named local
+                # function into the closure.
+                callee = cname[len("self."):] \
+                    if cname.startswith("self.") else cname
+                if callee and "." not in callee and callee in funcs \
+                        and callee not in seen:
+                    frontier.append(callee)
+        return seen
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        funcs = {}
+        for fn in iter_functions(ctx.tree):
+            funcs.setdefault(fn.name, fn)
+        for fn_name in sorted(self._closure(funcs)):
+            via = "" if fn_name in self.ROOTS else \
+                " (reachable from the dispatch side)"
+            for node in ast.walk(funcs[fn_name]):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                if self._is_sync_call(node):
+                    what = name or f".{node.func.attr}()"  # type: ignore[union-attr]
+                    yield ctx.finding(
+                        self.id, node,
+                        f"blocking readback ({what}) in '{fn_name}'{via} — "
+                        "readback belongs on the process side; move it to "
+                        "_process_step or annotate the deliberate site",
+                    )
+                elif name in ("int", "float") and node.args \
+                        and self._touches_device(node.args[0]):
+                    yield ctx.finding(
+                        self.id, node,
+                        f"{name}() over a device value in '{fn_name}'{via} "
+                        "forces a blocking transfer on the dispatch side",
+                    )

@@ -168,9 +168,11 @@ class EngineConfig:
     # In-flight decode blocks (pipeline depth): the engine keeps up to
     # `lookahead_blocks` dispatched-but-unprocessed FULL-K blocks on the
     # device queue, so host-side processing and D2H latency hide behind
-    # device compute. When adaptive blocking shrinks K the depth scales
-    # up by the same factor (capped at 64 blocks), keeping
-    # steps-in-flight constant. Device-side stopping + per-block request snapshots make
+    # device compute. When adaptive blocking shrinks K the LOOKAHEAD
+    # portion scales up by the same factor — 1 + (depth-1) x (K/steps),
+    # capped at 64 blocks — keeping queued-ahead steps constant while
+    # depth 1 stays exactly synchronous.
+    # Device-side stopping + per-block request snapshots make
     # stale blocks safe (engine.py _run); the cost is up to
     # lookahead_blocks x decode_block_steps wasted device steps when a
     # stream finishes. 1 → classic dispatch-then-process.
@@ -300,8 +302,13 @@ class EngineConfig:
             adaptive_block=os.environ.get(
                 "POLYKEY_ADAPTIVE_BLOCK", "1"
             ).lower() in ("1", "true"),
+            # POLYKEY_DISPATCH_LOOKAHEAD is the documented knob (DEPLOY.md;
+            # the engine also honors it as a construction-time override so
+            # it works however the config was built); POLYKEY_LOOKAHEAD is
+            # the legacy alias and loses when both are set.
             lookahead_blocks=_env_int(
-                "POLYKEY_LOOKAHEAD", cls.lookahead_blocks
+                "POLYKEY_DISPATCH_LOOKAHEAD",
+                _env_int("POLYKEY_LOOKAHEAD", cls.lookahead_blocks),
             ),
             tp=_env_int("POLYKEY_TP", cls.tp),
             dp=_env_int("POLYKEY_DP", cls.dp),

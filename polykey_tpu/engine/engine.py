@@ -14,17 +14,28 @@ Design:
   `max_decode_slots` slots; prompts prefill through a small set of padded
   length buckets. Slot occupancy is data (`active` mask), not shape.
 - Latency-tolerant loop: decode runs in K-step blocks (one lax.scan
-  dispatch each, device-side EOS/cap stopping), and a bounded pipeline
-  of blocks stays in flight (`lookahead_blocks` at the full K, deepened
+  dispatch each, device-side EOS/cap stopping), structured as a lookahead
+  pipeline with two frontiers. The DISPATCH frontier runs ahead: block
+  N+1 is dispatched before block N's results are read back, with up to
+  `lookahead_blocks` slot-state generations device-resident (deepened
   proportionally when adaptive blocking shrinks K, so steps-in-flight —
-  and therefore roundtrip hiding — stay constant) while the host reads
-  one block behind through async D2H copies. Admissions prefill in padded
-  buckets (batched for bursts, chunked for long prompts) and activate
-  their lanes via tiny on-device merge dispatches — no sync, no pipeline
-  flush; retirements dispatch the mirror-image lane reset. Dispatch is
-  asynchronous and effectively free; only first syncs of fresh results
-  pay the host↔device roundtrip (PERF.md), so steady state pays ~one
-  hidden sync per block regardless of latency.
+  and therefore roundtrip hiding — stay constant). The PROCESSED frontier
+  trails one (or more) blocks behind, reading each block's packed
+  "done"/token buffer through the sanctioned `_host_crossing` path —
+  landed copies drain in batches, and only a copy that has not landed
+  yet blocks the host (measured as `host_stall_ms`). Depth 1 collapses
+  the pipeline to synchronous dispatch-then-read, bit-identically.
+  The per-step slot state (tokens / seq_lens / active) is DONATED through
+  every decode dispatch, so the pipeline is double-buffered rather than
+  allocating: at depth 2 exactly two generations exist on device — the
+  in-flight block's inputs and the outputs the next dispatch consumes —
+  and the donation chain guarantees they never alias. Admissions prefill
+  in padded buckets (batched for bursts, chunked for long prompts) and
+  activate their lanes via tiny on-device merge dispatches — no sync, no
+  pipeline flush; retirements dispatch the mirror-image lane reset.
+  Dispatch is asynchronous and effectively free; only first syncs of
+  fresh results pay the host↔device roundtrip (PERF.md), so steady state
+  pays ~one hidden sync per block regardless of latency.
 - Inactive slots point their page tables at the reserved garbage page 0 and
   carry position 0; their lanes compute masked garbage that is never read.
 - Page pools are donated through every jitted step (in-place update — the
@@ -39,13 +50,14 @@ Design:
 from __future__ import annotations
 
 import contextlib
+import os
 import queue
 import threading
 import time
 import traceback
 from dataclasses import dataclass, field
 from functools import partial
-from typing import TYPE_CHECKING, Optional
+from typing import TYPE_CHECKING, NamedTuple, Optional
 
 if TYPE_CHECKING:
     from ..obs.trace import Span
@@ -310,6 +322,21 @@ def _retire_lane_fn(last_tokens, seq_lens, page_tables, active, caps, slot):
 _MAX_PREFILL_GROUP = 8   # burst admissions batched per prefill dispatch
 
 
+class _InflightBlock(NamedTuple):
+    """One dispatched-but-unprocessed decode block (or spec round) in the
+    lookahead pipeline. A NamedTuple so legacy (kind, data, reqs) tuples
+    still unpack (tests build minimal blocks by hand); `seq` is the
+    block's dispatch sequence number — at process time,
+    engine._dispatch_seq - seq is the OBSERVED lookahead (how many newer
+    blocks were dispatched before this one's readback), the number the
+    loop-trace regression test pins."""
+
+    kind: str
+    data: object
+    reqs: list
+    seq: int = 0
+
+
 class EngineDeadError(RuntimeError):
     pass
 
@@ -458,12 +485,21 @@ class InferenceEngine:
             out_shardings=(self._repl, self._pool_sharding),
         )
         self._dp_steps = NamedSharding(self.mesh, PartitionSpec(None, "dp"))
+        # Double-buffered slot state: the three per-step-advancing vectors
+        # (last_tokens / seq_lens / active) are donated alongside the pool,
+        # so the decode chain updates them in place instead of allocating a
+        # fresh generation per block. With lookahead, the runtime keeps the
+        # in-flight block's buffers alive until it completes while the next
+        # dispatch writes the other generation — two device-resident copies
+        # that never alias (GL002 audits the aliasing). Read-only geometry
+        # (page_tables / caps / sampling params / seeds) is NOT donated:
+        # it has no corresponding output to alias into.
         self._jit_decode = jax.jit(
             _decode_fn,
             static_argnames=(
                 "cfg", "greedy", "steps", "eos_id", "candidates", "mesh",
             ),
-            donate_argnames=("paged",),
+            donate_argnames=("paged", "last_tokens", "seq_lens", "active"),
             out_shardings=(
                 self._dp_steps, self._dp_vec, self._dp_vec,
                 self._dp_vec, self._pool_sharding,
@@ -640,7 +676,12 @@ class InferenceEngine:
                 static_argnames=(
                     "t_cfg", "d_cfg", "gamma", "eos_id", "candidates", "mesh",
                 ),
-                donate_argnames=("t_paged", "d_paged"),
+                # Same double-buffered slot-state donation as the plain
+                # decode block — spec rounds ride the identical pipeline.
+                donate_argnames=(
+                    "t_paged", "d_paged",
+                    "last_tokens", "seq_lens", "active",
+                ),
                 out_shardings=(
                     self._dp_mat, self._dp_vec, self._dp_vec, self._dp_vec,
                     self._repl,
@@ -668,20 +709,41 @@ class InferenceEngine:
 
         self._submit: queue.Queue[GenRequest] = queue.Queue()
         # Lookahead pipeline: dispatched-but-unprocessed decode blocks,
-        # oldest first. Kept at ≤ _depth_target deep while dispatching
-        # (lookahead_blocks, scaled up when adaptive blocking shrinks K —
-        # constant steps-in-flight).
+        # oldest first (_InflightBlock records). While dispatching, up to
+        # _depth_target - 1 blocks stay queued ACROSS iterations — depth
+        # counts device-resident slot-state generations including the
+        # block just dispatched, so depth 2 = double-buffered overlap
+        # (dispatch N+1 before reading N) and depth 1 = synchronous
+        # dispatch-then-read, exactly. POLYKEY_DISPATCH_LOOKAHEAD
+        # overrides the config depth regardless of how the config was
+        # built (serving env, bench, tests) — the operator knob for
+        # host-bound decode (DEPLOY.md runbook).
         from collections import deque
 
         self._inflight_q: deque = deque()
-        self._depth = config.lookahead_blocks
+        try:
+            self._depth = max(1, int(os.environ.get(
+                "POLYKEY_DISPATCH_LOOKAHEAD", config.lookahead_blocks
+            )))
+        except ValueError:
+            self._depth = config.lookahead_blocks
+        # Pipeline flight recorder: a bounded ring of ("dispatch", seq) /
+        # ("process", seq, observed_lookahead, queued_after) events —
+        # cheap tuples, always on — so the dispatch/process ordering is
+        # replayable post-hoc (the loop-trace regression test asserts
+        # N+1-before-N on it; an operator can dump it from a debugger).
+        self._pipe_events: deque = deque(maxlen=512)
+        self._dispatch_seq = 0
         # In-flight target for the CURRENT block size: when the adaptive
-        # dispatcher shrinks K, the pipeline deepens by the same factor
-        # (constant steps-in-flight), because roundtrip hiding needs
-        # depth × block_time ≥ the tunnel latency — a K/8 block at the
-        # configured depth would leave the host stalled on un-landed
-        # copies. The 64-block cap binds only for large lookahead_blocks
-        # (the scale factor itself tops out at block_steps // solo_steps).
+        # dispatcher shrinks K, the LOOKAHEAD portion deepens by the
+        # same factor (1 + (depth-1) x (K/steps) — constant queued-ahead
+        # steps), because roundtrip hiding needs lookahead × block_time
+        # ≥ the tunnel latency — a K/8 block at the configured depth
+        # would leave the host stalled on un-landed copies. Only the
+        # lookahead portion scales, so depth 1 stays exactly
+        # synchronous at every block size (the escape-hatch contract).
+        # The 64-block cap binds only for large lookahead_blocks (the
+        # scale factor itself tops out at block_steps // solo_steps).
         self._depth_target = self._depth
         if config.compile_warmup:
             self._compile_warmup()
@@ -690,18 +752,11 @@ class InferenceEngine:
         self.dead: Optional[str] = None
         self.last_progress = time.monotonic()
 
-        import os as _os
-
         self._trace_acc = (
-
             {"iters": 0}
-
-            if _os.environ.get("POLYKEY_LOOP_TRACE", "") == "1"
-
+            if os.environ.get("POLYKEY_LOOP_TRACE", "") == "1"
             else None
-
         )
-
 
         self._thread = threading.Thread(
             target=self._run, name="polykey-engine", daemon=True
@@ -795,6 +850,12 @@ class InferenceEngine:
                 "queued": self._submit.qsize(),
                 "inflight_blocks": len(self._inflight_q),
                 "prefill_budget": self._prefill_budget,
+                # Lookahead pipeline (ISSUE 6): configured depth (env
+                # override included), the live adaptive target, and the
+                # host-stall/overlap numbers ride the metrics snapshot
+                # (host_stall_ms_p50, lookahead_observed_*).
+                "lookahead_depth": self._depth,
+                "lookahead_target": self._depth_target,
             }
         )
         if snap.get("avg_lanes") is not None:
@@ -888,8 +949,10 @@ class InferenceEngine:
                     # may never rewind live device state, so the whole
                     # pipeline drains first.
                     self._drain_inflight()
-                # Lookahead pipeline: keep up to `_depth_target` blocks in
-                # flight (constant steps-in-flight across block sizes).
+                # Dispatch frontier: keep up to `_depth_target` slot-state
+                # generations resident — the dispatch in hand plus
+                # `_depth_target - 1` queued blocks (constant
+                # steps-in-flight across block sizes).
                 # Device-side stopping makes stale blocks safe (a stream the
                 # host finished was stopped on device by the same EOS/cap
                 # condition, so its lookahead emit lanes read -1);
@@ -920,9 +983,28 @@ class InferenceEngine:
                 t0 = _t()
                 self._resolve_prefills()
                 _acc("resolve", t0)
-                target = self._depth_target if dispatched else 0
+                # Processed frontier: drain down to depth-1 queued blocks
+                # (depth counts the dispatch in hand, so depth 1 reads the
+                # block it just dispatched — synchronous — and depth 2
+                # keeps one block in flight while dispatching the next).
+                # Behind the forced drain, any OLDER block whose packed
+                # copy already LANDED is processed too — a free batched
+                # readback that never blocks the host. The freshest block
+                # stays in flight across the iteration boundary (floor)
+                # even when a fast device finishes it instantly: reading
+                # it now would re-serialize dispatch-then-read, and the
+                # whole point of the pipeline is that block N's readback
+                # happens AFTER block N+1's dispatch (the happens-before
+                # the loop-trace test pins). Idle iterations (floor 0)
+                # collapse the pipeline completely.
+                target = max(0, self._depth_target - 1) if dispatched else 0
+                floor = 1 if (dispatched and self._depth > 1) else 0
                 t0 = _t()
-                while len(self._inflight_q) > target:
+                while self._inflight_q and (
+                    len(self._inflight_q) > target
+                    or (len(self._inflight_q) > floor
+                        and self._block_ready(self._inflight_q[0]))
+                ):
                     self._process_step(self._inflight_q.popleft())
                     worked = True
                 _acc("process", t0)
@@ -1330,7 +1412,11 @@ class InferenceEngine:
                         eos_id=self.tokenizer.eos_id,
                         candidates=cand, mesh=self.mesh,
                     )
-                    *_, self.paged, self.d_paged = outs
+                    # Donated slot state: rebind the warmed dev entries
+                    # from the outputs or the next warmup call would feed
+                    # deleted buffers.
+                    (_, dev["last_tokens"], dev["seq_lens"], dev["active"],
+                     _, self.paged, self.d_paged) = outs
             if warm_sampled and self.config.top_p_candidates == 0:
                 # Without the top-k prefilter, a batch containing any
                 # sampled top_p<1 row leaves the spec path entirely and
@@ -1349,7 +1435,8 @@ class InferenceEngine:
                         eos_id=self.tokenizer.eos_id,
                         candidates=0, mesh=self.mesh,
                     )
-                    *_, self.paged = outs
+                    (_, dev["last_tokens"], dev["seq_lens"], dev["active"],
+                     self.paged) = outs
         else:
             # greedy is batch-keyed at dispatch (all-greedy vs any-sampled)
             # and the adaptive dispatcher alternates between the solo and
@@ -1365,7 +1452,10 @@ class InferenceEngine:
                         eos_id=self.tokenizer.eos_id,
                         candidates=self.config.top_p_candidates, mesh=self.mesh,
                     )
-                    *_, self.paged = outs
+                    # Donated slot state: rebind or the next warmup call
+                    # would feed deleted buffers.
+                    (_, dev["last_tokens"], dev["seq_lens"], dev["active"],
+                     self.paged) = outs
         self._jit_retire(
             dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
             dev["active"], dev["caps"], np.int32(0),
@@ -1492,7 +1582,7 @@ class InferenceEngine:
             # Deliberate resolve point: the copy was started async at merge
             # time (copy_to_host_async), so this sync is local by now.
             with _host_crossing():
-                # polylint: disable=PL001(first-token resolve point; async copy landed)
+                # polylint: disable=PL001(first-token resolve point; async copy landed), PL008(reached from dispatch only on the dev-dirty cold path, behind a full pipeline drain)
                 token = int(np.asarray(slot.token_dev).reshape(-1)[slot.token_row])
         except Exception as e:
             slot.token_dev = None
@@ -1663,10 +1753,11 @@ class InferenceEngine:
             # draft steps + one verify — the step weight that makes its
             # lane-seconds comparable to a plain K-step block's.
             self.metrics.on_dispatch(int(act.sum()), self._gamma + 1)
-            return (
-                "spec",
-                self._dispatch_spec(dev, spec_candidates),
-                self._snapshot_requests(),
+            data = self._dispatch_spec(dev, spec_candidates)
+            self._dispatch_seq += 1
+            self._pipe_events.append(("dispatch", self._dispatch_seq))
+            return _InflightBlock(
+                "spec", data, self._snapshot_requests(), self._dispatch_seq,
             )
         # Static variant: an all-greedy batch (the benchmark mode) skips
         # sample_dynamic's [B, vocab] sort and all RNG work. At most two
@@ -1687,8 +1778,15 @@ class InferenceEngine:
         # steps ≈ 0.9 s of dead work in flight).
         remaining = self._remaining_budget(act)
         blocks_needed = max(1, -(-remaining // max(1, steps)))
+        # Scale only the LOOKAHEAD portion (depth - 1 queued blocks);
+        # the +1 is the dispatch in hand. Deepening the whole depth
+        # would let depth 1 — the documented synchronous escape hatch —
+        # run ahead whenever adaptive blocking shrinks K (target 8 on a
+        # solo stream), breaking the bit-identical-rollback contract on
+        # any backend where readback isn't instant.
         self._depth_target = min(
-            64, self._depth * (self._block_steps // max(1, steps)),
+            64,
+            1 + (self._depth - 1) * (self._block_steps // max(1, steps)),
             blocks_needed,
         )
         self.metrics.on_dispatch(int(act.sum()), steps)
@@ -1728,7 +1826,11 @@ class InferenceEngine:
             # regardless, so a backend without async copies loses overlap,
             # not correctness.
             pass
-        return ("plain", packed_dev, self._snapshot_requests())
+        self._dispatch_seq += 1
+        self._pipe_events.append(("dispatch", self._dispatch_seq))
+        return _InflightBlock(
+            "plain", packed_dev, self._snapshot_requests(), self._dispatch_seq,
+        )
 
     def _eff_top_k(self, request: GenRequest) -> int:
         """Effective per-request top_k: with the top-k prefilter enabled
@@ -1788,16 +1890,45 @@ class InferenceEngine:
         the new occupant."""
         return [s.request if s is not None else None for s in self._slots]
 
+    def _block_ready(self, block) -> bool:
+        """True when a dispatched block's result buffers have landed —
+        its readback will not block the host. Conservative: a backend
+        without is_ready() reports landed (the read then syncs, which is
+        the pre-pipeline behavior — correctness over overlap)."""
+        data = block[1]
+        try:
+            if block[0] == "spec":
+                return all(a.is_ready() for a in data)
+            return data.is_ready()
+        except Exception:
+            # Justified: is_ready() is an optional backend capability —
+            # "landed" is the safe answer (process path syncs regardless),
+            # and an error here must never take the engine loop down.
+            return True
+
     def _process_step(self, block) -> None:
         """Sync a dispatched block's results and emit/finish on the host.
         Slots activated between dispatch and process were not in the block:
-        their device lanes were inactive, so their columns read -1."""
-        kind, data, reqs = block
+        their device lanes were inactive, so their columns read -1.
+
+        `block` is an _InflightBlock (legacy bare (kind, data, reqs)
+        tuples still unpack — seq then defaults to the current dispatch
+        frontier, i.e. observed lookahead 0)."""
+        kind, data, reqs = block[0], block[1], block[2]
+        seq = block[3] if len(block) > 3 else self._dispatch_seq
+        # Observed lookahead: blocks dispatched after this one, before its
+        # readback — ≥1 is the overlap the pipeline exists for; 0 is the
+        # synchronous depth-1 shape. Recorded for every processed block
+        # (the loop-trace test and engine_stats read it).
+        lookahead = self._dispatch_seq - seq
+        self._pipe_events.append(
+            ("process", seq, lookahead, len(self._inflight_q))
+        )
         if kind == "spec":
             # Spec rounds always sync: their device-computed acceptance
             # stats feed the gamma-tuning dial even when every occupant is
             # gone by processing time.
-            self._process_spec(data, reqs)
+            self._process_spec(data, reqs, lookahead)
             return
         if not any(
             s is not None and s.request is reqs[i]
@@ -1805,12 +1936,21 @@ class InferenceEngine:
         ):
             # Dead block: every dispatch-time occupant is gone (batch
             # drained / all cancelled). Nothing to emit — skip the sync
-            # entirely so the drain costs no host↔device roundtrip.
+            # entirely so the drain costs no host↔device roundtrip (no
+            # stall is recorded: nothing was read).
+            self.metrics.on_process_block(lookahead, None)
             return
         t_sync = time.monotonic()
         with _host_crossing():
             # polylint: disable=PL001(block resolve point; one packed D2H read per block)
             packed = np.asarray(data)     # [K, B]; blocks until block done
+        # Host stall: how long the processed frontier blocked waiting for
+        # this block's copy to land — ~0 when lookahead hid the roundtrip,
+        # ~roundtrip_ms when the host is on the critical path (the r03
+        # signature this pipeline exists to erase).
+        self.metrics.on_process_block(
+            lookahead, (time.monotonic() - t_sync) * 1e3
+        )
 
         emitted = 0
         for i, slot in enumerate(self._slots):
@@ -1883,7 +2023,7 @@ class InferenceEngine:
             pass
         return packed_dev, stats_dev
 
-    def _process_spec(self, data, reqs) -> None:
+    def _process_spec(self, data, reqs, lookahead: int = 0) -> None:
         """Sync a spec round; emits each row's packed prefix (-1 padded —
         device-truncated). Acceptance stats come FROM the device
         (spec_decode_fn), which owns truncation and the untruncated n_acc
@@ -1895,6 +2035,9 @@ class InferenceEngine:
             packed = np.asarray(packed_dev)  # [B, gamma+1]; blocks until done
             # polylint: disable=PL001(device-owned acceptance stats feed the gamma dial)
             accepted, proposed = (int(v) for v in np.asarray(stats_dev))
+        self.metrics.on_process_block(
+            lookahead, (time.monotonic() - t_sync) * 1e3
+        )
 
         emitted = 0
         for i, slot in enumerate(self._slots):

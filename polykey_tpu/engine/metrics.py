@@ -18,6 +18,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
+from typing import Optional
 
 from ..obs.histogram import Histogram
 
@@ -114,6 +115,46 @@ class EngineMetrics:
         # overshoot semantics).
         self.prefill_tokens_total = 0
         self.interleave_max_tokens = 0
+        # Lookahead pipeline accounting (ISSUE 6): per processed block,
+        # the OBSERVED lookahead (blocks dispatched after it, before its
+        # readback — ≥1 means the dispatch frontier ran ahead of the
+        # processed frontier; 0 is the synchronous depth-1 shape) and the
+        # host stall (ms the processed frontier blocked waiting for the
+        # block's D2H copy — ~0 when the pipeline hid the roundtrip).
+        # The stall histogram renders as polykey_host_stall_ms_bucket.
+        self.blocks_processed = 0
+        # Blocks that actually performed a readback — dead blocks (every
+        # occupant gone, sync skipped) count in blocks_processed but not
+        # here, so stall means divide by the reads that happened.
+        self.blocks_synced = 0
+        self.lookahead_sum = 0
+        self.lookahead_max = 0
+        self.host_stall_ms_total = 0.0
+        self.host_stall_hist = Histogram()
+        # Dispatch cadence: host-side gap between consecutive block
+        # dispatches. At depth 1 the gap is bounded below by the block's
+        # device time plus the readback (the host sits between blocks);
+        # with lookahead it shrinks toward pure host scheduling work —
+        # bench's `dispatch_gap_ms` is the windowed mean of this.
+        self.dispatch_gap_ms_total = 0.0
+        self.dispatch_gaps = 0
+        self._last_dispatch_t = 0.0
+
+    def on_process_block(self, lookahead: int,
+                         stall_ms: Optional[float]) -> None:
+        """One in-flight block processed with `lookahead` newer blocks
+        already dispatched; `stall_ms` is the blocking-readback wall time
+        (None for dead blocks whose sync was skipped entirely)."""
+        with self._lock:
+            self.blocks_processed += 1
+            self.lookahead_sum += lookahead
+            if lookahead > self.lookahead_max:
+                self.lookahead_max = lookahead
+            if stall_ms is not None:
+                self.blocks_synced += 1
+                self.host_stall_ms_total += stall_ms
+        if stall_ms is not None:
+            self.host_stall_hist.observe(stall_ms)
 
     def on_prefill_interleave(self, tokens: int, decode_live: bool) -> None:
         """Prefill tokens dispatched in one engine-loop iteration;
@@ -129,7 +170,17 @@ class EngineMetrics:
     def on_dispatch(self, lanes: int, steps: int) -> None:
         """One decode block (or spec round) dispatched with `lanes` live
         decode lanes for `steps` device steps."""
+        now = time.monotonic()
         with self._lock:
+            if self._last_dispatch_t:
+                gap_ms = (now - self._last_dispatch_t) * 1e3
+                # Idle gaps (no active lanes → no dispatch) are load
+                # shape, not scheduling cost; cap what one gap can
+                # contribute so the windowed mean reads cadence.
+                if gap_ms < 10_000.0:
+                    self.dispatch_gap_ms_total += gap_ms
+                    self.dispatch_gaps += 1
+            self._last_dispatch_t = now
             self.blocks_dispatched += 1
             self.lanes_dispatched += lanes
             self.lane_steps += lanes * steps
@@ -154,6 +205,14 @@ class EngineMetrics:
                     if self.steps_dispatched else None
                 ),
                 "lanes_ewma": round(self._lanes_ewma, 2),
+                # Pipeline counters for windowed diffs (bench step_costs,
+                # occupancy soak): host stall + dispatch cadence.
+                "blocks_processed": self.blocks_processed,
+                "blocks_synced": self.blocks_synced,
+                "lookahead_sum": self.lookahead_sum,
+                "host_stall_ms_total": self.host_stall_ms_total,
+                "dispatch_gap_ms_total": self.dispatch_gap_ms_total,
+                "dispatch_gaps": self.dispatch_gaps,
             }
 
     def on_admit(self) -> None:
@@ -262,6 +321,13 @@ class EngineMetrics:
                 "lanes_ewma": round(self._lanes_ewma, 2),
                 "prefill_tokens_total": self.prefill_tokens_total,
                 "interleave_max_tokens": self.interleave_max_tokens,
+                "blocks_processed": self.blocks_processed,
+                "lookahead_observed_max": self.lookahead_max,
+                "lookahead_observed_mean": (
+                    round(self.lookahead_sum / self.blocks_processed, 2)
+                    if self.blocks_processed else 0.0
+                ),
+                "host_stall_ms_total": round(self.host_stall_ms_total, 2),
             }
             if self.steps_dispatched:
                 # Step-weighted measured occupancy — the number roofline
@@ -287,6 +353,13 @@ class EngineMetrics:
             snap["itl_ms_p50"] = round(p50, 2)
             snap["itl_ms_p95"] = round(p95, 2)
             snap["itl_ms_p99"] = round(p99, 2)
+        if self.host_stall_hist.count:
+            # Host-stall tail: the "is decode host-bound?" dial — a p50
+            # near roundtrip_ms means the lookahead pipeline is not
+            # hiding the host (see DEPLOY.md runbook).
+            p50, p95 = self.host_stall_hist.percentiles(50, 95)
+            snap["host_stall_ms_p50"] = round(p50, 2)
+            snap["host_stall_ms_p95"] = round(p95, 2)
         if drafts_proposed:
             snap["drafts_accepted"] = drafts_accepted
             snap["drafts_proposed"] = drafts_proposed

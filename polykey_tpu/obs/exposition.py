@@ -162,6 +162,30 @@ def engine_collector(engine_or_provider):
             "Live decode lanes at block dispatch.",
             engine.metrics.lanes_hist,
         )
+        # Lookahead dispatch pipeline (ISSUE 6): how deep the dispatch
+        # frontier runs ahead of the processed frontier, and what the
+        # host pays when it fails to — a host_stall_ms p50 near the
+        # device roundtrip means decode is host-bound (DEPLOY.md
+        # "diagnosing host-bound decode").
+        lines += render_gauge(
+            "polykey_dispatch_inflight",
+            "Decode blocks dispatched but not yet processed (the "
+            "in-flight lookahead queue).",
+            snap["inflight_blocks"],
+        )
+        lines += render_gauge(
+            "polykey_dispatch_lookahead_depth",
+            "Configured lookahead depth (POLYKEY_DISPATCH_LOOKAHEAD; "
+            "1 = synchronous dispatch-then-read).",
+            snap["lookahead_depth"],
+        )
+        lines += render_histogram(
+            "polykey_host_stall_ms",
+            "Time _process_step blocked waiting for a block's D2H "
+            "readback to land, ms (~0 when the lookahead pipeline hides "
+            "the roundtrip).",
+            engine.metrics.host_stall_hist,
+        )
         lines += render_histogram(
             "polykey_ttft_ms",
             "Time to first token (enqueue to first emit), ms.",

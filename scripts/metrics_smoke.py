@@ -55,6 +55,11 @@ REQUIRED_FAMILIES = (
     "polykey_dispatched_steps_total",
     "polykey_live_lanes_per_block_bucket",
     "polykey_prefill_tokens_total",
+    # Lookahead dispatch pipeline (ISSUE 6): in-flight depth gauge and
+    # the host-stall histogram the "host-bound decode" runbook reads.
+    "polykey_dispatch_inflight",
+    "polykey_dispatch_lookahead_depth",
+    "polykey_host_stall_ms_bucket",
 )
 
 CONFIG = EngineConfig(

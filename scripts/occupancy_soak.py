@@ -268,6 +268,22 @@ def main() -> int:
             "avg_lanes": round(avg_lanes, 2),
             "occupancy": round(occupancy, 4),
             "avg_lanes_source": "measured",
+            # Lookahead-pipeline host accounting over the same window
+            # (ISSUE 6): mean time the processed frontier blocked per
+            # readback, and mean observed lookahead (blocks dispatched
+            # ahead of each readback) — host-stall alongside lanes, so
+            # a soak that holds occupancy but pays the host tax is
+            # visible from the artifact alone.
+            "host_stall_ms_mean": round(
+                (snap1["host_stall_ms_total"] - snap0["host_stall_ms_total"])
+                / max(1, snap1["blocks_synced"]
+                      - snap0["blocks_synced"]), 3),
+            "lookahead_observed_mean": round(
+                (snap1["lookahead_sum"] - snap0["lookahead_sum"])
+                / max(1, snap1["blocks_processed"]
+                      - snap0["blocks_processed"]), 2),
+            "host_stall_ms_p50": stats1.get("host_stall_ms_p50"),
+            "lookahead_depth": stats1["lookahead_depth"],
             "tok_s": round(tokens / window_s, 1) if window_s else None,
             "interleave_max_tokens": stats1["interleave_max_tokens"],
             # Lifetime TTFT percentiles (incl. ramp — queue wait under

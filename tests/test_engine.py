@@ -725,11 +725,14 @@ def test_adaptive_block_solo_vs_loaded():
         static_cfg)
     assert solo_k == 1 and static_k == 8
     assert solo_tokens == static_tokens
-    # Constant steps-in-flight MID-STREAM: shrinking K deepens the
-    # pipeline (depth x block_time keeps covering the roundtrip), up to
-    # the stream's remaining budget (12 new tokens -> ~12 blocks at K=1).
-    assert solo_max >= 10, solo_max
-    assert solo_max <= cfg.lookahead_blocks * 8
+    # Constant LOOKAHEAD steps MID-STREAM: shrinking K deepens the
+    # pipeline so the queued-ahead work keeps covering the roundtrip —
+    # 1 + (depth-1) x (K/steps), i.e. 1+8=9 at K=1; only the lookahead
+    # portion scales, so depth 1 stays exactly synchronous (the
+    # escape-hatch contract test_dispatch_pipeline pins). Bounded by the
+    # stream's remaining budget (12 new tokens -> ~12 blocks at K=1).
+    assert solo_max >= 1 + (cfg.lookahead_blocks - 1) * 8, solo_max
+    assert solo_max <= 1 + (cfg.lookahead_blocks - 1) * 8
     # Tail cap: in-flight work never exceeds what active streams still
     # need — the final dispatches shrink to one block, so stream tails
     # don't leave ~lookahead x K steps of dead full-batch work queued in

@@ -37,11 +37,11 @@ def blocking(findings, rule=None):
 # -- registry ----------------------------------------------------------------
 
 
-def test_registry_has_the_seven_rules():
+def test_registry_has_the_eight_rules():
     ids = [r.id for r in all_rules()]
     assert ids == sorted(ids)
     for expected in ("PL001", "PL002", "PL003", "PL004",
-                     "PL005", "PL006", "PL007"):
+                     "PL005", "PL006", "PL007", "PL008"):
         assert expected in ids
 
 
@@ -315,6 +315,97 @@ def test_pl007_accepts_obs_contract_names(tmp_path):
             return render_histogram("polykey_ttft_ms", "ok", hist)
     """)
     assert not blocking(findings, "PL007")
+
+
+# -- PL008 dispatch-side-sync -------------------------------------------------
+
+
+def test_pl008_fires_through_the_call_graph(tmp_path):
+    """A sync hidden in an innocuously-named helper still fires when the
+    helper is reachable from _dispatch_step — the closure PL001's name
+    match can't see."""
+    findings = lint(tmp_path, "polykey_tpu/engine/pipe.py", """\
+        import numpy as np
+
+        class E:
+            def _dispatch_step(self):
+                self._prepare()
+                return self._jit(self._dev)
+
+            def _prepare(self):
+                # Innocuous name: PL001's ^_?(dispatch|...) misses it.
+                return np.asarray(self._dev["tokens"])
+    """)
+    hits = blocking(findings, "PL008")
+    assert hits and "_prepare" in hits[0].message
+    assert "reachable from the dispatch side" in hits[0].message
+
+
+def test_pl008_fires_in_upload_slot_state_root(tmp_path):
+    findings = lint(tmp_path, "polykey_tpu/engine/pipe.py", """\
+        def _upload_slot_state(self):
+            self._dev["tokens"].block_until_ready()
+    """)
+    assert blocking(findings, "PL008")
+
+
+def test_pl008_ignores_process_side_and_unreachable(tmp_path):
+    findings = lint(tmp_path, "polykey_tpu/engine/pipe.py", """\
+        import numpy as np
+
+        class E:
+            def _dispatch_step(self):
+                return self._jit(self._dev)
+
+            def _process_step(self, block):
+                # polylint: disable=PL001(block resolve point)
+                return np.asarray(block)
+
+            def _unreachable_helper(self, data):
+                return np.asarray(data)
+    """)
+    assert not blocking(findings, "PL008")
+
+
+def test_pl008_cross_object_call_does_not_pull_local_namesake(tmp_path):
+    """self.metrics.on_dispatch(...) is another object's method; a local
+    function that happens to share the name must not join the dispatch
+    closure (its legitimate process-side sync is not a finding)."""
+    findings = lint(tmp_path, "polykey_tpu/engine/pipe.py", """\
+        import numpy as np
+
+        class E:
+            def _dispatch_step(self):
+                self.metrics.on_dispatch(1, 2)
+                return self._jit(self._dev)
+
+        def on_dispatch(block, _):
+            # Module-level namesake, process-side by construction.
+            return np.asarray(block)
+    """)
+    assert not blocking(findings, "PL008")
+
+
+def test_pl008_annotated_site_suppresses(tmp_path):
+    findings = lint(tmp_path, "polykey_tpu/engine/pipe.py", """\
+        import numpy as np
+
+        def _dispatch_step(self):
+            # polylint: disable=PL008(cold-start mirror fold, behind a drain)
+            return np.asarray(self._dev["tokens"])
+    """)
+    assert not blocking(findings, "PL008")
+    assert any(f.rule == "PL008" and f.suppressed for f in findings)
+
+
+def test_pl008_scoped_to_engine_package(tmp_path):
+    findings = lint(tmp_path, "polykey_tpu/gateway/pipe.py", """\
+        import numpy as np
+
+        def _dispatch_step(self):
+            return np.asarray(self._dev["tokens"])
+    """)
+    assert not blocking(findings, "PL008")
 
 
 # -- suppressions -------------------------------------------------------------
