@@ -9,7 +9,8 @@ BUILD_DIR := build
 
 .PHONY: help run run-client test test-models native protos clean bench dryrun \
 	kernel-check tunnel-probe bench-tokenizer tpu-watch metrics-smoke \
-	chaos-smoke print-chaos occupancy-smoke occupancy-soak
+	chaos-smoke print-chaos occupancy-smoke occupancy-soak \
+	failover-smoke failover-soak
 
 help: ## Show available targets
 	@grep -E '^[a-zA-Z_-]+:.*?## .*$$' $(MAKEFILE_LIST) | \
@@ -49,11 +50,12 @@ bench: ## Run the benchmark harness (prints one JSON line)
 metrics-smoke: ## Boot the stack on CPU, scrape /metrics, assert required families
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/metrics_smoke.py
 
-# Deterministic fault-injection suite (ISSUE 3): deadline drops, load
-# shedding, watchdog trip → supervised restart, client retries, health
-# transitions — all on CPU with test-scaled timeouts.
+# Deterministic fault-injection suite (ISSUE 3 + ISSUE 9): deadline
+# drops, load shedding, watchdog trip → supervised restart, client
+# retries, health transitions, replica-pool failover/resume — all on
+# CPU with test-scaled timeouts.
 CHAOS_TESTS := tests/test_chaos.py tests/test_faults.py tests/test_health.py \
-	tests/test_client_retry.py
+	tests/test_client_retry.py tests/test_replica_pool.py
 
 chaos-smoke: ## Run the fault-injection/resilience test suite on CPU
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest $(CHAOS_TESTS) -q
@@ -73,6 +75,20 @@ occupancy-soak: ## The full 48-slot / 60 s acceptance soak (writes perf/)
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/occupancy_soak.py \
 	  --slots 48 --duration 60 --min-occupancy 0.8 \
 	  --out perf/occupancy_soak_$$(date -u +%Y%m%d_%H%M%S).json
+
+# Replica failover drill (ISSUE 9): Poisson load at 2 replicas, one
+# replica killed mid-run via targeted fault injection — gates zero
+# failed RPCs, token-complete streams, bounded p95 TTFT inflation, and
+# recovery to full SERVING capacity. Artifact to /tmp so CI runs never
+# dirty the repo.
+failover-smoke: ## Kill-one-replica drill at CI scale (2 replicas, 10 s)
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/failover_soak.py \
+	  --replicas 2 --duration 10 --out /tmp/failover_smoke.json
+
+failover-soak: ## The 3-replica / 30 s acceptance drill (writes perf/)
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/failover_soak.py \
+	  --replicas 3 --duration 30 \
+	  --out perf/failover_soak_$$(date -u +%Y%m%d_%H%M%S).json
 
 print-chaos: ## Print the chaos test file list (CI's single source of truth)
 	@echo $(CHAOS_TESTS)
@@ -157,10 +173,11 @@ scan: ## Security scan (Trivy fs over the tree + lockfile, CRITICAL/HIGH gate)
 	  --scanners vuln,secret \
 	  --severity CRITICAL,HIGH
 
-ci-check: ## Run the CI pipeline locally: lint+polylint+graphlint, chaos, occupancy, tests, native(+asan), scan
+ci-check: ## Run the CI pipeline locally: lint+polylint+graphlint, chaos, failover, occupancy, tests, native(+asan), scan
 	@$(MAKE) lint
 	@$(MAKE) graphlint
 	@$(MAKE) chaos-smoke
+	@$(MAKE) failover-smoke
 	@$(MAKE) occupancy-smoke
 	@$(MAKE) test
 	@$(MAKE) native

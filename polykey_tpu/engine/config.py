@@ -255,6 +255,31 @@ class EngineConfig:
     max_engine_restarts: int = 3
     restart_window_s: float = 600.0
 
+    # -- Replica tier (ISSUE 9) ----------------------------------------------
+    # POLYKEY_REPLICAS > 1 serves through an in-process pool of
+    # independently supervised engine replicas (engine/replica_pool.py)
+    # behind a health/load-aware router. 1 (the default) keeps the
+    # single-engine wiring byte-for-byte: no pool object, no routing, no
+    # behavior change.
+    replicas: int = 1
+    # This engine's identity within a pool (fault targeting, metric
+    # labels, stats). Set by the pool via dataclasses.replace — not an
+    # env knob; a standalone engine is replica 0.
+    replica: int = 0
+    # Router score = prefix_weight × (cached-prefix fraction)
+    #              − delay_weight × (estimated queue delay, s);
+    # candidates whose estimated delay would blow the request deadline
+    # are filtered first (headroom check). Ties break on the lowest
+    # replica index, so routing is deterministic given equal state.
+    route_prefix_weight: float = 1.0
+    route_delay_weight: float = 1.0
+    # How many times one request may be re-routed onto another replica
+    # after an engine-lifecycle failure (queued requests move losslessly;
+    # in-flight streams resume with already-emitted tokens suppressed).
+    # 0 disables failover re-routing (failures surface as UNAVAILABLE,
+    # exactly the single-engine contract).
+    max_reroutes: int = 3
+
     @property
     def pages_per_seq(self) -> int:
         return self.max_seq_len // self.page_size
@@ -346,6 +371,14 @@ class EngineConfig:
             restart_window_s=_env_float(
                 "POLYKEY_RESTART_WINDOW", cls.restart_window_s
             ),
+            replicas=_env_int("POLYKEY_REPLICAS", cls.replicas),
+            route_prefix_weight=_env_float(
+                "POLYKEY_ROUTE_W_PREFIX", cls.route_prefix_weight
+            ),
+            route_delay_weight=_env_float(
+                "POLYKEY_ROUTE_W_DELAY", cls.route_delay_weight
+            ),
+            max_reroutes=_env_int("POLYKEY_MAX_REROUTES", cls.max_reroutes),
         )
 
     def validate(self) -> None:
@@ -392,6 +425,14 @@ class EngineConfig:
             raise ValueError("max_engine_restarts must be >= 0")
         if self.restart_window_s <= 0:
             raise ValueError("restart_window_s must be > 0")
+        if self.replicas < 1:
+            raise ValueError("replicas must be >= 1")
+        if self.replica < 0:
+            raise ValueError("replica index must be >= 0")
+        if self.max_reroutes < 0:
+            raise ValueError("max_reroutes must be >= 0 (0 → no failover)")
+        if self.route_prefix_weight < 0 or self.route_delay_weight < 0:
+            raise ValueError("routing weights must be >= 0")
         for name in ("tp", "dp", "ep", "sp", "pp", "num_slices"):
             if getattr(self, name) < 1:
                 raise ValueError(f"{name} must be >= 1")

@@ -389,6 +389,10 @@ class InferenceEngine:
         # POLYKEY_FAULTS is set, so every injection point below is one
         # attribute load + `is None` — nothing on the hot path when off.
         self._faults = get_injector()
+        # Identity within a replica pool (engine/replica_pool.py): fault
+        # targeting (":replica=N") and per-replica metric labels key on
+        # it. A standalone engine is replica 0.
+        self.replica_id = config.replica
         self._dtype = jnp.dtype(config.dtype)
 
         # --- Serving mesh: tp shards heads/hidden (Megatron specs,
@@ -824,6 +828,34 @@ class InferenceEngine:
         slots = max(1, self.config.max_decode_slots)
         return max(50, int(1000.0 * ewma / slots))
 
+    # -- router signals (replica_pool; any thread) ---------------------------
+
+    def queue_delay_estimate_s(self) -> float:
+        """Public routing signal: the same estimated queue delay the
+        deadline-aware admission check uses (qsize × service EWMA /
+        slots) — the replica pool ranks candidates by it."""
+        return self._estimated_queue_delay_s()
+
+    def load_fraction(self) -> float:
+        """Instantaneous load for routing: (busy slots + queued) over
+        slots. The EWMA-based delay estimate is 0 until a first request
+        completes, so a cold pool would tie every score and pile work on
+        replica 0 — this term spreads concurrent cold traffic."""
+        slots = max(1, self.config.max_decode_slots)
+        busy = sum(s is not None for s in self._slots)
+        return (busy + self._submit.qsize()) / slots
+
+    def prefix_warmth(self, ids) -> float:
+        """Fraction [0, 1] of `ids` (token id sequence) whose KV this
+        engine could serve from its prefix cache — the NetKV-style
+        warmth signal the replica router scores on. Read-only: no page
+        retains, no LRU refresh, no hit accounting (prefix_cache.probe).
+        0.0 with prefix caching off or an empty prompt."""
+        if self._prefix is None or len(ids) == 0:
+            return 0.0
+        ids = np.asarray(ids, dtype=np.int32)
+        return self._prefix.probe(ids) / len(ids)
+
     @staticmethod
     def _deadline_expired(request: GenRequest) -> bool:
         return (
@@ -843,6 +875,7 @@ class InferenceEngine:
         snap.update(
             {
                 "model": self.model_cfg.name,
+                "replica": self.replica_id,
                 "slots_busy": sum(s is not None for s in self._slots),
                 "slots_total": self.config.max_decode_slots,
                 "pages_free": self.allocator.num_free,
@@ -1133,7 +1166,7 @@ class InferenceEngine:
         request.timings.prefill_start = time.monotonic()
 
         if self._faults is not None:
-            self._faults.maybe_raise("tokenizer-error")
+            self._faults.maybe_raise("tokenizer-error", replica=self.replica_id)
         prompt_ids = self.tokenizer.encode(request.prompt)
         max_new = max(
             1,
@@ -1166,7 +1199,9 @@ class InferenceEngine:
             if self._faults is not None:
                 # Inside the try: the AllocationError path below must
                 # still release the prefix-cache lookup's page refs.
-                self._faults.maybe_raise("alloc-fail", AllocationError)
+                self._faults.maybe_raise(
+                    "alloc-fail", AllocationError, replica=self.replica_id
+                )
             try:
                 fresh = self.allocator.alloc(need)
             except AllocationError:
@@ -1278,7 +1313,7 @@ class InferenceEngine:
         )
         try:
             if self._faults is not None:
-                self._faults.maybe_raise("prefill-error")
+                self._faults.maybe_raise("prefill-error", replica=self.replica_id)
             with jax.profiler.TraceAnnotation("polykey/prefill"):
                 if self._spec:
                     # Spec burst admissions batch exactly like plain ones
@@ -1488,7 +1523,7 @@ class InferenceEngine:
             put(np.asarray([self._eff_top_k(request)], dtype=np.int32)),
         )
         if self._faults is not None:
-            self._faults.maybe_raise("prefill-error")
+            self._faults.maybe_raise("prefill-error", replica=self.replica_id)
         with jax.profiler.TraceAnnotation("polykey/prefill"):
             if self._spec:
                 first_token, self.paged, self.d_paged = self._jit_spec_prefill(
@@ -1712,8 +1747,8 @@ class InferenceEngine:
             # device call: they block the engine thread exactly where the
             # real dispatch would, so the watchdog's no-progress clock
             # sees the genuine failure shape.
-            self._faults.maybe_sleep("step-stall")
-            self._faults.maybe_sleep("slow-step")
+            self._faults.maybe_sleep("step-stall", replica=self.replica_id)
+            self._faults.maybe_sleep("slow-step", replica=self.replica_id)
         if self._dev_dirty:
             # Rare (init / retire-failure recovery): mirrors must be
             # complete before they become the device state — deliver any

@@ -83,6 +83,20 @@ class PrefixCache:
         self.hit_tokens += len(pages) * self._page_size
         return pages
 
+    def probe(self, ids: np.ndarray) -> int:
+        """How many leading tokens of `ids` are covered by cached pages —
+        a read-only warmth signal for replica routing. Unlike lookup()
+        this retains nothing, refreshes no LRU position, and charges no
+        hit/lookup accounting: a router probing every replica must not
+        perturb the caches it is comparing."""
+        n_full = max(0, (len(ids) - 1) // self._page_size)
+        matched = 0
+        for key in _page_keys(ids, self._page_size, n_full):
+            if key not in self._map:
+                break
+            matched += 1
+        return matched * self._page_size
+
     def insert(self, ids: np.ndarray, table_pages: list[int]) -> None:
         """Register a fully-prefilled prompt's page-aligned pages
         (table_pages[i] holds positions [i·ps, (i+1)·ps)). The cache

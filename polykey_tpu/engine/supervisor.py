@@ -64,6 +64,7 @@ class EngineSupervisor:
         self.gave_up = False
         self._restart_times: deque[float] = deque()
         self._listeners: list[Callable[[object], None]] = []
+        self._giveup_listeners: list[Callable[[str], None]] = []
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name="polykey-supervisor", daemon=True
@@ -73,6 +74,14 @@ class EngineSupervisor:
         """Called with the fresh engine after every successful restart
         (from the supervisor thread)."""
         self._listeners.append(callback)
+
+    def add_giveup_listener(self, callback: Callable[[str], None]) -> None:
+        """Called with the failure reason when the restart budget is
+        exhausted and this supervisor stops trying (from the supervisor
+        thread). The replica pool uses it to mark the replica DEAD while
+        the rest of the pool keeps health SERVING — per-replica give-up
+        instead of the single-engine whole-process NOT_SERVING."""
+        self._giveup_listeners.append(callback)
 
     def start(self) -> "EngineSupervisor":
         self._thread.start()
@@ -122,6 +131,8 @@ class EngineSupervisor:
             )
         # Health stays NOT_SERVING (the watchdog/crash path already
         # flipped it); the platform's restart policy takes over.
+        for callback in self._giveup_listeners:
+            callback(reason)
 
     def _restart(self, old) -> None:
         reason = old.dead or "engine dead"

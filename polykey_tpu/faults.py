@@ -13,12 +13,18 @@ no parsing, no dict lookups, no clock reads on the hot path.
 Spec grammar (comma- or semicolon-separated entries)::
 
     POLYKEY_FAULTS="step-stall=1.5@1,slow-step=0.01"
+    POLYKEY_FAULTS="step-stall=1.0@1:replica=2"     # target one replica
 
-    entry   := name [ "=" value ] [ "@" count ]
+    entry   := name [ "=" value ] [ "@" count ] [ ":replica=" index ]
     value   := float    seconds for sleep points; ignored by raise points
                         (default 1.0)
     count   := int      how many times the point fires before going
                         inert (default: unlimited)
+    index   := int      fire only for the engine replica with this index
+                        (replica_pool.py; a single engine is replica 0).
+                        Without the suffix the fault fires on every
+                        replica — chaos tests that kill ONE replica
+                        while the others serve need the targeting.
 
 Points (all consumed by engine/engine.py):
 
@@ -60,6 +66,7 @@ class _Fault:
     value: float = 1.0
     remaining: Optional[int] = None  # None → unlimited
     fired: int = 0
+    replica: Optional[int] = None    # None → fires on every replica
 
 
 class FaultInjector:
@@ -69,11 +76,27 @@ class FaultInjector:
 
     def __init__(self, spec: str):
         self._lock = threading.Lock()
-        self._faults: dict[str, _Fault] = {}
+        # One point can carry SEVERAL entries (e.g. the same fault
+        # targeted at two different replicas) — keyed by name alone they
+        # would silently overwrite and a two-replica chaos spec would
+        # only ever kill one.
+        self._faults: dict[str, list[_Fault]] = {}
         for raw in spec.replace(";", ",").split(","):
             entry = raw.strip()
             if not entry:
                 continue
+            replica: Optional[int] = None
+            if ":" in entry:
+                # Replica targeting rides a trailing ":replica=N" so chaos
+                # tests can kill one pool replica while the others serve.
+                entry, target = entry.rsplit(":", 1)
+                key, _, index_s = target.partition("=")
+                if key.strip() != "replica":
+                    raise ValueError(
+                        f"unknown fault qualifier {target!r}; only "
+                        "':replica=N' is supported"
+                    )
+                replica = int(index_s)
             count: Optional[int] = None
             if "@" in entry:
                 entry, count_s = entry.rsplit("@", 1)
@@ -88,36 +111,44 @@ class FaultInjector:
                     f"unknown fault point {name!r}; valid points: "
                     f"{', '.join(sorted(POINTS))}"
                 )
-            self._faults[name] = _Fault(value=value, remaining=count)
+            self._faults.setdefault(name, []).append(_Fault(
+                value=value, remaining=count, replica=replica
+            ))
 
-    def _take(self, point: str) -> Optional[float]:
-        """Consume one firing of `point`; returns its value, or None when
-        the point is unarmed or exhausted."""
+    def _take(self, point: str, replica: Optional[int] = None) -> Optional[float]:
+        """Consume one firing of `point` — the first armed entry whose
+        replica target matches; returns its value, or None when the
+        point is unarmed, exhausted, or targeted elsewhere (`replica`
+        is the caller's replica index; callers that pass None only
+        consume untargeted faults)."""
         with self._lock:
-            fault = self._faults.get(point)
-            if fault is None or fault.remaining == 0:
-                return None
-            if fault.remaining is not None:
-                fault.remaining -= 1
-            fault.fired += 1
-            return fault.value
+            for fault in self._faults.get(point, ()):
+                if fault.remaining == 0:
+                    continue
+                if fault.replica is not None and replica != fault.replica:
+                    continue
+                if fault.remaining is not None:
+                    fault.remaining -= 1
+                fault.fired += 1
+                return fault.value
+            return None
 
-    def maybe_sleep(self, point: str) -> None:
+    def maybe_sleep(self, point: str, replica: Optional[int] = None) -> None:
         """Sleep the point's value (seconds) if it fires. Sleeping stands
         in for a wedged/slow device call, so it deliberately blocks the
         calling thread exactly where the real stall would."""
-        value = self._take(point)
+        value = self._take(point, replica)
         if value is not None and value > 0:
             time.sleep(value)
 
-    def maybe_raise(self, point: str, exc_type: type = RuntimeError) -> None:
-        if self._take(point) is not None:
+    def maybe_raise(self, point: str, exc_type: type = RuntimeError,
+                    replica: Optional[int] = None) -> None:
+        if self._take(point, replica) is not None:
             raise exc_type(f"injected fault: {point}")
 
     def fired(self, point: str) -> int:
         with self._lock:
-            fault = self._faults.get(point)
-            return fault.fired if fault is not None else 0
+            return sum(f.fired for f in self._faults.get(point, ()))
 
 
 _injector: Optional[FaultInjector] = None

@@ -34,7 +34,13 @@ from typing import Callable, Optional
 
 import grpc
 
-from .errors import RETRY_AFTER_MS_KEY
+from .errors import (
+    REPLICA_KEY,
+    RESTARTED_KEY,
+    RESUME_SUPPORTED_KEY,
+    RESUME_TOKENS_KEY,
+    RETRY_AFTER_MS_KEY,
+)
 
 from ..proto import common_v2_pb2 as cmn
 from ..proto import polykey_v2_pb2 as pk
@@ -57,19 +63,39 @@ RETRYABLE_CODES = frozenset({
 })
 
 
+def trailers_from(obj) -> dict:
+    """Trailing metadata of a grpc.Call / RpcError as a dict ({} when
+    the object has none — in-process test doubles)."""
+    try:
+        return dict(obj.trailing_metadata() or ())
+    except Exception:
+        return {}  # not a grpc.Call (test doubles): no trailers to read
+
+
 def retry_after_ms_from(err: grpc.RpcError) -> Optional[int]:
     """The server's retry-after-ms trailing-metadata hint, if any."""
+    value = trailers_from(err).get(RETRY_AFTER_MS_KEY)
+    if value is None:
+        return None
     try:
-        metadata = err.trailing_metadata() or ()
-    except Exception:
-        return None  # not a grpc.Call (test doubles): no trailers to read
-    for key, value in metadata:
-        if key == RETRY_AFTER_MS_KEY:
-            try:
-                return int(value)
-            except ValueError:
-                return None
-    return None
+        return int(value)
+    except ValueError:
+        return None
+
+
+def resume_tokens_from(err: grpc.RpcError) -> Optional[int]:
+    """Mid-stream resume contract (ISSUE 9): when an UNAVAILABLE stream
+    failure carries `resume-supported`, its `resume-tokens` trailer is
+    the count of tokens the server already delivered — re-issuing the
+    request with `received_tokens` set to it streams only the missing
+    suffix. Returns None when the server did not offer a resume."""
+    trailers = trailers_from(err)
+    if trailers.get(RESUME_SUPPORTED_KEY) != "1":
+        return None
+    try:
+        return int(trailers[RESUME_TOKENS_KEY])
+    except (KeyError, ValueError):
+        return None
 
 
 @dataclass
@@ -189,6 +215,15 @@ class Client:
         self._log_response(resp)
         return resp
 
+    def _resume_request(self, request: pk.ExecuteToolRequest,
+                        received_tokens: int) -> pk.ExecuteToolRequest:
+        """A copy of `request` carrying received_tokens — the caller's
+        proto must not be mutated across resume attempts."""
+        resumed = pk.ExecuteToolRequest()
+        resumed.CopyFrom(request)
+        resumed.parameters.update({"received_tokens": received_tokens})
+        return resumed
+
     def execute_tool_stream(self, request: pk.ExecuteToolRequest, timeout: float = 30.0):
         self.logger.info(
             "Executing tool",
@@ -197,13 +232,17 @@ class Client:
             has_metadata=request.HasField("metadata"),
         )
         attempt = 0
+        # Accumulated across RESUME attempts (the server only streams the
+        # missing suffix); cleared on plain retries, which only happen
+        # before any chunk arrived.
+        text: list[str] = []
+        usage, status, trailers = None, None, {}
         while True:
-            # Fresh accumulators per attempt: a retried stream must not
-            # concatenate output from a failed one.
-            text, usage, status = [], None, None
+            usage, status = None, None
             received = False
             try:
-                for chunk in self.stub.ExecuteToolStream(request, timeout=timeout):
+                call = self.stub.ExecuteToolStream(request, timeout=timeout)
+                for chunk in call:
                     received = True
                     if chunk.delta:
                         text.append(chunk.delta)
@@ -212,10 +251,39 @@ class Client:
                             status = chunk.status
                         if chunk.HasField("usage"):
                             usage = chunk.usage
+                trailers = trailers_from(call)
                 break
             except grpc.RpcError as e:
-                # Mid-stream failures are terminal: chunks were already
-                # observed, so a retry would silently replay output.
+                # Mid-stream resume (ISSUE 9): an UNAVAILABLE failure
+                # that carries the resume trailers can be re-issued with
+                # received_tokens — the server suppresses what we
+                # already hold, so nothing replays. Gated on the same
+                # retry budget/backoff as ordinary retries.
+                resume_at = (
+                    resume_tokens_from(e)
+                    if e.code() == grpc.StatusCode.UNAVAILABLE else None
+                )
+                if (
+                    resume_at is not None and self.retry is not None
+                    and self.retry.should_retry(e.code(), attempt)
+                ):
+                    delay = self.retry.delay_s(attempt, retry_after_ms_from(e))
+                    self.logger.warn(
+                        "stream interrupted; resuming",
+                        code=e.code().name, received_tokens=resume_at,
+                        attempt=attempt + 1, delay_ms=round(delay * 1e3, 1),
+                    )
+                    self.retry.sleep(delay)
+                    request = self._resume_request(request, resume_at)
+                    attempt += 1
+                    continue
+                # Mid-stream failures without a resume offer are
+                # terminal: chunks were already observed, so a blind
+                # retry would silently replay output.
+                # (text needs no reset here: received is False, so this
+                # attempt appended nothing, and text from earlier RESUME
+                # attempts must survive — the re-issued request still
+                # carries their received_tokens.)
                 if not received and self._backoff(e, attempt):
                     attempt += 1
                     continue
@@ -223,6 +291,14 @@ class Client:
                     "gRPC call failed", code=e.code().name, message=e.details()
                 )
                 raise
+        if REPLICA_KEY in trailers:
+            # Replica-tier trailers: which replica served, and whether
+            # the stream was resumed server-side on a replica failure.
+            self.logger.info(
+                "Served by replica",
+                replica=trailers[REPLICA_KEY],
+                restarted=trailers.get(RESTARTED_KEY) == "1",
+            )
         if status is not None:
             self.logger.info(
                 "Tool execution completed",

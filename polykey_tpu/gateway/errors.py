@@ -31,6 +31,19 @@ import grpc
 
 RETRY_AFTER_MS_KEY = "retry-after-ms"
 
+# Replica-tier trailer contract (ISSUE 9). On successful LLM RPCs the
+# backend stamps which replica served (`replica`) and whether the stream
+# was resumed on another replica mid-flight (`restarted` — greedy
+# resumes are bit-identical; sampled streams on a speculative engine are
+# only distributionally equivalent, which is why the flag exists). On a
+# mid-stream UNAVAILABLE the backend attaches `resume-supported` +
+# `resume-tokens` so a client can re-issue the request with
+# `received_tokens` and get only the missing suffix (client.py).
+REPLICA_KEY = "replica"
+RESTARTED_KEY = "restarted"
+RESUME_SUPPORTED_KEY = "resume-supported"
+RESUME_TOKENS_KEY = "resume-tokens"
+
 
 class RpcStatusError(RuntimeError):
     """A service failure with an explicit gRPC status code. server.py
@@ -69,9 +82,20 @@ class ResourceExhaustedError(RpcStatusError):
 
 class UnavailableError(RpcStatusError):
     """The backend cannot take work right now (engine dead / restarting /
-    shut down). Retryable: a supervised restart usually brings it back."""
+    shut down). Retryable: a supervised restart usually brings it back.
+    `trailers` lets the backend attach the mid-stream resume contract
+    (resume-supported / resume-tokens) so a well-behaved client re-issues
+    with `received_tokens` instead of replaying the whole stream."""
 
     code = grpc.StatusCode.UNAVAILABLE
+
+    def __init__(self, message: str,
+                 trailers: tuple[tuple[str, str], ...] = ()):
+        super().__init__(message)
+        self._trailers = tuple(trailers)
+
+    def trailing_metadata(self) -> tuple[tuple[str, str], ...]:
+        return self._trailers
 
 
 # -- RPC deadline propagation (handler thread-local) -------------------------
@@ -101,3 +125,26 @@ def set_rpc_deadline(deadline: Optional[float]) -> None:
 
 def rpc_deadline() -> Optional[float]:
     return getattr(_local, "deadline", None)
+
+
+# -- response trailers (handler thread-local) ---------------------------------
+# The Service seam is context-free (reference parity), so a backend that
+# wants to attach SUCCESS-path trailing metadata (replica id, restarted
+# flag) stashes pairs here; the handler (server.py) flushes them into
+# the ServicerContext after the service call and clears in `finally`
+# (threads are pooled — a missed clear would leak one RPC's trailers
+# into the next).
+
+
+def add_rpc_trailers(*pairs: tuple[str, str]) -> None:
+    stash = getattr(_local, "trailers", None)
+    if stash is None:
+        stash = []
+        _local.trailers = stash
+    stash.extend(pairs)
+
+
+def pop_rpc_trailers() -> tuple[tuple[str, str], ...]:
+    stash = getattr(_local, "trailers", None)
+    _local.trailers = None
+    return tuple(stash) if stash else ()

@@ -101,6 +101,20 @@ class PolykeyServer(PolykeyServiceServicer):
                 pass  # in-process doubles without trailer support
         context.abort(e.code, str(e))
 
+    @staticmethod
+    def _flush_trailers(context) -> None:
+        """Success-path trailing metadata the backend stashed through
+        errors.add_rpc_trailers (replica id, restarted flag): set it on
+        the context, where the interceptor's recording proxy merges it
+        with the x-trace-id echo. Error paths carry their trailers on
+        the typed error instead (_abort_status)."""
+        trailers = errors.pop_rpc_trailers()
+        if trailers:
+            try:
+                context.set_trailing_metadata(trailers)
+            except Exception:
+                pass  # in-process doubles without trailer support
+
     def ExecuteTool(self, request, context):
         self._log_call("ExecuteTool", request)
         # Deadline propagation (ISSUE 3): the Service seam is
@@ -108,7 +122,9 @@ class PolykeyServer(PolykeyServiceServicer):
         # rides a thread-local the backend stamps onto GenRequest.
         errors.set_rpc_deadline(errors.deadline_from_context(context))
         try:
-            return self.service.execute_tool(*self._unpack(request))
+            response = self.service.execute_tool(*self._unpack(request))
+            self._flush_trailers(context)
+            return response
         except errors.RpcStatusError as e:
             self._abort_status("ExecuteTool", context, e)
         except Exception as e:
@@ -116,12 +132,14 @@ class PolykeyServer(PolykeyServiceServicer):
             context.abort(grpc.StatusCode.UNKNOWN, str(e))
         finally:
             errors.set_rpc_deadline(None)  # handler threads are pooled
+            errors.pop_rpc_trailers()      # drop any stash an abort left
 
     def ExecuteToolStream(self, request, context):
         self._log_call("ExecuteToolStream", request)
         errors.set_rpc_deadline(errors.deadline_from_context(context))
         try:
             yield from self.service.execute_tool_stream(*self._unpack(request))
+            self._flush_trailers(context)
         except errors.RpcStatusError as e:
             self._abort_status("ExecuteToolStream", context, e)
         except Exception as e:
@@ -129,6 +147,7 @@ class PolykeyServer(PolykeyServiceServicer):
             context.abort(grpc.StatusCode.UNKNOWN, str(e))
         finally:
             errors.set_rpc_deadline(None)
+            errors.pop_rpc_trailers()
 
 
 def normalize_address(addr: str) -> str:

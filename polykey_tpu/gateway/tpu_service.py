@@ -40,6 +40,7 @@ from ..engine.engine import (
     GenRequest,
     InferenceEngine,
 )
+from ..engine.replica_pool import ReplicaPool
 from ..engine.supervisor import EngineSupervisor
 from ..engine.tokenizer import ByteTokenizer, IncrementalDetokenizer
 from ..engine.watchdog import Watchdog
@@ -122,8 +123,15 @@ class TpuService(Service):
         wiring lives (from_env and the metrics-smoke probe both call it,
         so they can't drift apart). `engine_factory` overrides how a
         replacement engine is built on supervised restart (default:
-        reconstruct from the same config)."""
+        reconstruct from the same config).
+
+        A `ReplicaPool` passes through as-is: the pool already owns a
+        watchdog and supervisor PER REPLICA (plus the aggregate-health
+        wiring), so the single-engine supervision built here would be
+        redundant and wrong (one watchdog cannot watch N engines)."""
         service = cls(engine, None, secrets=secrets, logger=logger, obs=obs)
+        if isinstance(engine, ReplicaPool):
+            return service
         recorder = obs.recorder if obs is not None else None
         watchdog = Watchdog(
             engine, health=health, logger=logger,
@@ -174,7 +182,15 @@ class TpuService(Service):
         # jax config mutated under them). Restarts skip the 20-40 s/step
         # TPU recompiles; POLYKEY_COMPILE_CACHE=0 opts out.
         enable_persistent_compile_cache()
-        engine = InferenceEngine(config, health=health, logger=logger)
+        if config.replicas > 1:
+            # Replica tier (ISSUE 9): POLYKEY_REPLICAS engines behind
+            # the routing pool. POLYKEY_REPLICAS=1 (default) never takes
+            # this branch — the single-engine wiring below is unchanged.
+            engine = ReplicaPool.create(
+                config, health=health, logger=logger, obs=obs,
+            )
+        else:
+            engine = InferenceEngine(config, health=health, logger=logger)
         service = cls.create(
             engine, health=health, logger=logger,
             secrets=SecretStore.from_env(logger), obs=obs,
@@ -183,6 +199,7 @@ class TpuService(Service):
             logger.info(
                 "engine initialized",
                 model=config.model,
+                replicas=config.replicas,
                 slots=config.max_decode_slots,
                 pages=config.num_pages,
                 page_size=config.page_size,
@@ -278,17 +295,58 @@ class TpuService(Service):
             raise errors.UnavailableError(str(e)) from e
 
     @staticmethod
-    def _engine_error(message: str) -> Exception:
+    def _engine_error(message: str, delivered: Optional[int] = None) -> Exception:
         """Map an engine failure event to the RPC status contract:
         deadline expiries → DEADLINE_EXCEEDED (never retryable); engine
         lifecycle failures (dead / shut down / restarting — all begin
         "engine") → UNAVAILABLE (retryable); anything else keeps the
-        reference's Unknown mapping."""
+        reference's Unknown mapping.
+
+        `delivered` (streaming only) is the count of tokens the client
+        has already received: UNAVAILABLE then carries the mid-stream
+        resume contract in trailing metadata — `resume-supported` plus
+        `resume-tokens` — so a resuming client re-issues the request
+        with `received_tokens` and gets only the missing suffix."""
         if message.startswith(DEADLINE_MSG):
             return errors.DeadlineExceededError(message)
         if message.startswith("engine"):
-            return errors.UnavailableError(message)
+            trailers: tuple = ()
+            if delivered is not None:
+                trailers = (
+                    (errors.RESUME_SUPPORTED_KEY, "1"),
+                    (errors.RESUME_TOKENS_KEY, str(int(delivered))),
+                )
+            return errors.UnavailableError(message, trailers=trailers)
         return RuntimeError(message)
+
+    @staticmethod
+    def _parse_received(params: dict) -> int:
+        """`received_tokens`: how many tokens this client already holds
+        from an interrupted stream (the resume-tokens trailer value).
+        The server replays the generation and suppresses that many
+        leading tokens — exact for greedy and for seeded sampling on a
+        plain engine (position-keyed draws)."""
+        rv = params.get("received_tokens", 0)
+        if isinstance(rv, float) and (not math.isfinite(rv) or rv != int(rv)):
+            raise ValueError("'received_tokens' must be a non-negative integer")
+        received = int(rv)
+        if received < 0:
+            raise ValueError("'received_tokens' must be a non-negative integer")
+        return received
+
+    def _stamp_serving_trailers(self, request: GenRequest) -> None:
+        """Success-path trailers for the replica tier: which replica
+        served, and whether the stream was resumed on another replica
+        (`restarted` — the signal that a SAMPLED stream's suffix may
+        not extend the delivered prefix bit-exactly on a spec engine).
+        No-ops for a bare engine (no replica attribute stamped)."""
+        replica = getattr(request, "replica", None)
+        if replica is None:
+            return
+        trailers = [(errors.REPLICA_KEY, str(replica))]
+        if getattr(request, "restarted", False):
+            trailers.append((errors.RESTARTED_KEY, "1"))
+        errors.add_rpc_trailers(*trailers)
 
     def _drain(self, request: GenRequest, timeout: float):
         """Yield engine events until done/error; raises on timeout."""
@@ -326,7 +384,8 @@ class TpuService(Service):
             raise ValueError("'stop' entries must be non-empty strings")
         return stops
 
-    def _text_events(self, request: GenRequest, stops: list[str]):
+    def _text_events(self, request: GenRequest, stops: list[str],
+                     skip: int = 0):
         """Decode engine tokens into text deltas, applying stop sequences:
         yields ("delta", str) then ("done", timings | None).
 
@@ -336,6 +395,13 @@ class TpuService(Service):
         further device work) and the stream ends cleanly at the text
         BEFORE the earliest match. The engine's own "cancelled" error is
         the expected outcome of that cancellation, not a failure.
+
+        `skip` (client resume, `received_tokens`): the first `skip`
+        tokens still pass through the detokenizer — incremental decode
+        is context-dependent — but their text is discarded, so the
+        stream carries only the suffix the client is missing. An engine-
+        lifecycle failure raises UNAVAILABLE carrying the resume
+        trailers with the total delivered count (skip + this stream's).
         """
         tokenizer = self.engine.tokenizer
         incremental = isinstance(tokenizer, ByteTokenizer)
@@ -344,6 +410,8 @@ class TpuService(Service):
         hold = max((len(s) for s in stops), default=1) - 1
         buf = ""
         stopped = False
+        skipped = 0
+        delivered = 0
         timings = None
         detok_s = 0.0     # cumulative detokenize wall time (trace span)
         for kind, value in self._drain(
@@ -360,6 +428,10 @@ class TpuService(Service):
                     # bounded-window incremental decode, O(n) total.
                     delta = detok.push(value)
                 detok_s += time.monotonic() - t0
+                if skipped < skip:
+                    skipped += 1
+                    continue
+                delivered += 1
                 if not delta:
                     continue
                 if not stops:
@@ -384,7 +456,17 @@ class TpuService(Service):
                     yield "delta", buf
                     buf = ""
             elif kind == "error":
-                raise self._engine_error(value)
+                if buf:
+                    # Flush the stop-scanner's held-back tail first: the
+                    # resume-tokens trailer counts CONSUMED tokens, so
+                    # text still held here would be advertised as
+                    # delivered and silently lost across a client
+                    # resume. The stream is ending either way; a stop
+                    # that would only complete across the resume
+                    # boundary is the one remaining (documented) gap.
+                    yield "delta", buf
+                    buf = ""
+                raise self._engine_error(value, delivered=skip + delivered)
             else:
                 timings = value
         if stopped:
@@ -536,6 +618,7 @@ class TpuService(Service):
         request = self._build_request(parameters)
         request.trace = span
         stops = self._parse_stops(params)
+        skip = self._parse_received(params)
         self._submit(request)
 
         if not stops:
@@ -551,7 +634,7 @@ class TpuService(Service):
                 elif kind == "error":
                     raise self._engine_error(value)
             t0 = time.monotonic()
-            text = self.engine.tokenizer.decode(token_ids)
+            text = self.engine.tokenizer.decode(token_ids[skip:])
             if request.trace is not None:
                 request.trace.child(
                     "detokenize", start=t0, end=time.monotonic(),
@@ -559,11 +642,12 @@ class TpuService(Service):
                 )
         else:
             pieces: list[str] = []
-            for kind, value in self._text_events(request, stops):
+            for kind, value in self._text_events(request, stops, skip):
                 if kind == "delta":
                     pieces.append(value)
             text = "".join(pieces)
 
+        self._stamp_serving_trailers(request)
         response = pk.ExecuteToolResponse(
             status=cmn.Status(code=200, message="Tool executed successfully"),
             string_output=text,
@@ -587,11 +671,12 @@ class TpuService(Service):
         request = self._build_request(parameters)
         request.trace = span
         stops = self._parse_stops(params)
+        skip = self._parse_received(params)
         self._submit(request)
 
         timings = None
         try:
-            for kind, value in self._text_events(request, stops):
+            for kind, value in self._text_events(request, stops, skip):
                 if kind == "delta":
                     yield pk.ExecuteToolStreamChunk(delta=value)
                 else:
@@ -606,6 +691,7 @@ class TpuService(Service):
                 span.set(client_disconnected=True)
             raise
 
+        self._stamp_serving_trailers(request)
         final = pk.ExecuteToolStreamChunk(
             final=True,
             status=cmn.Status(code=200, message="Tool executed successfully"),

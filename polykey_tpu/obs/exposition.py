@@ -24,189 +24,224 @@ from .prometheus import (
     render_counter,
     render_gauge,
     render_header,
-    render_histogram,
     render_sample,
 )
 
 
-def engine_collector(engine_or_provider):
-    """Scrape-time collector over a live InferenceEngine: counters and
-    gauges come from `engine.stats()` (the engine's public surface, so a
-    rename of its internals can't 500 the scrape); the latency families
-    read `engine.metrics.ttft_hist` / `.itl_hist` directly — those two
-    attributes are part of EngineMetrics' public contract (this collector
-    and the snapshot percentiles both depend on them). Registered once
-    per engine via `Registry.register_collector`.
+# The engine metric families, rendered from `engine.stats()` snapshots
+# (counters/gauges) and the EngineMetrics histograms. One table serves
+# BOTH exposition shapes: a bare engine renders unlabeled samples
+# (byte-compatible with the pre-pool page), a replica pool renders one
+# sample per replica with a {replica="i"} label — same family names, so
+# dashboards survive turning the pool on. kind ∈ {counter, gauge,
+# hist}; `key` indexes the stats snapshot, hist entries name the
+# EngineMetrics attribute instead.
+_ENGINE_FAMILIES: tuple = (
+    ("counter", "polykey_requests_admitted_total",
+     "Requests accepted into the engine queue.", "requests_admitted"),
+    ("counter", "polykey_requests_completed_total",
+     "Requests finished successfully.", "requests_completed"),
+    ("counter", "polykey_requests_failed_total",
+     "Requests finished with an error (includes cancellations: "
+     "stop-sequence matches and client disconnects).", "requests_failed"),
+    ("counter", "polykey_requests_shed_total",
+     "Requests rejected at admission (queue bound or "
+     "estimated-delay check) with RESOURCE_EXHAUSTED.", "requests_shed"),
+    # One family, one sample per expiry phase: queued (dropped at
+    # dequeue, never prefilled), prefill (mid-chunked-prefill),
+    # decode (block-boundary drop).
+    ("phases", "polykey_deadline_expired_total",
+     "Requests dropped because their deadline passed, by phase.", None),
+    ("counter", "polykey_decode_tokens_total",
+     "Tokens emitted by the decode loop.", "tokens_generated"),
+    ("counter", "polykey_decode_steps_total",
+     "Decode blocks processed.", "decode_steps"),
+    ("gauge", "polykey_active_requests",
+     "Requests currently holding a decode slot.", "slots_busy"),
+    ("gauge", "polykey_queue_depth",
+     "Requests waiting for admission.", "queued"),
+    ("gauge", "polykey_pages_free",
+     "Free KV pages in the block allocator.", "pages_free"),
+    ("gauge", "polykey_pages_total",
+     "Total KV pages in the pool.", "pages_total"),
+    ("gauge", "polykey_tokens_per_sec",
+     "Decode throughput over the last ~1s window.", "tokens_per_sec"),
+    # Occupancy tracker (ISSUE 4): measured live-lane accounting — the
+    # counters avg_lanes derives from (lane_steps / steps), the EWMA
+    # "now" gauge, and the per-block distribution.
+    ("counter", "polykey_dispatched_blocks_total",
+     "Decode blocks / spec rounds dispatched.", "blocks_dispatched"),
+    ("counter", "polykey_dispatched_steps_total",
+     "Device decode steps dispatched (spec rounds weigh gamma+1).",
+     "steps_dispatched"),
+    ("counter", "polykey_lane_steps_total",
+     "Live-lane-steps dispatched (sum of lanes x steps per block); "
+     "divided by polykey_dispatched_steps_total gives measured "
+     "average occupancy.", "lane_steps"),
+    ("gauge", "polykey_live_lanes",
+     "EWMA of live decode lanes per dispatched block.", "lanes_ewma"),
+    ("gauge", "polykey_decode_slots",
+     "Configured decode slots (occupancy denominator).", "slots_total"),
+    ("counter", "polykey_prefill_tokens_total",
+     "Prefill tokens dispatched (bucket groups + chunks).",
+     "prefill_tokens_total"),
+    ("gauge", "polykey_prefill_interleave_max_tokens",
+     "Worst single-iteration prefill injection while decode lanes "
+     "were live (bounded by the prefill budget + one dispatch).",
+     "interleave_max_tokens"),
+    ("hist", "polykey_live_lanes_per_block",
+     "Live decode lanes at block dispatch.", "lanes_hist"),
+    # Lookahead dispatch pipeline (ISSUE 6): how deep the dispatch
+    # frontier runs ahead of the processed frontier, and what the host
+    # pays when it fails to (DEPLOY.md "diagnosing host-bound decode").
+    ("gauge", "polykey_dispatch_inflight",
+     "Decode blocks dispatched but not yet processed (the "
+     "in-flight lookahead queue).", "inflight_blocks"),
+    ("gauge", "polykey_dispatch_lookahead_depth",
+     "Configured lookahead depth (POLYKEY_DISPATCH_LOOKAHEAD; "
+     "1 = synchronous dispatch-then-read).", "lookahead_depth"),
+    ("hist", "polykey_host_stall_ms",
+     "Time _process_step blocked waiting for a block's D2H "
+     "readback to land, ms (~0 when the lookahead pipeline hides "
+     "the roundtrip).", "host_stall_hist"),
+    ("hist", "polykey_ttft_ms",
+     "Time to first token (enqueue to first emit), ms.", "ttft_hist"),
+    ("hist", "polykey_itl_ms",
+     "Inter-token gap, ms (per decode block, amortized per token).",
+     "itl_hist"),
+)
 
-    Accepts either an engine or a zero-arg provider returning one — a
+_SPEC_FAMILIES: tuple = (
+    ("polykey_spec_drafts_proposed_total",
+     "Speculative draft tokens proposed.", "drafts_proposed"),
+    ("polykey_spec_drafts_accepted_total",
+     "Speculative draft tokens accepted.", "drafts_accepted"),
+)
+
+
+def _histogram_samples(name: str, labels: dict, hist) -> list[str]:
+    """One label-set's samples of a histogram family (header emitted
+    once by the caller — the text format forbids repeating it)."""
+    snap = hist.snapshot()
+    lines = []
+    for bound, cumulative in snap["buckets"]:
+        lines.append(render_sample(
+            f"{name}_bucket", {**labels, "le": f"{bound:g}"}, cumulative
+        ))
+    lines.append(render_sample(
+        f"{name}_bucket", {**labels, "le": "+Inf"}, snap["inf"]
+    ))
+    lines.append(render_sample(f"{name}_sum", labels, snap["sum"]))
+    lines.append(render_sample(f"{name}_count", labels, snap["count"]))
+    return lines
+
+
+def _pool_lines(pool, members: list) -> list[str]:
+    """Pool-tier families (ISSUE 9): replica lifecycle states and the
+    failover/router counters. `members` is [(labels, engine, snap)]."""
+    from ..engine.replica_pool import STATES  # lazy: obs must not import engine at module load
+
+    stats = pool.stats()
+    lines = render_header(
+        "polykey_replica_state",
+        "Replica lifecycle (1 for the replica's current state; states: "
+        + ", ".join(STATES) + ").",
+        "gauge",
+    )
+    states = stats.get("replica_states", {})
+    for index in sorted(states, key=int):
+        for state in STATES:
+            lines.append(render_sample(
+                "polykey_replica_state",
+                {"replica": index, "state": state},
+                1 if states[index] == state else 0,
+            ))
+    lines += render_gauge(
+        "polykey_replicas_serving",
+        "Replicas currently in SERVING state.",
+        stats.get("replicas_serving", 0),
+    )
+    lines += render_counter(
+        "polykey_requests_rerouted_total",
+        "Requests moved to another replica after an engine-lifecycle "
+        "failure (queued moves are lossless; mid-stream moves resume).",
+        stats.get("requests_rerouted", 0),
+    )
+    lines += render_counter(
+        "polykey_streams_resumed_total",
+        "Mid-stream requests resumed on another replica with "
+        "already-emitted tokens suppressed.",
+        stats.get("streams_resumed", 0),
+    )
+    lines += render_header(
+        "polykey_router_decisions_total",
+        "Routing decisions by dominant reason (prefix-hit / least-delay "
+        "/ headroom).",
+        "counter",
+    )
+    for reason, count in sorted(stats.get("router_decisions", {}).items()):
+        lines.append(render_sample(
+            "polykey_router_decisions_total", {"reason": reason}, count
+        ))
+    return lines
+
+
+def engine_collector(engine_or_provider):
+    """Scrape-time collector over a live InferenceEngine OR a
+    ReplicaPool: counters and gauges come from `stats()` snapshots (the
+    public surface, so a rename of engine internals can't 500 the
+    scrape); the latency families read the EngineMetrics histograms
+    directly — part of its public contract. A pool renders every engine
+    family once per replica with a ``replica`` label plus the pool-tier
+    families (replica_state, rerouted/resumed, router decisions); a bare
+    engine renders the exact unlabeled page it always has.
+
+    Accepts either the object or a zero-arg provider returning one — a
     supervised restart (engine/supervisor.py) swaps the live engine out
     from under the registry, and the scrape must follow to the fresh
     instance instead of reading the corpse forever."""
 
     def collect() -> list[str]:
-        engine = (
+        target = (
             engine_or_provider()
             if callable(engine_or_provider) else engine_or_provider
         )
-        snap = engine.stats()
+        pool = target if hasattr(target, "replicas") else None
+        if pool is not None:
+            members = [
+                ({"replica": str(rep.index)}, rep.engine, rep.engine.stats())
+                for rep in pool.replicas
+            ]
+        else:
+            members = [({}, target, target.stats())]
         lines: list[str] = []
-        lines += render_counter(
-            "polykey_requests_admitted_total",
-            "Requests accepted into the engine queue.",
-            snap["requests_admitted"],
-        )
-        lines += render_counter(
-            "polykey_requests_completed_total",
-            "Requests finished successfully.", snap["requests_completed"],
-        )
-        lines += render_counter(
-            "polykey_requests_failed_total",
-            "Requests finished with an error (includes cancellations: "
-            "stop-sequence matches and client disconnects).",
-            snap["requests_failed"],
-        )
-        lines += render_counter(
-            "polykey_requests_shed_total",
-            "Requests rejected at admission (queue bound or "
-            "estimated-delay check) with RESOURCE_EXHAUSTED.",
-            snap["requests_shed"],
-        )
-        # One family, one sample per expiry phase: queued (dropped at
-        # dequeue, never prefilled), prefill (mid-chunked-prefill),
-        # decode (block-boundary drop).
-        lines += render_header(
-            "polykey_deadline_expired_total",
-            "Requests dropped because their deadline passed, by phase.",
-            "counter",
-        )
-        for phase in ("queued", "prefill", "decode"):
-            lines.append(render_sample(
-                "polykey_deadline_expired_total", {"phase": phase},
-                snap[f"deadline_expired_{phase}"],
-            ))
-        lines += render_counter(
-            "polykey_decode_tokens_total",
-            "Tokens emitted by the decode loop.", snap["tokens_generated"],
-        )
-        lines += render_counter(
-            "polykey_decode_steps_total",
-            "Decode blocks processed.", snap["decode_steps"],
-        )
-        lines += render_gauge(
-            "polykey_active_requests",
-            "Requests currently holding a decode slot.", snap["slots_busy"],
-        )
-        lines += render_gauge(
-            "polykey_queue_depth",
-            "Requests waiting for admission.", snap["queued"],
-        )
-        lines += render_gauge(
-            "polykey_pages_free",
-            "Free KV pages in the block allocator.", snap["pages_free"],
-        )
-        lines += render_gauge(
-            "polykey_pages_total",
-            "Total KV pages in the pool.", snap["pages_total"],
-        )
-        lines += render_gauge(
-            "polykey_tokens_per_sec",
-            "Decode throughput over the last ~1s window.",
-            snap["tokens_per_sec"],
-        )
-        # Occupancy tracker (ISSUE 4): measured live-lane accounting —
-        # the counters avg_lanes derives from (lane_steps / steps), the
-        # EWMA "now" gauge, and the per-block distribution. These are
-        # what replaces avg_lanes_source: "assumed_full" in roofline
-        # grading.
-        lines += render_counter(
-            "polykey_dispatched_blocks_total",
-            "Decode blocks / spec rounds dispatched.",
-            snap["blocks_dispatched"],
-        )
-        lines += render_counter(
-            "polykey_dispatched_steps_total",
-            "Device decode steps dispatched (spec rounds weigh gamma+1).",
-            snap["steps_dispatched"],
-        )
-        lines += render_counter(
-            "polykey_lane_steps_total",
-            "Live-lane-steps dispatched (sum of lanes x steps per block); "
-            "divided by polykey_dispatched_steps_total gives measured "
-            "average occupancy.",
-            snap["lane_steps"],
-        )
-        lines += render_gauge(
-            "polykey_live_lanes",
-            "EWMA of live decode lanes per dispatched block.",
-            snap["lanes_ewma"],
-        )
-        lines += render_gauge(
-            "polykey_decode_slots",
-            "Configured decode slots (occupancy denominator).",
-            snap["slots_total"],
-        )
-        lines += render_counter(
-            "polykey_prefill_tokens_total",
-            "Prefill tokens dispatched (bucket groups + chunks).",
-            snap["prefill_tokens_total"],
-        )
-        lines += render_gauge(
-            "polykey_prefill_interleave_max_tokens",
-            "Worst single-iteration prefill injection while decode lanes "
-            "were live (bounded by the prefill budget + one dispatch).",
-            snap["interleave_max_tokens"],
-        )
-        # polylint: disable=PL007(lanes are a unitless count, not a ms/bytes quantity)
-        lines += render_histogram(
-            "polykey_live_lanes_per_block",
-            "Live decode lanes at block dispatch.",
-            engine.metrics.lanes_hist,
-        )
-        # Lookahead dispatch pipeline (ISSUE 6): how deep the dispatch
-        # frontier runs ahead of the processed frontier, and what the
-        # host pays when it fails to — a host_stall_ms p50 near the
-        # device roundtrip means decode is host-bound (DEPLOY.md
-        # "diagnosing host-bound decode").
-        lines += render_gauge(
-            "polykey_dispatch_inflight",
-            "Decode blocks dispatched but not yet processed (the "
-            "in-flight lookahead queue).",
-            snap["inflight_blocks"],
-        )
-        lines += render_gauge(
-            "polykey_dispatch_lookahead_depth",
-            "Configured lookahead depth (POLYKEY_DISPATCH_LOOKAHEAD; "
-            "1 = synchronous dispatch-then-read).",
-            snap["lookahead_depth"],
-        )
-        lines += render_histogram(
-            "polykey_host_stall_ms",
-            "Time _process_step blocked waiting for a block's D2H "
-            "readback to land, ms (~0 when the lookahead pipeline hides "
-            "the roundtrip).",
-            engine.metrics.host_stall_hist,
-        )
-        lines += render_histogram(
-            "polykey_ttft_ms",
-            "Time to first token (enqueue to first emit), ms.",
-            engine.metrics.ttft_hist,
-        )
-        lines += render_histogram(
-            "polykey_itl_ms",
-            "Inter-token gap, ms (per decode block, amortized per token).",
-            engine.metrics.itl_hist,
-        )
-        if snap.get("drafts_proposed"):
-            lines += render_counter(
-                "polykey_spec_drafts_proposed_total",
-                "Speculative draft tokens proposed.",
-                snap["drafts_proposed"],
-            )
-            lines += render_counter(
-                "polykey_spec_drafts_accepted_total",
-                "Speculative draft tokens accepted.",
-                snap["drafts_accepted"],
-            )
+        for kind, name, help_text, key in _ENGINE_FAMILIES:
+            if kind == "phases":
+                lines += render_header(name, help_text, "counter")
+                for labels, _engine, snap in members:
+                    for phase in ("queued", "prefill", "decode"):
+                        lines.append(render_sample(
+                            name, {**labels, "phase": phase},
+                            snap[f"deadline_expired_{phase}"],
+                        ))
+            elif kind == "hist":
+                lines += render_header(name, help_text, "histogram")
+                for labels, engine, _snap in members:
+                    lines += _histogram_samples(
+                        name, labels, getattr(engine.metrics, key)
+                    )
+            else:
+                lines += render_header(name, help_text, kind)
+                for labels, _engine, snap in members:
+                    lines.append(render_sample(name, labels, snap[key]))
+        if any(snap.get("drafts_proposed") for _, _, snap in members):
+            for name, help_text, key in _SPEC_FAMILIES:
+                lines += render_header(name, help_text, "counter")
+                for labels, _engine, snap in members:
+                    if snap.get("drafts_proposed"):
+                        lines.append(render_sample(name, labels, snap[key]))
+        if pool is not None:
+            lines += _pool_lines(pool, members)
         return lines
 
     return collect

@@ -1,0 +1,337 @@
+"""Failover drill: kill one replica under open-loop load, lose nothing.
+
+ISSUE 9's acceptance criterion in script form: with ≥2 replicas serving
+Poisson traffic, injecting a fault that kills ONE replica mid-run
+(`step-stall` targeted via ``:replica=K``, long enough to trip the
+watchdog) must cost added latency only:
+
+- **zero failed requests** — the pool re-routes the dead replica's
+  queued work losslessly and resumes its in-flight streams on healthy
+  replicas (greedy streams bit-identically; test_replica_pool pins the
+  bit-identity itself, this drill pins it at load);
+- **every stream is token-complete** — exactly max_new tokens arrive
+  per request (greedy, no EOS on the hermetic byte tokenizer);
+- **bounded p95 TTFT inflation** — post-kill p95 TTFT may exceed the
+  pre-kill p95 by at most --max-p95-added-ms (the detection + reroute
+  latency bound), not collapse into timeouts;
+- **recovery to full capacity** — the killed replica's supervisor
+  restarts it and the pool returns to all-replicas-SERVING.
+
+Writes a JSON artifact and exits nonzero on any violated bound. CI runs
+`make failover-smoke` (2 replicas / short window); the committed
+acceptance artifact comes from `make failover-soak` (3 replicas).
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# The image pre-registers the axon plugin; the env var alone is not
+# enough (tests/conftest.py has the same workaround).
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def percentile(values: list, q: float) -> float:
+    if not values:
+        return 0.0
+    return float(np.percentile(np.asarray(values, dtype=np.float64), q))
+
+
+def build_pool(args):
+    from polykey_tpu.engine.config import EngineConfig
+    from polykey_tpu.engine.replica_pool import ReplicaPool
+
+    config = EngineConfig(
+        model=args.model,
+        dtype="float32",
+        max_decode_slots=args.slots,
+        page_size=8,
+        num_pages=args.slots * (args.max_seq // 8) + 32,
+        max_seq_len=args.max_seq,
+        prefill_buckets=(16, 32),
+        max_new_tokens_cap=args.max_new,
+        default_max_new_tokens=args.max_new,
+        decode_block_steps=2,
+        adaptive_block=False,
+        lookahead_blocks=2,
+        # Pre-compile BEFORE the watchdogs arm: a cold first-dispatch
+        # compile can exceed the test-scaled watchdog window and read as
+        # a spurious stall (the pool would recover, but the drill must
+        # attribute every reroute to ITS injected kill).
+        compile_warmup=True,
+        warm_sampled_variants=False,
+        # Open-loop load keeps a backlog; shedding it would turn
+        # deliberate oversubscription into "failed RPCs".
+        max_queue_depth=0,
+        watchdog_timeout_s=args.watchdog_timeout,
+        supervise=True,
+        max_engine_restarts=5,
+        restart_window_s=600.0,
+        replicas=args.replicas,
+    )
+    return ReplicaPool.create(
+        config,
+        watchdog_interval_s=0.1,
+        supervisor_interval_s=0.1,
+    )
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--slots", type=int, default=4,
+                    help="decode slots PER replica")
+    ap.add_argument("--duration", type=float, default=30.0)
+    ap.add_argument("--rate", type=float, default=0.0,
+                    help="arrivals/s; 0 -> auto-calibrate via a warm burst")
+    ap.add_argument("--oversub", type=float, default=0.8,
+                    help="auto-rate multiplier over pool slots/service_time "
+                         "(< 1: the drill measures failover, not saturation)")
+    ap.add_argument("--max-new", type=int, default=16)
+    ap.add_argument("--max-seq", type=int, default=64)
+    ap.add_argument("--model", default="tiny-llama")
+    ap.add_argument("--kill-replica", type=int, default=0)
+    ap.add_argument("--kill-at", type=float, default=0.35,
+                    help="kill time as a fraction of --duration")
+    ap.add_argument("--stall", type=float, default=2.0,
+                    help="injected stall seconds (> watchdog window)")
+    ap.add_argument("--watchdog-timeout", type=float, default=0.6)
+    ap.add_argument("--max-p95-added-ms", type=float, default=8000.0,
+                    help="post-kill p95 TTFT may exceed pre-kill p95 by "
+                         "at most this (detection + reroute bound)")
+    ap.add_argument("--recovery-timeout", type=float, default=60.0)
+    ap.add_argument("--seed", type=int, default=17)
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+
+    if args.replicas < 2:
+        log("failover drill needs >= 2 replicas")
+        return 2
+
+    from polykey_tpu import faults
+    from polykey_tpu.engine.engine import GenRequest
+    from polykey_tpu.engine.replica_pool import SERVING
+
+    rng = np.random.default_rng(args.seed)
+    log(f"building {args.replicas}-replica pool "
+        f"({args.slots} slots each, compile warmup) ...")
+    pool = build_pool(args)
+
+    results_lock = threading.Lock()
+    results: list[dict] = []
+
+    def drain(request: GenRequest, enqueued_at: float) -> None:
+        tokens = 0
+        error = None
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            try:
+                kind, value = request.out.get(
+                    timeout=deadline - time.monotonic())
+            except Exception:
+                # queue.Empty (or a negative timeout at the deadline
+                # edge): both mean the stream starved — recorded as a
+                # drill failure below, never silently dropped.
+                error = "drain timeout"
+                break
+            if kind == "token":
+                tokens += 1
+            elif kind == "done":
+                break
+            else:
+                error = value
+                break
+        else:
+            error = error or "drain timeout"
+        with results_lock:
+            results.append({
+                "enqueued_at": enqueued_at,
+                "tokens": tokens,
+                "error": error,
+                "ttft_ms": request.timings.ttft_ms,
+                "replica": getattr(request, "replica", None),
+                "restarted": bool(getattr(request, "restarted", False)),
+            })
+
+    def fire(prompt: str, enqueued_at: float) -> threading.Thread:
+        request = GenRequest(prompt=prompt, max_new_tokens=args.max_new)
+        pool.submit(request)
+        thread = threading.Thread(
+            target=drain, args=(request, enqueued_at), daemon=True
+        )
+        thread.start()
+        return thread
+
+    # Warm every replica (spreads via the router's load term) and
+    # calibrate the arrival rate from the measured service time.
+    warm_start = time.monotonic()
+    warm_threads = [
+        fire(f"warm replica {i}", 0.0) for i in range(args.replicas)
+    ]
+    for thread in warm_threads:
+        thread.join(timeout=120)
+    service_s = max(0.05, (time.monotonic() - warm_start))
+    with results_lock:
+        results.clear()       # warmers don't count
+    rate = args.rate or (
+        args.oversub * args.replicas * args.slots / service_s
+    )
+    log(f"warm service ~{service_s:.2f}s -> rate {rate:.1f}/s; "
+        f"kill replica {args.kill_replica} at "
+        f"{args.kill_at * args.duration:.1f}s")
+
+    start = time.monotonic()
+    kill_at = start + args.kill_at * args.duration
+    killed_at = None
+    threads = []
+    index = 0
+    next_arrival = start
+    while True:
+        now = time.monotonic()
+        if killed_at is None and now >= kill_at:
+            # The targeted stall wedges ONE replica's decode dispatch
+            # long enough to trip its watchdog; every other replica
+            # keeps serving (":replica=K" scoping, faults.py). Engines
+            # cache the shared injector at construction (the env-var
+            # path arms it before the server boots), so a MID-RUN kill
+            # must hand the fresh injector to the live engine; the
+            # supervisor's replacement engine re-reads the shared one,
+            # whose @1 budget is then already spent — restart runs clean.
+            injector = faults.install(
+                f"step-stall={args.stall}@1:replica={args.kill_replica}"
+            )
+            pool.replicas[args.kill_replica].engine._faults = injector
+            killed_at = now
+            log(f"t+{now - start:.1f}s: injected kill on replica "
+                f"{args.kill_replica}")
+        if now - start >= args.duration:
+            break
+        if now >= next_arrival:
+            threads.append(fire(f"soak request {index}", now - start))
+            index += 1
+            next_arrival += rng.exponential(1.0 / rate)
+        else:
+            time.sleep(min(0.005, next_arrival - now))
+
+    log(f"arrivals done ({index}); draining ...")
+    for thread in threads:
+        thread.join(timeout=180)
+    alive = sum(t.is_alive() for t in threads)
+
+    # Recovery: the supervisor restarts the killed replica and the pool
+    # returns to full SERVING capacity.
+    recovered_s = None
+    recovery_deadline = time.monotonic() + args.recovery_timeout
+    while time.monotonic() < recovery_deadline:
+        states = pool.stats()["replica_states"]
+        if all(state == SERVING for state in states.values()):
+            recovered_s = time.monotonic() - (killed_at or start)
+            break
+        time.sleep(0.1)
+
+    stats = pool.stats()
+    faults.clear()
+    pool.shutdown()
+
+    with results_lock:
+        done = list(results)
+    kill_rel = (killed_at - start) if killed_at is not None else None
+    failed = [r for r in done if r["error"] is not None]
+    short = [r for r in done if r["error"] is None
+             and r["tokens"] != args.max_new]
+    pre = [r["ttft_ms"] for r in done
+           if r["error"] is None and kill_rel is not None
+           and r["enqueued_at"] < kill_rel and r["ttft_ms"] > 0]
+    post = [r["ttft_ms"] for r in done
+            if r["error"] is None and kill_rel is not None
+            and r["enqueued_at"] >= kill_rel and r["ttft_ms"] > 0]
+    p95_pre = percentile(pre, 95)
+    p95_post = percentile(post, 95)
+    added_ms = p95_post - p95_pre
+
+    artifact = {
+        "schema": "polykey_failover_soak_v1",
+        "replicas": args.replicas,
+        "slots_per_replica": args.slots,
+        "duration_s": args.duration,
+        "rate_per_s": round(rate, 2),
+        "arrivals": index,
+        "completed": len(done) - len(failed),
+        "failed": len(failed),
+        "failed_errors": sorted({r["error"] for r in failed})[:5],
+        "short_streams": len(short),
+        "undrained": alive,
+        "kill_replica": args.kill_replica,
+        "kill_at_s": round(kill_rel, 2) if kill_rel is not None else None,
+        "requests_rerouted": stats["requests_rerouted"],
+        "streams_resumed": stats["streams_resumed"],
+        "router_decisions": stats["router_decisions"],
+        "restarted_streams": sum(r["restarted"] for r in done),
+        "ttft_ms_p50_pre_kill": round(percentile(pre, 50), 1),
+        "ttft_ms_p95_pre_kill": round(p95_pre, 1),
+        "ttft_ms_p50_post_kill": round(percentile(post, 50), 1),
+        "ttft_ms_p95_post_kill": round(p95_post, 1),
+        "p95_added_ms": round(added_ms, 1),
+        "max_p95_added_ms": args.max_p95_added_ms,
+        "recovered_to_full_capacity_s": (
+            round(recovered_s, 2) if recovered_s is not None else None
+        ),
+        "replica_states_final": stats["replica_states"],
+        "per_replica_completed": {
+            str(s.get("replica")): s.get("requests_completed")
+            for s in stats["per_replica"]
+        },
+    }
+    out = args.out or os.path.join(
+        "perf", f"failover_soak_{time.strftime('%Y-%m-%d')}.json"
+    )
+    os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=2, sort_keys=True)
+        f.write("\n")
+    log(json.dumps(artifact, indent=2, sort_keys=True))
+    log(f"artifact -> {out}")
+
+    ok = True
+    if failed or alive:
+        log(f"FAIL: {len(failed)} failed requests, {alive} undrained "
+            "(the drill requires ZERO failed RPCs)")
+        ok = False
+    if short:
+        log(f"FAIL: {len(short)} streams finished short of "
+            f"{args.max_new} tokens")
+        ok = False
+    if killed_at is None:
+        log("FAIL: kill never fired (duration too short for --kill-at)")
+        ok = False
+    if stats["requests_rerouted"] < 1:
+        log("FAIL: kill caused no reroutes — the fault missed "
+            "(no request was on the killed replica?)")
+        ok = False
+    if added_ms > args.max_p95_added_ms:
+        log(f"FAIL: p95 TTFT inflation {added_ms:.0f}ms exceeds bound "
+            f"{args.max_p95_added_ms:.0f}ms")
+        ok = False
+    if recovered_s is None:
+        log("FAIL: pool never recovered to full SERVING capacity")
+        ok = False
+    log("failover drill " + ("PASSED" if ok else "FAILED"))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

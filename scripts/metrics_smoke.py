@@ -69,6 +69,21 @@ CONFIG = EngineConfig(
     default_max_new_tokens=16,
 )
 
+# Replica-tier families (ISSUE 9): present on a pool-backed stack, with
+# engine families carrying a replica label per member.
+POOL_FAMILIES = (
+    'polykey_requests_completed_total{replica="0"}',
+    'polykey_requests_completed_total{replica="1"}',
+    'polykey_ttft_ms_bucket{le="+Inf",replica="0"}',
+    'polykey_replica_state{replica="0",state="SERVING"} 1',
+    'polykey_replica_state{replica="1",state="SERVING"} 1',
+    "polykey_replicas_serving 2",
+    "polykey_requests_rerouted_total",
+    "polykey_streams_resumed_total",
+    'polykey_router_decisions_total{reason="least-delay"}',
+    'polykey_deadline_expired_total{phase="queued",replica="1"}',
+)
+
 
 def scrape(port: int) -> str:
     with urllib.request.urlopen(
@@ -78,6 +93,87 @@ def scrape(port: int) -> str:
         ctype = resp.headers["Content-Type"]
         assert "text/plain" in ctype, ctype
         return resp.read().decode()
+
+
+def pool_smoke() -> list:
+    """Replica-tier exposition (ISSUE 9): boot a 2-replica pool behind
+    the same gateway wiring, drive both replicas (two concurrent
+    generations — the router load-balances the second away from the
+    first), and assert the replica-labeled engine families, the
+    pool-tier families, and that engine_stats aggregates across
+    replicas."""
+    import dataclasses
+
+    from polykey_tpu.engine.replica_pool import ReplicaPool
+
+    print("booting 2-replica pool on CPU ...", flush=True)
+    logger = Logger(stream=open(os.devnull, "w"))
+    obs = Observability()
+    config = dataclasses.replace(CONFIG, replicas=2)
+    pool = ReplicaPool.create(config, logger=logger, obs=obs)
+    service = TpuService.create(pool, logger=logger, obs=obs)
+    server, _, port = gateway_server.build_server(
+        service, logger, address="127.0.0.1:0", obs=obs
+    )
+    server.start()
+    metrics = MetricsHTTPServer(obs.registry, host="127.0.0.1", port=0)
+    metrics.start()
+
+    failures: list[str] = []
+    try:
+        channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+        stub = PolykeyServiceStub(channel)
+
+        def generate(prompt):
+            request = pk.ExecuteToolRequest(tool_name="llm_generate")
+            request.parameters.update({"prompt": prompt, "max_tokens": 24})
+            chunks = list(stub.ExecuteToolStream(request, timeout=120))
+            assert chunks[-1].final
+
+        # Two concurrent streams: the second routes to the other replica
+        # (least-delay), so BOTH replicas record completions.
+        threads = [
+            threading.Thread(target=generate, args=(f"pool smoke {i}",))
+            for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive(), "pool generation did not finish"
+
+        page = scrape(metrics.port)
+        for family in POOL_FAMILIES:
+            if family not in page:
+                failures.append(f"pool page missing: {family}")
+
+        # engine_stats must aggregate across replicas: the top-level
+        # completed count is the sum of the per-replica ones.
+        stats = dict(
+            stub.ExecuteTool(
+                pk.ExecuteToolRequest(tool_name="engine_stats"), timeout=30
+            ).struct_output
+        )
+        per = [dict(s) for s in stats.get("per_replica", [])]
+        if stats.get("replicas_total") != 2 or len(per) != 2:
+            failures.append("engine_stats missing per_replica for 2 replicas")
+        else:
+            total = sum(s.get("requests_completed", 0) for s in per)
+            if stats.get("requests_completed") != total or total < 4:
+                failures.append(
+                    "engine_stats requests_completed does not aggregate: "
+                    f"top={stats.get('requests_completed')} sum={total}"
+                )
+            if min(s.get("requests_completed", 0) for s in per) < 1:
+                failures.append(
+                    "router never load-balanced: a replica served nothing"
+                )
+        channel.close()
+    finally:
+        metrics.stop()
+        server.stop(grace=None)
+        service.close()
+    return failures
 
 
 def main() -> int:
@@ -160,13 +256,16 @@ def main() -> int:
         server.stop(grace=None)
         service.close()
 
+    failures += pool_smoke()
+
     if failures:
         print("metrics-smoke FAILED:")
         for failure in failures:
             print(f"  - {failure}")
         return 1
     print(f"metrics-smoke OK: {len(REQUIRED_FAMILIES)} families present, "
-          "span tree complete")
+          f"span tree complete, {len(POOL_FAMILIES)} replica-pool "
+          "families present, engine_stats aggregates across replicas")
     return 0
 
 

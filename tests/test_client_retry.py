@@ -180,6 +180,122 @@ def test_retry_none_disables_retries():
         server.stop(grace=None)
 
 
+class _ResumableService(Service):
+    """Models the ISSUE 9 mid-stream failure contract: the first stream
+    yields a prefix then dies UNAVAILABLE with resume-supported +
+    resume-tokens trailers; a follow-up call carrying received_tokens
+    streams only the suffix. Tokens are 1:1 with characters here."""
+
+    FULL = "abcdef"
+
+    def __init__(self, fail_after: int = 3):
+        self.fail_after = fail_after
+        self.calls = 0
+        self.received_tokens_seen = []
+
+    def execute_tool(self, tool_name, parameters, secret_id, metadata):
+        raise NotImplementedError
+
+    def execute_tool_stream(self, tool_name, parameters, secret_id, metadata):
+        self.calls += 1
+        params = dict(parameters) if parameters is not None else {}
+        received = int(params.get("received_tokens", 0))
+        self.received_tokens_seen.append(received)
+        if received == 0 and self.calls == 1:
+            yield pk.ExecuteToolStreamChunk(delta=self.FULL[:self.fail_after])
+            raise errors.UnavailableError(
+                "engine restarting: watchdog trip",
+                trailers=(
+                    (errors.RESUME_SUPPORTED_KEY, "1"),
+                    (errors.RESUME_TOKENS_KEY, str(self.fail_after)),
+                ),
+            )
+        yield pk.ExecuteToolStreamChunk(delta=self.FULL[received:])
+        yield pk.ExecuteToolStreamChunk(
+            final=True, status=cmn.Status(code=200, message="ok")
+        )
+
+
+@pytest.fixture()
+def resumable_stack():
+    started = []
+
+    def make(fail_after=3, max_attempts=4):
+        service = _ResumableService(fail_after=fail_after)
+        server, _, port = gateway_server.build_server(
+            service, Logger(stream=io.StringIO()), address="127.0.0.1:0"
+        )
+        server.start()
+        sleeps: list[float] = []
+        policy = RetryPolicy(
+            max_attempts=max_attempts, base_delay_s=0.01,
+            sleep=sleeps.append,
+        )
+        cfg = types.SimpleNamespace(
+            server_address=f"127.0.0.1:{port}", timeout=5.0
+        )
+        cli = Client(cfg, Logger(stream=io.StringIO()), retry=policy)
+        started.append((server, cli))
+        return cli, service, sleeps
+
+    yield make
+    for server, cli in started:
+        cli.close()
+        server.stop(grace=None)
+
+
+def test_stream_resumes_on_resume_supported_trailer(resumable_stack):
+    # Mid-stream UNAVAILABLE *with* the resume trailers IS retried —
+    # with received_tokens — and the result concatenates prefix+suffix
+    # without replaying anything.
+    cli, service, sleeps = resumable_stack(fail_after=3)
+    text = cli.execute_tool_stream(_request(), timeout=5)
+    assert text == _ResumableService.FULL
+    assert service.calls == 2
+    assert service.received_tokens_seen == [0, 3]
+    assert len(sleeps) == 1
+
+
+def test_stream_resume_respects_retry_budget(resumable_stack):
+    # retry=None (or an exhausted budget) must not resume either — the
+    # resume path rides the same policy as ordinary retries.
+    service = _ResumableService(fail_after=2)
+    server, _, port = gateway_server.build_server(
+        service, Logger(stream=io.StringIO()), address="127.0.0.1:0"
+    )
+    server.start()
+    cfg = types.SimpleNamespace(server_address=f"127.0.0.1:{port}", timeout=5.0)
+    cli = Client(cfg, Logger(stream=io.StringIO()), retry=None)
+    try:
+        with pytest.raises(grpc.RpcError) as err:
+            cli.execute_tool_stream(_request(), timeout=5)
+        assert err.value.code() == grpc.StatusCode.UNAVAILABLE
+        assert service.calls == 1
+    finally:
+        cli.close()
+        server.stop(grace=None)
+
+
+def test_resume_tokens_parse_helper():
+    class _Err:
+        def __init__(self, md):
+            self._md = md
+
+        def trailing_metadata(self):
+            return self._md
+
+    ok = _Err(((errors.RESUME_SUPPORTED_KEY, "1"),
+               (errors.RESUME_TOKENS_KEY, "17")))
+    assert client_mod.resume_tokens_from(ok) == 17
+    assert client_mod.resume_tokens_from(
+        _Err(((errors.RESUME_TOKENS_KEY, "17"),))
+    ) is None   # no resume-supported flag
+    assert client_mod.resume_tokens_from(
+        _Err(((errors.RESUME_SUPPORTED_KEY, "1"),))
+    ) is None   # flag without a count is malformed
+    assert client_mod.resume_tokens_from(_Err(None)) is None
+
+
 def test_retry_after_parse_helpers():
     class _Err:
         def __init__(self, md):
