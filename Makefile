@@ -9,8 +9,8 @@ BUILD_DIR := build
 
 .PHONY: help run run-client test test-models native protos clean bench dryrun \
 	kernel-check tunnel-probe bench-tokenizer tpu-watch metrics-smoke \
-	chaos-smoke print-chaos occupancy-smoke occupancy-soak \
-	failover-smoke failover-soak
+	obs-smoke chaos-smoke print-chaos occupancy-smoke occupancy-soak \
+	failover-smoke failover-soak timeline-capture
 
 help: ## Show available targets
 	@grep -E '^[a-zA-Z_-]+:.*?## .*$$' $(MAKEFILE_LIST) | \
@@ -47,8 +47,25 @@ protos: ## Regenerate protobuf stubs from protos/
 bench: ## Run the benchmark harness (prints one JSON line)
 	$(PYTHON) bench.py
 
-metrics-smoke: ## Boot the stack on CPU, scrape /metrics, assert required families
-	JAX_PLATFORMS=cpu $(PYTHON) scripts/metrics_smoke.py
+# Observability acceptance probe (ISSUE 10; grown from PR 1's
+# metrics-smoke): families, OpenMetrics exemplars, the gated /debug
+# surface (incl. a 2-replica pool), and a CPU profiler-capture
+# round-trip with the single-flight guarantee.
+obs-smoke: ## Boot the stack on CPU; assert families, exemplars, debug endpoints, profiler
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/obs_smoke.py
+
+metrics-smoke: obs-smoke ## Legacy alias for obs-smoke
+
+# Flight-deck timeline capture (ISSUE 10): a short CPU occupancy soak
+# exporting the engine timeline as Perfetto JSON. The committed
+# perf/timeline_*.json artifacts come from this target (open them at
+# https://ui.perfetto.dev); tests/test_timeline.py validates structure.
+timeline-capture: ## Capture a CPU soak timeline to perf/ (Perfetto JSON)
+	JAX_PLATFORMS=cpu POLYKEY_DISPATCH_LOOKAHEAD=2 \
+	  $(PYTHON) scripts/occupancy_soak.py \
+	  --slots 8 --duration 12 --min-occupancy 0.7 \
+	  --out /tmp/timeline_soak.json \
+	  --timeline perf/timeline_$$(date -u +%Y-%m-%d).json
 
 # Deterministic fault-injection suite (ISSUE 3 + ISSUE 9): deadline
 # drops, load shedding, watchdog trip → supervised restart, client
@@ -173,12 +190,13 @@ scan: ## Security scan (Trivy fs over the tree + lockfile, CRITICAL/HIGH gate)
 	  --scanners vuln,secret \
 	  --severity CRITICAL,HIGH
 
-ci-check: ## Run the CI pipeline locally: lint+polylint+graphlint, chaos, failover, occupancy, tests, native(+asan), scan
+ci-check: ## Run the CI pipeline locally: lint+polylint+graphlint, chaos, failover, occupancy, obs, tests, native(+asan), scan
 	@$(MAKE) lint
 	@$(MAKE) graphlint
 	@$(MAKE) chaos-smoke
 	@$(MAKE) failover-smoke
 	@$(MAKE) occupancy-smoke
+	@$(MAKE) obs-smoke
 	@$(MAKE) test
 	@$(MAKE) native
 	@$(MAKE) native-asan

@@ -532,6 +532,16 @@ def _probe_step_costs(engine, max_new: int) -> dict:
         if gap_ms:
             out["overlap_ratio"] = round(
                 min(1.0, max(0.0, (gap_ms - stall_ms) / gap_ms)), 3)
+    # Attribution-side cross-check (ISSUE 10): the windowed device-busy
+    # fraction from the per-block attribution the engine charges to
+    # requests — should track overlap_ratio (same gap − stall model,
+    # accumulated per block instead of averaged over means).
+    gap_total = (lanes1["dispatch_gap_ms_total"]
+                 - lanes0["dispatch_gap_ms_total"])
+    if gap_total > 0:
+        out["device_busy_fraction"] = round(
+            (lanes1["device_busy_ms_total"]
+             - lanes0["device_busy_ms_total"]) / gap_total, 3)
     out["lookahead_depth"] = getattr(engine, "_depth", 1)
     return out
 

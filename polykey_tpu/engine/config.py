@@ -178,6 +178,16 @@ class EngineConfig:
     # stream finishes. 1 → classic dispatch-then-process.
     lookahead_blocks: int = 2
 
+    # Flight-deck timeline (ISSUE 10): bounded ring of typed engine
+    # events — dispatch/process frontiers, admissions, prefill chunks,
+    # retirements, expiries, restarts, re-routes — exported as
+    # Perfetto JSON (/debug/timeline, occupancy_soak --timeline).
+    # Capacity bounds memory (events are small tuples; 4096 ≈ a few
+    # hundred KB worst case). 0 DISABLES it: the engine allocates no
+    # ring and every emission site is one `is None` branch, so an
+    # obs-less deployment pays nothing. POLYKEY_TIMELINE_CAPACITY.
+    timeline_capacity: int = 4096
+
     # Parallelism axes (parallel/mesh.py); 1 → axis unused. ep shards MoE
     # expert weights and rides token dispatch over the ep axis (Mixtral —
     # BASELINE.md measurement config 4); it requires an MoE model. sp
@@ -335,6 +345,9 @@ class EngineConfig:
                 "POLYKEY_DISPATCH_LOOKAHEAD",
                 _env_int("POLYKEY_LOOKAHEAD", cls.lookahead_blocks),
             ),
+            timeline_capacity=_env_int(
+                "POLYKEY_TIMELINE_CAPACITY", cls.timeline_capacity
+            ),
             tp=_env_int("POLYKEY_TP", cls.tp),
             dp=_env_int("POLYKEY_DP", cls.dp),
             ep=_env_int("POLYKEY_EP", cls.ep),
@@ -410,6 +423,10 @@ class EngineConfig:
             raise ValueError("decode_block_steps must be >= 1")
         if self.lookahead_blocks < 1:
             raise ValueError("lookahead_blocks must be >= 1")
+        if self.timeline_capacity < 0:
+            raise ValueError(
+                "timeline_capacity must be >= 0 (0 disables the ring)"
+            )
         if self.quantize_bits not in (4, 8):
             raise ValueError("quantize_bits must be 4 or 8")
         if self.kv_dtype not in ("", "bfloat16", "float32", "int8"):

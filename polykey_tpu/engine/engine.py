@@ -68,6 +68,7 @@ import numpy as np
 
 from ..faults import get_injector
 from ..models.config import ModelConfig, get_config
+from ..obs.timeline import TimelineRecorder
 from ..models.transformer import forward_paged, init_params, unembed
 from ..parallel.mesh import MeshConfig, create_mesh
 from ..parallel.sharding import paged_kv_sharding, shard_params
@@ -329,12 +330,16 @@ class _InflightBlock(NamedTuple):
     block's dispatch sequence number — at process time,
     engine._dispatch_seq - seq is the OBSERVED lookahead (how many newer
     blocks were dispatched before this one's readback), the number the
-    loop-trace regression test pins."""
+    loop-trace regression test pins. `gap_ms` (the host gap preceding
+    this dispatch) and `live` (slot indices active at dispatch) carry
+    the device-time attribution inputs to process time (ISSUE 10)."""
 
     kind: str
     data: object
     reqs: list
     seq: int = 0
+    gap_ms: float = 0.0
+    live: tuple = ()
 
 
 class EngineDeadError(RuntimeError):
@@ -731,12 +736,18 @@ class InferenceEngine:
             )))
         except ValueError:
             self._depth = config.lookahead_blocks
-        # Pipeline flight recorder: a bounded ring of ("dispatch", seq) /
-        # ("process", seq, observed_lookahead, queued_after) events —
-        # cheap tuples, always on — so the dispatch/process ordering is
-        # replayable post-hoc (the loop-trace regression test asserts
-        # N+1-before-N on it; an operator can dump it from a debugger).
-        self._pipe_events: deque = deque(maxlen=512)
+        # Flight-deck timeline (ISSUE 10): the promoted pipeline ring —
+        # typed, bounded, always-on events for both frontiers plus slot
+        # lifecycle, exported as Perfetto JSON (/debug/timeline). The
+        # loop-trace regression test asserts dispatch-N+1-before-
+        # process-N on it. timeline_capacity=0 disables it entirely:
+        # no ring allocated, every emission site one `is None` branch —
+        # obs-off engines pay nothing (the memory-discipline contract
+        # tests/test_timeline.py pins).
+        self.timeline: Optional[TimelineRecorder] = (
+            TimelineRecorder(config.timeline_capacity)
+            if config.timeline_capacity > 0 else None
+        )
         self._dispatch_seq = 0
         # In-flight target for the CURRENT block size: when the adaptive
         # dispatcher shrinks K, the LOOKAHEAD portion deepens by the
@@ -863,12 +874,21 @@ class InferenceEngine:
             and time.monotonic() >= request.deadline
         )
 
+    @staticmethod
+    def _trace_id_of(request: Optional[GenRequest]) -> Optional[str]:
+        if request is None or request.trace is None:
+            return None
+        return request.trace.trace_id
+
     def _expire(self, request: GenRequest, phase: str) -> None:
         """Fail an expired request that never held (or no longer holds)
         a slot. Slot-holding expiries go through _finish instead."""
         self.metrics.on_deadline_expired(phase)
+        if self.timeline is not None:
+            self.timeline.expire(phase, self._trace_id_of(request))
         request.out.put(("error", f"{DEADLINE_MSG} while {phase}"))
-        self.metrics.on_finish(request.timings, failed=True)
+        self.metrics.on_finish(request.timings, failed=True,
+                               trace_id=self._trace_id_of(request))
 
     def stats(self) -> dict:
         snap = self.metrics.snapshot()
@@ -1044,6 +1064,12 @@ class InferenceEngine:
                 if worked:
                     self.last_progress = time.monotonic()
                 else:
+                    # Idle iteration ⇒ no live lanes and an empty
+                    # pipeline: the idle wait must not be charged to the
+                    # next request as device time (attribution reads the
+                    # inter-dispatch gap as device-busy, which only
+                    # holds while dispatches tile the device schedule).
+                    self.metrics.on_dispatch_idle()
                     self._resolve_prefills(block=True)
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
@@ -1116,6 +1142,11 @@ class InferenceEngine:
                 try:
                     prep = self._prepare_request(free_slots[0], request)
                     admitted = True
+                    if self.timeline is not None:
+                        self.timeline.admit(
+                            free_slots[0], self._trace_id_of(request),
+                            request.timings.prompt_tokens,
+                        )
                     if trace is not None:
                         trace["adm_ok"] = trace.get("adm_ok", 0) + 1
                     if prep is not None:
@@ -1139,7 +1170,8 @@ class InferenceEngine:
                     return admitted, spent
                 except Exception as e:
                     request.out.put(("error", f"admission failed: {e}"))
-                    self.metrics.on_finish(request.timings, failed=True)
+                    self.metrics.on_finish(request.timings, failed=True,
+                                           trace_id=self._trace_id_of(request))
             return admitted, spent
         finally:
             for bucket, group in groups.items():
@@ -1345,6 +1377,8 @@ class InferenceEngine:
                     self._finish(slot_idx, error=f"prefill failed: {e}")
             return
         for r, (slot_idx, slot, _, _) in enumerate(group):
+            if self.timeline is not None:
+                self.timeline.prefill(slot_idx, bucket, True)
             self._merge_slot(slot_idx, slot, toks_dev, r)
 
     def _compile_warmup(self) -> None:
@@ -1637,6 +1671,8 @@ class InferenceEngine:
         self._last_tokens[slot_idx] = token
         request.timings.first_token = time.monotonic()
         slot.last_emit = request.timings.first_token
+        if self.timeline is not None:
+            self.timeline.slot_start(slot_idx, self._trace_id_of(request))
         if request.trace is not None:
             # Prefill phase: admission tokenize through first-token
             # delivery (covers bucketed, batched, and chunked prefill —
@@ -1714,6 +1750,8 @@ class InferenceEngine:
         except Exception as e:
             self._finish(slot_idx, error=f"prefill failed: {e}")
             return
+        if self.timeline is not None:
+            self.timeline.prefill(slot_idx, take, final)
         if final:
             # The final chunk's sampled token activates the lane (on-device
             # merge; the host delivers it to the client once its async copy
@@ -1787,12 +1825,19 @@ class InferenceEngine:
             # Occupancy tracker: a spec round's scan length is gamma
             # draft steps + one verify — the step weight that makes its
             # lane-seconds comparable to a plain K-step block's.
-            self.metrics.on_dispatch(int(act.sum()), self._gamma + 1)
+            lanes = int(act.sum())
+            gap_ms = self.metrics.on_dispatch(lanes, self._gamma + 1)
+            live = tuple(int(i) for i in np.flatnonzero(act))
             data = self._dispatch_spec(dev, spec_candidates)
             self._dispatch_seq += 1
-            self._pipe_events.append(("dispatch", self._dispatch_seq))
+            if self.timeline is not None:
+                self.timeline.dispatch(
+                    self._dispatch_seq, "spec", lanes, self._gamma + 1,
+                    gap_ms,
+                )
             return _InflightBlock(
                 "spec", data, self._snapshot_requests(), self._dispatch_seq,
+                gap_ms, live,
             )
         # Static variant: an all-greedy batch (the benchmark mode) skips
         # sample_dynamic's [B, vocab] sort and all RNG work. At most two
@@ -1824,7 +1869,9 @@ class InferenceEngine:
             1 + (self._depth - 1) * (self._block_steps // max(1, steps)),
             blocks_needed,
         )
-        self.metrics.on_dispatch(int(act.sum()), steps)
+        lanes = int(act.sum())
+        gap_ms = self.metrics.on_dispatch(lanes, steps)
+        live = tuple(int(i) for i in np.flatnonzero(act))
         with jax.profiler.TraceAnnotation("polykey/decode"):
             (packed_dev, last_dev, seq_dev, act_dev,
              self.paged) = self._jit_decode(
@@ -1862,9 +1909,13 @@ class InferenceEngine:
             # not correctness.
             pass
         self._dispatch_seq += 1
-        self._pipe_events.append(("dispatch", self._dispatch_seq))
+        if self.timeline is not None:
+            self.timeline.dispatch(
+                self._dispatch_seq, "plain", lanes, steps, gap_ms
+            )
         return _InflightBlock(
             "plain", packed_dev, self._snapshot_requests(), self._dispatch_seq,
+            gap_ms, live,
         )
 
     def _eff_top_k(self, request: GenRequest) -> int:
@@ -1915,7 +1966,10 @@ class InferenceEngine:
         if n > 0:
             now = time.monotonic()
             if slot.last_emit > 0:
-                self.metrics.on_itl((now - slot.last_emit) * 1e3 / n, n)
+                self.metrics.on_itl(
+                    (now - slot.last_emit) * 1e3 / n, n,
+                    trace_id=self._trace_id_of(slot.request),
+                )
             slot.last_emit = now
 
     def _snapshot_requests(self):
@@ -1951,19 +2005,21 @@ class InferenceEngine:
         frontier, i.e. observed lookahead 0)."""
         kind, data, reqs = block[0], block[1], block[2]
         seq = block[3] if len(block) > 3 else self._dispatch_seq
+        gap_ms = block[4] if len(block) > 4 else 0.0
+        live = block[5] if len(block) > 5 else ()
         # Observed lookahead: blocks dispatched after this one, before its
         # readback — ≥1 is the overlap the pipeline exists for; 0 is the
         # synchronous depth-1 shape. Recorded for every processed block
         # (the loop-trace test and engine_stats read it).
         lookahead = self._dispatch_seq - seq
-        self._pipe_events.append(
-            ("process", seq, lookahead, len(self._inflight_q))
-        )
+        queued_after = len(self._inflight_q)
         if kind == "spec":
             # Spec rounds always sync: their device-computed acceptance
             # stats feed the gamma-tuning dial even when every occupant is
             # gone by processing time.
-            self._process_spec(data, reqs, lookahead)
+            self._process_spec(data, reqs, lookahead, seq=seq,
+                               gap_ms=gap_ms, live=live,
+                               queued_after=queued_after)
             return
         if not any(
             s is not None and s.request is reqs[i]
@@ -1972,8 +2028,13 @@ class InferenceEngine:
             # Dead block: every dispatch-time occupant is gone (batch
             # drained / all cancelled). Nothing to emit — skip the sync
             # entirely so the drain costs no host↔device roundtrip (no
-            # stall is recorded: nothing was read).
+            # stall is recorded: nothing was read; no device time is
+            # attributed: every lane's request already finished).
             self.metrics.on_process_block(lookahead, None)
+            if self.timeline is not None:
+                now = time.monotonic()
+                self.timeline.process(seq, now, now, None, lookahead,
+                                      queued_after, 0.0)
             return
         t_sync = time.monotonic()
         with _host_crossing():
@@ -1983,9 +2044,11 @@ class InferenceEngine:
         # this block's copy to land — ~0 when lookahead hid the roundtrip,
         # ~roundtrip_ms when the host is on the critical path (the r03
         # signature this pipeline exists to erase).
+        stall_ms = (time.monotonic() - t_sync) * 1e3
         self.metrics.on_process_block(
-            lookahead, (time.monotonic() - t_sync) * 1e3
+            lookahead, stall_ms, trace_id=self._block_trace_id(reqs, live)
         )
+        busy_ms = self._attribute_device_time(gap_ms, stall_ms, live, reqs)
 
         emitted = 0
         for i, slot in enumerate(self._slots):
@@ -2028,6 +2091,48 @@ class InferenceEngine:
                     break
             self._note_block_done(slot, before)
         self.metrics.on_step(emitted)
+        if self.timeline is not None:
+            self.timeline.process(seq, t_sync, time.monotonic(), stall_ms,
+                                  lookahead, queued_after, busy_ms)
+
+    def _block_trace_id(self, reqs, live) -> Optional[str]:
+        """A trace id to exemplar block-level observations with: the
+        first traced request live in the block (any live request is an
+        honest witness for a shared stall)."""
+        for i in live:
+            trace_id = self._trace_id_of(reqs[i])
+            if trace_id is not None:
+                return trace_id
+        return None
+
+    def _attribute_device_time(self, gap_ms: float, stall_ms: float,
+                               live, reqs) -> float:
+        """Per-request device-time attribution (ISSUE 10): charge this
+        block's device-busy window — the host gap that preceded its
+        dispatch minus the host stall its readback cost — equally to the
+        lanes live at dispatch, into each request's timings.device_ms.
+
+        The dispatch gap approximates the block's device residency
+        (dispatches serialize on the device through the pool donation
+        chain, so at steady state consecutive dispatches tile the
+        device's schedule); subtracting the measured stall removes the
+        host's share. Conservation: Σ busy ≤ Σ counted gaps ≤ wall, so
+        Σ per-request device_ms can never exceed wall × slots — and on
+        a single-lane run the one request receives exactly
+        device_busy_ms_total (both pinned by tests/test_timeline.py).
+        Returns the busy ms charged (0.0 when nothing was)."""
+        if not live or gap_ms <= 0.0:
+            return 0.0
+        busy = gap_ms - max(0.0, stall_ms)
+        if busy <= 0.0:
+            return 0.0
+        self.metrics.on_device_busy(busy)
+        share = busy / len(live)
+        for i in live:
+            request = reqs[i]
+            if request is not None:
+                request.timings.device_ms += share
+        return busy
 
     def _dispatch_spec(self, dev: dict, candidates: int = 0):
         """Dispatch one draft/verify round (spec_decode.py). `candidates`
@@ -2058,7 +2163,9 @@ class InferenceEngine:
             pass
         return packed_dev, stats_dev
 
-    def _process_spec(self, data, reqs, lookahead: int = 0) -> None:
+    def _process_spec(self, data, reqs, lookahead: int = 0, seq: int = 0,
+                      gap_ms: float = 0.0, live: tuple = (),
+                      queued_after: int = 0) -> None:
         """Sync a spec round; emits each row's packed prefix (-1 padded —
         device-truncated). Acceptance stats come FROM the device
         (spec_decode_fn), which owns truncation and the untruncated n_acc
@@ -2070,9 +2177,11 @@ class InferenceEngine:
             packed = np.asarray(packed_dev)  # [B, gamma+1]; blocks until done
             # polylint: disable=PL001(device-owned acceptance stats feed the gamma dial)
             accepted, proposed = (int(v) for v in np.asarray(stats_dev))
+        stall_ms = (time.monotonic() - t_sync) * 1e3
         self.metrics.on_process_block(
-            lookahead, (time.monotonic() - t_sync) * 1e3
+            lookahead, stall_ms, trace_id=self._block_trace_id(reqs, live)
         )
+        busy_ms = self._attribute_device_time(gap_ms, stall_ms, live, reqs)
 
         emitted = 0
         for i, slot in enumerate(self._slots):
@@ -2108,6 +2217,9 @@ class InferenceEngine:
                     break
             self._note_block_done(slot, before)
         self.metrics.on_step(emitted)
+        if self.timeline is not None:
+            self.timeline.process(seq, t_sync, time.monotonic(), stall_ms,
+                                  lookahead, queued_after, busy_ms)
         self.metrics.on_spec(accepted, proposed)
         if proposed > 0 and self._gamma_low != self._gamma_max:
             # The gamma dial: EWMA of the per-draft acceptance rate with a
@@ -2140,9 +2252,21 @@ class InferenceEngine:
         request = slot.request
         request.timings.finished = time.monotonic()
         request.timings.completion_tokens = slot.generated
+        if self.timeline is not None:
+            self.timeline.slot_end(
+                slot_idx,
+                "cancelled" if error == "cancelled"
+                else ("error" if error is not None else "done"),
+                slot.generated,
+            )
         if slot.decode_span is not None:
             slot.decode_span.set(tokens=slot.generated)
             slot.decode_span.finish(end=request.timings.finished)
+        if request.trace is not None and request.timings.device_ms > 0:
+            # Attribution rides the span tree too: the root span carries
+            # the request's accumulated device time so a flight-recorder
+            # tree answers "device or host?" without cross-referencing.
+            request.trace.set(device_ms=round(request.timings.device_ms, 3))
         if request.trace is not None and error is not None:
             # Cancellation is not a failure label: the gateway cancels on
             # stop-sequence matches and client disconnects, both of which
@@ -2191,10 +2315,12 @@ class InferenceEngine:
                 self._dev_dirty = True
         if error is not None:
             request.out.put(("error", error))
-            self.metrics.on_finish(request.timings, failed=True)
+            self.metrics.on_finish(request.timings, failed=True,
+                                   trace_id=self._trace_id_of(request))
         else:
             request.out.put(("done", request.timings))
-            self.metrics.on_finish(request.timings)
+            self.metrics.on_finish(request.timings,
+                                   trace_id=self._trace_id_of(request))
 
     def _fail_pending(self, message: str) -> None:
         try:

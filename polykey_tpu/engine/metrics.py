@@ -41,6 +41,12 @@ class RequestTimings:
     finished: float = 0.0
     prompt_tokens: int = 0
     completion_tokens: int = 0
+    # Device-time attribution (ISSUE 10): the request's share of every
+    # decode block's device-busy window (dispatch gap minus host stall,
+    # split equally across the lanes live at dispatch). Accumulated by
+    # the engine thread; surfaced as a span attribute, the `device-ms`
+    # trailer, and the polykey_request_device_ms histogram.
+    device_ms: float = 0.0
 
     @property
     def ttft_ms(self) -> float:
@@ -139,12 +145,22 @@ class EngineMetrics:
         self.dispatch_gap_ms_total = 0.0
         self.dispatch_gaps = 0
         self._last_dispatch_t = 0.0
+        # Device-time attribution (ISSUE 10): total device-busy ms
+        # charged across blocks (gap − stall, clamped ≥ 0) and the
+        # per-request distribution of that charge. busy/gap is the
+        # polykey_device_busy_fraction gauge — the "how device-bound is
+        # steady state" dial, from the recorded schedule.
+        self.device_busy_ms_total = 0.0
+        self.device_ms_hist = Histogram()
 
     def on_process_block(self, lookahead: int,
-                         stall_ms: Optional[float]) -> None:
+                         stall_ms: Optional[float],
+                         trace_id: Optional[str] = None) -> None:
         """One in-flight block processed with `lookahead` newer blocks
         already dispatched; `stall_ms` is the blocking-readback wall time
-        (None for dead blocks whose sync was skipped entirely)."""
+        (None for dead blocks whose sync was skipped entirely).
+        `trace_id` exemplars the stall bucket with a request that was
+        live in the block."""
         with self._lock:
             self.blocks_processed += 1
             self.lookahead_sum += lookahead
@@ -154,7 +170,22 @@ class EngineMetrics:
                 self.blocks_synced += 1
                 self.host_stall_ms_total += stall_ms
         if stall_ms is not None:
-            self.host_stall_hist.observe(stall_ms)
+            self.host_stall_hist.observe(stall_ms, trace_id=trace_id)
+
+    def on_device_busy(self, busy_ms: float) -> None:
+        """Device-busy ms attributed to one processed block."""
+        with self._lock:
+            self.device_busy_ms_total += busy_ms
+
+    def on_dispatch_idle(self) -> None:
+        """The engine went idle (no live lanes, nothing in flight): reset
+        the dispatch-gap clock so the FIRST block of the next request is
+        not charged the idle wait as device-busy time. Without this, a
+        low-QPS engine (one request every few seconds) reports seconds
+        of device_ms for sub-second requests — the gap-tiles-the-device
+        assumption only holds while dispatches are back to back."""
+        with self._lock:
+            self._last_dispatch_t = 0.0
 
     def on_prefill_interleave(self, tokens: int, decode_live: bool) -> None:
         """Prefill tokens dispatched in one engine-loop iteration;
@@ -167,10 +198,13 @@ class EngineMetrics:
             if decode_live and tokens > self.interleave_max_tokens:
                 self.interleave_max_tokens = tokens
 
-    def on_dispatch(self, lanes: int, steps: int) -> None:
+    def on_dispatch(self, lanes: int, steps: int) -> float:
         """One decode block (or spec round) dispatched with `lanes` live
-        decode lanes for `steps` device steps."""
+        decode lanes for `steps` device steps. Returns the counted
+        dispatch gap in ms (0.0 for the first dispatch or an idle-capped
+        gap) — the attribution window the engine charges to the block."""
         now = time.monotonic()
+        counted_gap = 0.0
         with self._lock:
             if self._last_dispatch_t:
                 gap_ms = (now - self._last_dispatch_t) * 1e3
@@ -180,6 +214,7 @@ class EngineMetrics:
                 if gap_ms < 10_000.0:
                     self.dispatch_gap_ms_total += gap_ms
                     self.dispatch_gaps += 1
+                    counted_gap = gap_ms
             self._last_dispatch_t = now
             self.blocks_dispatched += 1
             self.lanes_dispatched += lanes
@@ -190,6 +225,7 @@ class EngineMetrics:
                 else 0.9 * self._lanes_ewma + 0.1 * lanes
             )
         self.lanes_hist.observe(float(lanes))
+        return counted_gap
 
     def lanes_snapshot(self) -> dict:
         """Occupancy counters alone — cheap enough for harnesses to poll
@@ -213,6 +249,7 @@ class EngineMetrics:
                 "host_stall_ms_total": self.host_stall_ms_total,
                 "dispatch_gap_ms_total": self.dispatch_gap_ms_total,
                 "dispatch_gaps": self.dispatch_gaps,
+                "device_busy_ms_total": self.device_busy_ms_total,
             }
 
     def on_admit(self) -> None:
@@ -243,14 +280,15 @@ class EngineMetrics:
                 self._window_start = now
                 self._window_tokens = 0
 
-    def on_itl(self, gap_ms: float, count: int = 1) -> None:
+    def on_itl(self, gap_ms: float, count: int = 1,
+               trace_id: Optional[str] = None) -> None:
         """Record `count` tokens delivered with a per-token gap of
         `gap_ms` (one decode block's inter-emit window amortized over its
         tokens). Per-BLOCK measurement, not per-request mean: a 2 s stall
         between blocks lands in the histogram as 2 s-scale gaps for that
         block's tokens instead of vanishing into a request average."""
         if gap_ms > 0:
-            self.itl_hist.observe(gap_ms, count)
+            self.itl_hist.observe(gap_ms, count, trace_id=trace_id)
 
     def on_spec(self, accepted: int, proposed: int) -> None:
         """Per-round speculative counters; acceptance rate is the speedup
@@ -259,7 +297,8 @@ class EngineMetrics:
             self.drafts_accepted += accepted
             self.drafts_proposed += proposed
 
-    def on_finish(self, timings: RequestTimings, failed: bool = False) -> None:
+    def on_finish(self, timings: RequestTimings, failed: bool = False,
+                  trace_id: Optional[str] = None) -> None:
         ttft = timings.ttft_ms
         with self._lock:
             if failed:
@@ -277,7 +316,10 @@ class EngineMetrics:
                 self.ttft_ms_sum += ttft
                 self.ttft_ms_count += 1
         if ttft > 0:
-            self.ttft_hist.observe(ttft)
+            self.ttft_hist.observe(ttft, trace_id=trace_id)
+        if timings.device_ms > 0:
+            self.device_ms_hist.observe(timings.device_ms,
+                                        trace_id=trace_id)
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -328,6 +370,15 @@ class EngineMetrics:
                     if self.blocks_processed else 0.0
                 ),
                 "host_stall_ms_total": round(self.host_stall_ms_total, 2),
+                "device_busy_ms_total": round(self.device_busy_ms_total, 2),
+                # Cumulative device-busy fraction of inter-dispatch wall
+                # time — the attribution-side mirror of bench's windowed
+                # overlap_ratio, always in [0, 1] (busy = gap − stall).
+                "device_busy_fraction": (
+                    round(self.device_busy_ms_total
+                          / self.dispatch_gap_ms_total, 4)
+                    if self.dispatch_gap_ms_total else 0.0
+                ),
             }
             if self.steps_dispatched:
                 # Step-weighted measured occupancy — the number roofline
@@ -360,6 +411,10 @@ class EngineMetrics:
             p50, p95 = self.host_stall_hist.percentiles(50, 95)
             snap["host_stall_ms_p50"] = round(p50, 2)
             snap["host_stall_ms_p95"] = round(p95, 2)
+        if self.device_ms_hist.count:
+            p50, p95 = self.device_ms_hist.percentiles(50, 95)
+            snap["request_device_ms_p50"] = round(p50, 2)
+            snap["request_device_ms_p95"] = round(p95, 2)
         if drafts_proposed:
             snap["drafts_accepted"] = drafts_accepted
             snap["drafts_proposed"] = drafts_proposed

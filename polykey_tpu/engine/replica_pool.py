@@ -89,6 +89,7 @@ _ADDITIVE_KEYS = frozenset({
     "inflight_blocks",
     "blocks_dispatched", "lane_steps", "steps_dispatched",
     "prefill_tokens_total", "blocks_processed", "host_stall_ms_total",
+    "device_busy_ms_total",
     "prefix_cache_pages", "prefix_hit_tokens", "prefix_lookup_tokens",
     "drafts_accepted", "drafts_proposed",
 })
@@ -581,9 +582,17 @@ class ReplicaPool:
                 timings.completion_tokens = record.emitted
                 if timings.first_token == 0.0:
                     timings.first_token = attempt_t.first_token
+                # Device-time attribution accumulates ACROSS attempts: a
+                # resumed stream's device cost includes the replay work
+                # on the new replica (that honesty is the point).
+                timings.device_ms += attempt_t.device_ms
                 forward = ("done", timings)
             else:  # error
                 record.terminal = True
+                if record.attempt is not None:
+                    record.request.timings.device_ms += (
+                        record.attempt.timings.device_ms
+                    )
                 if self._recoverable(record, value):
                     reroute_cause = value
                 else:
@@ -608,8 +617,9 @@ class ReplicaPool:
         """Move a failed request to a healthy replica: queued requests
         (emitted == 0) transfer losslessly; mid-stream requests resume
         with the already-delivered tokens suppressed."""
-        self._on_replica_down(record.replica)
-        exclude = {record.replica}
+        failed_replica = record.replica
+        self._on_replica_down(failed_replica)
+        exclude = {failed_replica}
         while True:
             replica, reason = self._route(record.request, exclude)
             if replica is None:
@@ -648,6 +658,29 @@ class ReplicaPool:
                 if resumed:
                     self.streams_resumed += 1
             self._count_decision(reason)
+            # Trace continuity (ISSUE 10): the stream keeps its original
+            # root span (attempts share it), and the failover becomes an
+            # explicit `resume` child — the span tree then SHOWS the
+            # replica move a postmortem reader would otherwise have to
+            # reconstruct from counters. Instant span (start == end):
+            # the resumed work itself lands as further decode children.
+            trace = record.request.trace
+            if trace is not None:
+                now = time.monotonic()
+                trace.child(
+                    "resume", start=now, end=now,
+                    from_replica=failed_replica, to_replica=replica.index,
+                    suppressed_tokens=record.suppress, cause=cause,
+                )
+            # And the TARGET replica's flight-deck timeline marks the
+            # arrival, so its Perfetto export explains the admission
+            # burst a failover causes.
+            timeline = getattr(replica.engine, "timeline", None)
+            if timeline is not None:
+                timeline.note(
+                    "reroute_in", from_replica=failed_replica,
+                    resumed=resumed, suppressed_tokens=record.suppress,
+                )
             if self.recorder is not None:
                 self.recorder.event(
                     "request_rerouted", to_replica=replica.index,

@@ -200,6 +200,13 @@ class EngineSupervisor:
             self.recorder.event(
                 "engine_restart", reason=reason, restarts=self.restarts,
             )
+        # The fresh engine's flight-deck timeline opens with the restart
+        # marker, so a Perfetto export of the post-restart schedule shows
+        # WHY the frontier counters reset (ISSUE 10).
+        timeline = getattr(fresh, "timeline", None)
+        if timeline is not None:
+            timeline.note("engine_restart", reason=reason,
+                          restarts=self.restarts)
         if self.logger is not None:
             self.logger.info(
                 "engine restarted", restarts=self.restarts,

@@ -43,6 +43,12 @@ REPLICA_KEY = "replica"
 RESTARTED_KEY = "restarted"
 RESUME_SUPPORTED_KEY = "resume-supported"
 RESUME_TOKENS_KEY = "resume-tokens"
+# Device-time attribution (ISSUE 10): successful LLM RPCs carry the
+# request's accumulated device milliseconds — each decode block's
+# device-busy window (dispatch gap minus host stall) split across its
+# live lanes — so a client can separate "the model was slow" from "the
+# server was busy" without scraping anything.
+DEVICE_MS_KEY = "device-ms"
 
 
 class RpcStatusError(RuntimeError):

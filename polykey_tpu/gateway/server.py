@@ -34,7 +34,7 @@ from ..proto.polykey_v2_grpc import (
     PolykeyServiceServicer,
     add_PolykeyServiceServicer_to_server,
 )
-from ..obs import MetricsHTTPServer, Observability
+from ..obs import DebugSurface, MetricsHTTPServer, Observability
 from . import errors
 from .health import HealthService
 from .interceptor import LoggingInterceptor
@@ -244,7 +244,7 @@ def serve(service: Optional[Service] = None, address: Optional[str] = None) -> N
         logger.error("failed to listen", error=str(e))
         raise SystemExit(1)
 
-    metrics_server = _start_metrics_server(obs, logger)
+    metrics_server = _start_metrics_server(obs, logger, service=service)
 
     _log_service_table(logger)
 
@@ -266,12 +266,18 @@ def serve(service: Optional[Service] = None, address: Optional[str] = None) -> N
 
 
 def _start_metrics_server(
-    obs: Observability, logger: Logger
+    obs: Observability, logger: Logger, service=None
 ) -> Optional[MetricsHTTPServer]:
     """Prometheus exposition sidecar thread. POLYKEY_METRICS_PORT picks
     the port (default 9464, the conventional exporter port); 0 disables.
     A bind failure degrades to no endpoint rather than killing the
-    gateway — the gRPC metrics_text view still works."""
+    gateway — the gRPC metrics_text view still works.
+
+    When the backend is engine-shaped (TpuService) the flight-deck
+    debug surface mounts alongside /metrics — still a 404 unless
+    POLYKEY_DEBUG_ENDPOINTS=1 (obs.exposition.DebugSurface). The
+    engine provider follows `service.engine` so supervised restarts
+    and replica pools stay visible without rewiring."""
     port_raw = os.environ.get("POLYKEY_METRICS_PORT", "9464")
     try:
         port = int(port_raw)
@@ -281,8 +287,17 @@ def _start_metrics_server(
         return None
     if port <= 0:
         return None
+    debug = None
+    if service is not None and hasattr(service, "engine"):
+        debug = DebugSurface(
+            engine_provider=lambda: service.engine,
+            obs=obs,
+            profiler=getattr(service, "profiler", None),
+        )
     try:
-        metrics_server = MetricsHTTPServer(obs.registry, port=port).start()
+        metrics_server = MetricsHTTPServer(
+            obs.registry, port=port, debug=debug
+        ).start()
     except OSError as e:
         logger.warn("metrics endpoint failed to bind; continuing without",
                     port=port, error=str(e))

@@ -45,6 +45,7 @@ from ..engine.supervisor import EngineSupervisor
 from ..engine.tokenizer import ByteTokenizer, IncrementalDetokenizer
 from ..engine.watchdog import Watchdog
 from ..obs import Observability, current_span, engine_collector
+from ..obs.profiler import ProfilerCapture
 from ..proto import common_v2_pb2 as cmn
 from ..proto import polykey_v2_pb2 as pk
 from . import errors
@@ -75,7 +76,14 @@ class TpuService(Service):
         self.stall_counter = None
         self.restart_counter = None
         self._mock = MockService()
-        self._profile_dir: Optional[str] = None
+        # Single-flight profiler shared by the engine_profile tool AND
+        # the /debug/profile HTTP trigger (obs/profiler.py): whichever
+        # surface starts a capture, the other sees "busy" — jax's
+        # profiler is process-global and two overlapping captures
+        # corrupt each other's artifacts.
+        self.profiler = ProfilerCapture(
+            recorder=obs.recorder if obs is not None else None
+        )
         if obs is not None:
             # Bind the engine into the scrape registry. A registry holds
             # ONE engine's families (the names carry no engine label):
@@ -335,18 +343,23 @@ class TpuService(Service):
         return received
 
     def _stamp_serving_trailers(self, request: GenRequest) -> None:
-        """Success-path trailers for the replica tier: which replica
-        served, and whether the stream was resumed on another replica
-        (`restarted` — the signal that a SAMPLED stream's suffix may
-        not extend the delivered prefix bit-exactly on a spec engine).
-        No-ops for a bare engine (no replica attribute stamped)."""
+        """Success-path trailers: the request's attributed device time
+        (`device-ms`, any engine) plus the replica-tier pair — which
+        replica served, and whether the stream was resumed on another
+        replica (`restarted` — the signal that a SAMPLED stream's
+        suffix may not extend the delivered prefix bit-exactly on a
+        spec engine; replica keys are absent on a bare engine)."""
+        trailers = []
+        device_ms = request.timings.device_ms
+        if device_ms > 0:
+            trailers.append((errors.DEVICE_MS_KEY, f"{device_ms:.2f}"))
         replica = getattr(request, "replica", None)
-        if replica is None:
-            return
-        trailers = [(errors.REPLICA_KEY, str(replica))]
-        if getattr(request, "restarted", False):
-            trailers.append((errors.RESTARTED_KEY, "1"))
-        errors.add_rpc_trailers(*trailers)
+        if replica is not None:
+            trailers.append((errors.REPLICA_KEY, str(replica)))
+            if getattr(request, "restarted", False):
+                trailers.append((errors.RESTARTED_KEY, "1"))
+        if trailers:
+            errors.add_rpc_trailers(*trailers)
 
     def _drain(self, request: GenRequest, timeout: float):
         """Yield engine events until done/error; raises on timeout."""
@@ -520,25 +533,18 @@ class TpuService(Service):
         params: action = start | stop | status; log_dir (start only).
         Captured traces carry the polykey/prefill, polykey/decode and
         polykey/spec_decode annotations around the engine's device steps
-        (engine.py) and open in TensorBoard / xprof.
+        (engine.py) and open in TensorBoard / xprof. Delegates to the
+        shared single-flight ProfilerCapture, so a capture started here
+        blocks /debug/profile (and vice versa) — ProfilerBusyError is a
+        ValueError, preserving the tool's original double-start contract.
         """
-        import jax
-
         params = dict(parameters) if parameters is not None else {}
         action = params.get("action", "status")
         if action == "start":
-            log_dir = str(params.get("log_dir", "/tmp/polykey_profile"))
-            if self._profile_dir is not None:
-                raise ValueError(
-                    f"profiler already tracing to {self._profile_dir}"
-                )
-            jax.profiler.start_trace(log_dir)
-            self._profile_dir = log_dir
+            log_dir = params.get("log_dir")
+            self.profiler.start(str(log_dir) if log_dir else None)
         elif action == "stop":
-            if self._profile_dir is None:
-                raise ValueError("profiler is not tracing")
-            jax.profiler.stop_trace()
-            self._profile_dir = None
+            self.profiler.stop()
             if self.logger is not None:
                 self.logger.info("profiler trace captured")
         elif action != "status":
@@ -548,9 +554,10 @@ class TpuService(Service):
         response = pk.ExecuteToolResponse(
             status=cmn.Status(code=200, message="Tool executed successfully")
         )
+        status = self.profiler.status()
         response.struct_output.update({
-            "profiling": self._profile_dir is not None,
-            "log_dir": self._profile_dir or "",
+            "profiling": status["profiling"],
+            "log_dir": status["log_dir"],
         })
         return response
 

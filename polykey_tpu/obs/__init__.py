@@ -12,10 +12,12 @@ serving component reports into one `Observability` bundle —
 Everything is stdlib-only and cheap enough to stay on in production.
 """
 
-from .exposition import MetricsHTTPServer, engine_collector
+from .exposition import DebugSurface, MetricsHTTPServer, engine_collector
 from .histogram import DEFAULT_MS_BUCKETS, Histogram, log_buckets
+from .profiler import ProfilerBusyError, ProfilerCapture
 from .prometheus import (
     CONTENT_TYPE,
+    CONTENT_TYPE_OPENMETRICS,
     Counter,
     Gauge,
     HistogramMetric,
@@ -24,6 +26,7 @@ from .prometheus import (
     render_gauge,
     render_histogram,
 )
+from .timeline import TimelineRecorder, engine_timelines, to_perfetto
 from .trace import (
     FlightRecorder,
     Span,
@@ -45,15 +48,21 @@ class Observability:
 
 __all__ = [
     "CONTENT_TYPE",
+    "CONTENT_TYPE_OPENMETRICS",
     "Counter",
     "DEFAULT_MS_BUCKETS",
+    "DebugSurface",
     "FlightRecorder",
     "Gauge",
     "Histogram",
     "HistogramMetric",
     "MetricsHTTPServer",
     "Observability",
+    "ProfilerBusyError",
+    "ProfilerCapture",
+    "TimelineRecorder",
     "engine_collector",
+    "engine_timelines",
     "Registry",
     "Span",
     "Tracer",
@@ -64,4 +73,5 @@ __all__ = [
     "render_gauge",
     "render_histogram",
     "set_current_span",
+    "to_perfetto",
 ]

@@ -3,9 +3,18 @@
 A stdlib ``http.server`` thread on the gateway (no new dependencies, no
 asyncio) serving:
 
-- ``GET /metrics`` — the registry's full text page;
+- ``GET /metrics`` — the registry's full text page. A scraper sending
+  ``Accept: application/openmetrics-text`` gets the OpenMetrics
+  rendering: same families plus per-bucket exemplars carrying the
+  ``trace_id`` of a recent request in that bucket (TTFT / ITL /
+  host-stall / device-ms), so a p99 bucket links straight to its
+  recorded span tree in the flight recorder.
 - ``GET /healthz`` — 200 "ok" (container-level liveness probes that
   can't speak gRPC health).
+- ``GET /debug/*`` — the read-only flight-deck surface (ISSUE 10),
+  served ONLY while ``POLYKEY_DEBUG_ENDPOINTS=1``: engine stats JSON,
+  the Perfetto timeline export, the flight recorder, a single trace by
+  id, and the single-flight profiler trigger. See `DebugSurface`.
 
 The engine collector snapshots `InferenceEngine` state at scrape time —
 no background sampler, no per-step bookkeeping beyond what
@@ -14,16 +23,21 @@ no background sampler, no per-step bookkeeping beyond what
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs
 
 from .prometheus import (
     CONTENT_TYPE,
+    CONTENT_TYPE_OPENMETRICS,
     Registry,
     render_counter,
     render_gauge,
     render_header,
+    render_histogram_samples,
     render_sample,
 )
 
@@ -104,6 +118,18 @@ _ENGINE_FAMILIES: tuple = (
      "Time _process_step blocked waiting for a block's D2H "
      "readback to land, ms (~0 when the lookahead pipeline hides "
      "the roundtrip).", "host_stall_hist"),
+    # Device-time attribution (ISSUE 10): per-block device-busy
+    # (dispatch gap minus host stall) apportioned to the lanes live in
+    # that block, accumulated per request — wall time split into
+    # device vs host from the recorded schedule.
+    ("gauge", "polykey_device_busy_fraction",
+     "Fraction of inter-dispatch wall time attributed to device "
+     "compute (cumulative: device-busy ms / dispatch-gap ms).",
+     "device_busy_fraction"),
+    ("hist", "polykey_request_device_ms",
+     "Per-request device time, ms: each block's device-busy window "
+     "(dispatch gap minus host stall) split across its live lanes.",
+     "device_ms_hist"),
     ("hist", "polykey_ttft_ms",
      "Time to first token (enqueue to first emit), ms.", "ttft_hist"),
     ("hist", "polykey_itl_ms",
@@ -119,21 +145,9 @@ _SPEC_FAMILIES: tuple = (
 )
 
 
-def _histogram_samples(name: str, labels: dict, hist) -> list[str]:
-    """One label-set's samples of a histogram family (header emitted
-    once by the caller — the text format forbids repeating it)."""
-    snap = hist.snapshot()
-    lines = []
-    for bound, cumulative in snap["buckets"]:
-        lines.append(render_sample(
-            f"{name}_bucket", {**labels, "le": f"{bound:g}"}, cumulative
-        ))
-    lines.append(render_sample(
-        f"{name}_bucket", {**labels, "le": "+Inf"}, snap["inf"]
-    ))
-    lines.append(render_sample(f"{name}_sum", labels, snap["sum"]))
-    lines.append(render_sample(f"{name}_count", labels, snap["count"]))
-    return lines
+# One label-set's samples of a histogram family (header emitted once by
+# the caller); exemplar rendering lives in the shared prometheus helper.
+_histogram_samples = render_histogram_samples
 
 
 def _pool_lines(pool, members: list) -> list[str]:
@@ -247,21 +261,131 @@ def engine_collector(engine_or_provider):
     return collect
 
 
+class DebugSurface:
+    """Read-only flight-deck endpoints (ISSUE 10), mounted on the
+    metrics HTTP server and gated by ``POLYKEY_DEBUG_ENDPOINTS=1``:
+
+    - ``/debug/engine``        — engine_stats snapshot as JSON
+    - ``/debug/timeline``      — Perfetto/Chrome-trace export of the
+      engine timeline (one process per replica for a pool)
+    - ``/debug/flight``        — flight-recorder span trees + events
+    - ``/debug/trace/<id>``    — one recorded span tree by trace id
+    - ``/debug/profile?seconds=N`` — blocking single-flight
+      jax.profiler capture; 409 while another capture runs
+
+    The gate is re-read per request (no enabled override), so an
+    operator can flip the env on a live process without a restart being
+    required for the "disabled ⇒ 404" contract to hold. Everything here
+    is read-only except the profiler trigger, which writes only to its
+    own artifact directory.
+    """
+
+    def __init__(self, engine_provider=None, obs=None, profiler=None,
+                 enabled: Optional[bool] = None):
+        self.engine_provider = engine_provider
+        self.obs = obs
+        self.profiler = profiler
+        self.enabled = enabled          # None → read the env per request
+
+    def _enabled_now(self) -> bool:
+        if self.enabled is not None:
+            return self.enabled
+        return os.environ.get("POLYKEY_DEBUG_ENDPOINTS", "") == "1"
+
+    def _engine(self):
+        return self.engine_provider() if self.engine_provider else None
+
+    def handle(self, path: str, query: str) -> tuple[int, str, bytes]:
+        """Route one /debug request. Returns (status, content_type,
+        body); unknown paths and the disabled state are both 404 — a
+        gated-off surface must be indistinguishable from an absent one."""
+        if not self._enabled_now():
+            return 404, "text/plain", b"not found\n"
+        try:
+            return self._route(path, query)
+        except Exception as e:
+            # A debug endpoint must never take the metrics server down,
+            # and an opaque 500 defeats its whole purpose.
+            return 500, "text/plain", f"debug error: {e}\n".encode()
+
+    def _route(self, path: str, query: str) -> tuple[int, str, bytes]:
+        if path == "/debug/engine":
+            engine = self._engine()
+            if engine is None:
+                return 404, "text/plain", b"no engine wired\n"
+            return 200, "application/json", _json_bytes(engine.stats())
+        if path == "/debug/timeline":
+            engine = self._engine()
+            if engine is None:
+                return 404, "text/plain", b"no engine wired\n"
+            from .timeline import engine_timelines, to_perfetto
+
+            trace = to_perfetto(
+                engine_timelines(engine),
+                meta={"source": "polykey /debug/timeline"},
+            )
+            return 200, "application/json", _json_bytes(trace)
+        if path == "/debug/flight":
+            if self.obs is None:
+                return 404, "text/plain", b"no recorder wired\n"
+            return 200, "application/json", _json_bytes({
+                "traces": self.obs.recorder.traces(),
+                "events": self.obs.recorder.events(),
+            })
+        if path.startswith("/debug/trace/"):
+            if self.obs is None:
+                return 404, "text/plain", b"no recorder wired\n"
+            trace_id = path[len("/debug/trace/"):]
+            for trace in reversed(self.obs.recorder.traces()):
+                if trace.get("trace_id") == trace_id:
+                    return 200, "application/json", _json_bytes(trace)
+            return 404, "text/plain", b"trace not found (ring evicted?)\n"
+        if path == "/debug/profile":
+            if self.profiler is None:
+                return 404, "text/plain", b"no profiler wired\n"
+            from .profiler import ProfilerBusyError
+
+            try:
+                seconds = float(parse_qs(query).get("seconds", ["2"])[0])
+            except ValueError:
+                return 400, "text/plain", b"seconds must be a number\n"
+            try:
+                result = self.profiler.capture(seconds)
+            except ProfilerBusyError as e:
+                return 409, "text/plain", f"{e}\n".encode()
+            return 200, "application/json", _json_bytes(result)
+        return 404, "text/plain", b"unknown debug endpoint\n"
+
+
+def _json_bytes(obj) -> bytes:
+    return (json.dumps(obj, indent=1, default=str) + "\n").encode()
+
+
 class _Handler(BaseHTTPRequestHandler):
     registry: Registry = None  # set by MetricsHTTPServer subclassing
+    debug: Optional[DebugSurface] = None
 
     def do_GET(self):  # noqa: N802 (http.server API)
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
         if path == "/metrics":
+            # Content negotiation: only an explicit OpenMetrics Accept
+            # gets the exemplar rendering; everyone else keeps the
+            # byte-stable classic page.
+            openmetrics = "application/openmetrics-text" in (
+                self.headers.get("Accept") or ""
+            )
             try:
-                body = self.registry.render().encode()
+                body = self.registry.render(openmetrics=openmetrics).encode()
             except Exception as e:  # a broken collector must not 500 opaquely
                 self.send_response(500)
                 self.end_headers()
                 self.wfile.write(f"collector error: {e}\n".encode())
                 return
             self.send_response(200)
-            self.send_header("Content-Type", CONTENT_TYPE)
+            self.send_header(
+                "Content-Type",
+                CONTENT_TYPE_OPENMETRICS if openmetrics else CONTENT_TYPE,
+            )
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -270,6 +394,13 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Type", "text/plain")
             self.end_headers()
             self.wfile.write(b"ok\n")
+        elif path.startswith("/debug/") and self.debug is not None:
+            status, ctype, body = self.debug.handle(path, query)
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         else:
             self.send_response(404)
             self.end_headers()
@@ -281,11 +412,14 @@ class _Handler(BaseHTTPRequestHandler):
 
 class MetricsHTTPServer:
     """Daemon-thread exposition server. `port=0` binds an ephemeral port
-    (tests / smoke); `.port` reports the bound one."""
+    (tests / smoke); `.port` reports the bound one. Passing a
+    `DebugSurface` mounts the /debug flight-deck routes (still gated by
+    POLYKEY_DEBUG_ENDPOINTS at request time)."""
 
     def __init__(self, registry: Registry, host: str = "0.0.0.0",
-                 port: int = 9464):
-        handler = type("BoundHandler", (_Handler,), {"registry": registry})
+                 port: int = 9464, debug: Optional[DebugSurface] = None):
+        handler = type("BoundHandler", (_Handler,),
+                       {"registry": registry, "debug": debug})
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True
         self.port = self._httpd.server_address[1]

@@ -17,6 +17,7 @@ from __future__ import annotations
 import bisect
 import math
 import threading
+import time
 from typing import Optional, Sequence
 
 
@@ -64,12 +65,21 @@ class Histogram:
         self._counts = [0] * (len(self.bounds) + 1)   # [+Inf] is last
         self._sum = 0.0
         self._count = 0
+        # OpenMetrics exemplars (ISSUE 10): the most recent
+        # (value, trace_id, unix_ts) observed per bucket, so a p99
+        # bucket on a dashboard links to a concrete recorded trace.
+        # Lazily allocated on the first traced observation — histograms
+        # that never see a trace id (bench, soak) pay no memory.
+        self._exemplars: Optional[list] = None
         self._lock = threading.Lock()
 
-    def observe(self, value: float, count: int = 1) -> None:
+    def observe(self, value: float, count: int = 1,
+                trace_id: Optional[str] = None) -> None:
         """Record `count` observations of `value` in one locked update
         (the engine amortizes a decode block's inter-token gap over the
-        block's tokens this way)."""
+        block's tokens this way). A `trace_id` stamps the bucket's
+        exemplar — last writer wins, which is exactly the "give me ANY
+        recent request in this bucket" exemplar semantics."""
         if count <= 0 or value != value or value in (math.inf, -math.inf):
             return                      # NaN/Inf would poison the sum
         idx = bisect.bisect_left(self.bounds, value)
@@ -77,6 +87,16 @@ class Histogram:
             self._counts[idx] += count
             self._sum += value * count
             self._count += count
+            if trace_id is not None:
+                if self._exemplars is None:
+                    self._exemplars = [None] * (len(self.bounds) + 1)
+                self._exemplars[idx] = (value, trace_id, time.time())
+
+    def exemplars(self) -> Optional[list]:
+        """Per-bucket exemplars aligned with `bounds` (+Inf last), or
+        None when no traced observation was ever recorded."""
+        with self._lock:
+            return list(self._exemplars) if self._exemplars else None
 
     @property
     def count(self) -> int:

@@ -11,12 +11,52 @@ histograms.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from typing import Callable, Optional
 
 from .histogram import Histogram
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+# Negotiated via the Accept header (obs.exposition): the OpenMetrics
+# rendering is the classic page plus per-bucket exemplars carrying
+# trace_id and a terminating "# EOF" — the subset serving needs to link
+# a p99 bucket to its recorded span tree. Scrapers that don't ask for
+# it get the byte-stable classic page.
+CONTENT_TYPE_OPENMETRICS = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+)
+
+# Render-mode flag (thread-local): set by Registry.render for the
+# duration of one page render, read by the histogram sample renderers —
+# collectors are plain zero-arg callables, so the mode can't ride an
+# argument without breaking every registered collector's signature.
+_render_local = threading.local()
+
+
+def openmetrics_active() -> bool:
+    return getattr(_render_local, "openmetrics", False)
+
+
+@contextlib.contextmanager
+def _render_mode(openmetrics: bool):
+    previous = getattr(_render_local, "openmetrics", False)
+    _render_local.openmetrics = openmetrics
+    try:
+        yield
+    finally:
+        _render_local.openmetrics = previous
+
+
+def render_exemplar_suffix(exemplar: Optional[tuple]) -> str:
+    """OpenMetrics exemplar tail for a bucket sample line:
+    `` # {trace_id="abc"} value unix_ts``. Empty string outside
+    OpenMetrics mode or without an exemplar."""
+    if exemplar is None or not openmetrics_active():
+        return ""
+    value, trace_id, ts = exemplar
+    return (f' # {{trace_id="{_escape_label(str(trace_id))}"}} '
+            f"{_fmt_value(value)} {ts:.3f}")
 
 
 def _fmt_value(v: float) -> str:
@@ -64,21 +104,33 @@ def render_gauge(name: str, help_text: str, value: float,
     ]
 
 
-def render_histogram(name: str, help_text: str, hist: Histogram,
-                     labels: Optional[dict] = None) -> list[str]:
-    labels = labels or {}
+def render_histogram_samples(name: str, labels: dict,
+                             hist: Histogram) -> list[str]:
+    """One label-set's sample lines for a histogram family (no header —
+    the text format forbids repeating it, so multi-label-set callers
+    emit it once themselves). In OpenMetrics render mode, bucket lines
+    carry trace_id exemplars. The ONE place exemplar bucket rendering
+    lives — the /metrics engine collector and Registry-owned histograms
+    both come through here."""
     snap = hist.snapshot()
-    lines = render_header(name, help_text, "histogram")
-    for bound, cumulative in snap["buckets"]:
+    exemplars = hist.exemplars() if openmetrics_active() else None
+    lines = []
+    for i, (bound, cumulative) in enumerate(snap["buckets"]):
         lines.append(render_sample(
             f"{name}_bucket", {**labels, "le": f"{bound:g}"}, cumulative
-        ))
+        ) + render_exemplar_suffix(exemplars[i] if exemplars else None))
     lines.append(render_sample(
         f"{name}_bucket", {**labels, "le": "+Inf"}, snap["inf"]
-    ))
+    ) + render_exemplar_suffix(exemplars[-1] if exemplars else None))
     lines.append(render_sample(f"{name}_sum", labels, snap["sum"]))
     lines.append(render_sample(f"{name}_count", labels, snap["count"]))
     return lines
+
+
+def render_histogram(name: str, help_text: str, hist: Histogram,
+                     labels: Optional[dict] = None) -> list[str]:
+    return render_header(name, help_text, "histogram") \
+        + render_histogram_samples(name, labels or {}, hist)
 
 
 class Counter:
@@ -241,13 +293,16 @@ class Registry:
         with self._lock:
             self._collectors.append(fn)
 
-    def render(self) -> str:
+    def render(self, openmetrics: bool = False) -> str:
         with self._lock:
             metrics = list(self._metrics)
             collectors = list(self._collectors)
         lines: list[str] = []
-        for metric in metrics:
-            lines.extend(metric.render())
-        for fn in collectors:
-            lines.extend(fn())
+        with _render_mode(openmetrics):
+            for metric in metrics:
+                lines.extend(metric.render())
+            for fn in collectors:
+                lines.extend(fn())
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"

@@ -154,17 +154,29 @@ class FlightRecorder:
     around them without any external collector running."""
 
     def __init__(self, capacity: int = 64, event_capacity: int = 256):
-        self._traces: deque = deque(maxlen=capacity)
-        self._events: deque = deque(maxlen=event_capacity)
+        # Memory discipline (ISSUE 10): a 0-capacity ring is DISABLED —
+        # no deque allocated, every append a no-op — so an obs-less
+        # deployment pays neither the rings nor the to_dict renders
+        # record() would otherwise do per request.
+        self._traces: Optional[deque] = (
+            deque(maxlen=capacity) if capacity > 0 else None
+        )
+        self._events: Optional[deque] = (
+            deque(maxlen=event_capacity) if event_capacity > 0 else None
+        )
         self._lock = threading.Lock()
 
     def record(self, span: Span) -> None:
+        if self._traces is None:
+            return
         # Store the rendered dict, not the live Span: entries are frozen
         # at record time and safe to hand out without locking the tree.
         with self._lock:
             self._traces.append(span.to_dict())
 
     def event(self, kind: str, **attrs) -> None:
+        if self._events is None:
+            return
         entry = {"kind": kind, "monotonic": time.monotonic(),
                  "time": time.time(), **attrs}
         with self._lock:
@@ -173,17 +185,15 @@ class FlightRecorder:
     def last(
         self, pred: Optional[Callable[[dict], bool]] = None
     ) -> Optional[dict]:
-        with self._lock:
-            traces = list(self._traces)
-        for trace in reversed(traces):
+        for trace in reversed(self.traces()):
             if pred is None or pred(trace):
                 return trace
         return None
 
     def traces(self) -> list[dict]:
         with self._lock:
-            return list(self._traces)
+            return list(self._traces) if self._traces is not None else []
 
     def events(self) -> list[dict]:
         with self._lock:
-            return list(self._events)
+            return list(self._events) if self._events is not None else []

@@ -1,17 +1,31 @@
 #!/usr/bin/env python3
-"""Exposition-endpoint smoke test (``make metrics-smoke``).
+"""Observability smoke test (``make obs-smoke`` — grown from the PR 1
+``metrics-smoke`` probe).
 
 Boots the full serving stack on CPU with a tiny model — gRPC gateway,
-TPU-service backend, observability bundle, Prometheus HTTP endpoint —
-runs a streaming generation, scrapes /metrics DURING and after it, and
-asserts the required metric families are present and well-formed. This
-is the ISSUE 1 acceptance probe in script form: exit 0 means an operator
-pointing a Prometheus scrape-config at the gateway will see data.
+TPU-service backend, observability bundle, Prometheus HTTP endpoint with
+the flight-deck debug surface — runs streaming generations, and asserts:
+
+- the required metric families (PR 1/3/4/6/9 + the ISSUE 10 attribution
+  families) on /metrics and the gRPC metrics_text view;
+- OpenMetrics content negotiation with parsable trace_id exemplars on
+  the latency histograms;
+- the /debug endpoints serve ONLY under POLYKEY_DEBUG_ENDPOINTS=1 —
+  engine stats, a structurally valid Perfetto timeline, the flight
+  recorder, trace-by-id round-trip — including against a 2-replica pool
+  (one Perfetto process per replica);
+- a profiler capture round-trip on CPU: non-empty artifact dir, and the
+  single-flight guarantee (a second concurrent capture is 409).
+
+Exit 0 means an operator gets the full flight deck, not just a page.
 """
 
+import json
 import os
+import re
 import sys
 import threading
+import urllib.error
 import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -60,6 +74,17 @@ REQUIRED_FAMILIES = (
     "polykey_dispatch_inflight",
     "polykey_dispatch_lookahead_depth",
     "polykey_host_stall_ms_bucket",
+    # Device-time attribution (ISSUE 10): the per-request device-ms
+    # histogram and the device-busy fraction gauge.
+    "polykey_request_device_ms_bucket",
+    "polykey_device_busy_fraction",
+)
+
+# One exemplar line on the TTFT histogram, OpenMetrics syntax:
+#   name_bucket{le="..."} N # {trace_id="..."} value timestamp
+EXEMPLAR_RE = re.compile(
+    r'polykey_ttft_ms_bucket\{le="[^"]+"\} \d+ '
+    r'# \{trace_id="[A-Za-z0-9_-]{1,64}"\} \d+(\.\d+)? \d+\.\d{3}'
 )
 
 CONFIG = EngineConfig(
@@ -95,6 +120,123 @@ def scrape(port: int) -> str:
         return resp.read().decode()
 
 
+def fetch(port: int, path: str, accept: str = "") -> tuple:
+    """GET on the metrics server; returns (status, content_type, body)
+    without raising on 4xx (the gating checks EXPECT 404/409)."""
+    request = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        headers={"Accept": accept} if accept else {},
+    )
+    try:
+        with urllib.request.urlopen(request, timeout=90) as resp:
+            return (resp.status, resp.headers.get("Content-Type", ""),
+                    resp.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers.get("Content-Type", ""), e.read().decode()
+
+
+def _debug_surface(service, obs):
+    from polykey_tpu.obs import DebugSurface
+
+    return DebugSurface(
+        engine_provider=lambda: service.engine, obs=obs,
+        profiler=service.profiler,
+    )
+
+
+def exemplar_checks(port: int) -> list:
+    """OpenMetrics negotiation + exemplar syntax on the TTFT family."""
+    failures = []
+    status, ctype, body = fetch(
+        port, "/metrics", accept="application/openmetrics-text"
+    )
+    if status != 200 or "application/openmetrics-text" not in ctype:
+        failures.append(f"openmetrics scrape: {status} {ctype}")
+        return failures
+    if not body.rstrip().endswith("# EOF"):
+        failures.append("openmetrics page missing # EOF terminator")
+    if not EXEMPLAR_RE.search(body):
+        failures.append("no parsable trace_id exemplar on polykey_ttft_ms")
+    if "trace_id" in scrape(port):
+        failures.append("classic text page leaked exemplars")
+    return failures
+
+
+def debug_checks(port: int, trace_id: str, expect_pids: int = 1) -> list:
+    """The /debug surface: gating, engine stats, a structurally valid
+    Perfetto timeline, flight recorder, trace-by-id."""
+    failures = []
+    os.environ.pop("POLYKEY_DEBUG_ENDPOINTS", None)
+    status, _, _ = fetch(port, "/debug/engine")
+    if status != 404:
+        failures.append(f"/debug/engine served while gated off: {status}")
+    os.environ["POLYKEY_DEBUG_ENDPOINTS"] = "1"
+
+    status, ctype, body = fetch(port, "/debug/engine")
+    if status != 200 or "json" not in ctype:
+        failures.append(f"/debug/engine: {status} {ctype}")
+    elif "slots_total" not in json.loads(body):
+        failures.append("/debug/engine missing slots_total")
+
+    status, _, body = fetch(port, "/debug/timeline")
+    if status != 200:
+        failures.append(f"/debug/timeline: {status}")
+    else:
+        trace = json.loads(body)
+        events = trace.get("traceEvents", [])
+        pids = {e.get("pid") for e in events}
+        tracks = {e["args"]["name"] for e in events
+                  if e.get("ph") == "M" and e.get("name") == "thread_name"}
+        if len(pids) < expect_pids:
+            failures.append(
+                f"/debug/timeline has {len(pids)} processes, "
+                f"expected >= {expect_pids}"
+            )
+        for track in ("dispatch frontier", "processed frontier"):
+            if track not in tracks:
+                failures.append(f"/debug/timeline missing track: {track}")
+        if not any(e.get("ph") == "X" for e in events):
+            failures.append("/debug/timeline has no slices")
+
+    status, _, body = fetch(port, "/debug/flight")
+    if status != 200 or not json.loads(body).get("traces"):
+        failures.append(f"/debug/flight empty or failing: {status}")
+
+    status, _, body = fetch(port, f"/debug/trace/{trace_id}")
+    if status != 200 or json.loads(body).get("trace_id") != trace_id:
+        failures.append(f"/debug/trace/{trace_id}: {status}")
+    status, _, _ = fetch(port, "/debug/trace/no-such-trace")
+    if status != 404:
+        failures.append(f"unknown trace id returned {status}, wanted 404")
+    return failures
+
+
+def profiler_checks(port: int, stub, pk_mod) -> list:
+    """Profiler round-trip on CPU + the single-flight guarantee across
+    the two trigger surfaces (gRPC tool and HTTP endpoint)."""
+    failures = []
+    start = pk_mod.ExecuteToolRequest(tool_name="engine_profile")
+    start.parameters.update({"action": "start"})
+    stub.ExecuteTool(start, timeout=30)
+    status, _, body = fetch(port, "/debug/profile?seconds=1")
+    if status != 409:
+        failures.append(
+            f"concurrent capture got {status}, wanted 409 (single-flight)"
+        )
+    stop = pk_mod.ExecuteToolRequest(tool_name="engine_profile")
+    stop.parameters.update({"action": "stop"})
+    stub.ExecuteTool(stop, timeout=30)
+
+    status, _, body = fetch(port, "/debug/profile?seconds=1")
+    if status != 200:
+        failures.append(f"/debug/profile capture failed: {status} {body}")
+    else:
+        result = json.loads(body)
+        if result.get("files", 0) < 1:
+            failures.append(f"profiler capture artifact dir empty: {result}")
+    return failures
+
+
 def pool_smoke() -> list:
     """Replica-tier exposition (ISSUE 9): boot a 2-replica pool behind
     the same gateway wiring, drive both replicas (two concurrent
@@ -116,7 +258,8 @@ def pool_smoke() -> list:
         service, logger, address="127.0.0.1:0", obs=obs
     )
     server.start()
-    metrics = MetricsHTTPServer(obs.registry, host="127.0.0.1", port=0)
+    metrics = MetricsHTTPServer(obs.registry, host="127.0.0.1", port=0,
+                                debug=_debug_surface(service, obs))
     metrics.start()
 
     failures: list[str] = []
@@ -168,11 +311,29 @@ def pool_smoke() -> list:
                 failures.append(
                     "router never load-balanced: a replica served nothing"
                 )
+
+        # Debug surface against the pool: the Perfetto export must carry
+        # one process per replica, each with its own frontier tracks.
+        os.environ["POLYKEY_DEBUG_ENDPOINTS"] = "1"
+        status, _, body = fetch(metrics.port, "/debug/timeline")
+        if status != 200:
+            failures.append(f"pool /debug/timeline: {status}")
+        else:
+            events = json.loads(body).get("traceEvents", [])
+            pids = {e.get("pid") for e in events}
+            if len(pids) < 2:
+                failures.append(
+                    f"pool timeline has {len(pids)} processes, wanted 2"
+                )
+        status, _, body = fetch(metrics.port, "/debug/engine")
+        if status != 200 or json.loads(body).get("replicas_total") != 2:
+            failures.append(f"pool /debug/engine: {status}")
         channel.close()
     finally:
         metrics.stop()
         server.stop(grace=None)
         service.close()
+        os.environ.pop("POLYKEY_DEBUG_ENDPOINTS", None)
     return failures
 
 
@@ -188,10 +349,12 @@ def main() -> int:
         service, logger, address="127.0.0.1:0", obs=obs
     )
     server.start()
-    metrics = MetricsHTTPServer(obs.registry, host="127.0.0.1", port=0)
+    metrics = MetricsHTTPServer(obs.registry, host="127.0.0.1", port=0,
+                                debug=_debug_surface(service, obs))
     metrics.start()
     print(f"gateway :{port}  metrics :{metrics.port}/metrics", flush=True)
 
+    trace_id = "obs-smoke-trace-1"
     failures: list[str] = []
     try:
         channel = grpc.insecure_channel(f"127.0.0.1:{port}")
@@ -204,7 +367,10 @@ def main() -> int:
         mid_stream_page = {}
 
         def generate():
-            chunks = list(stub.ExecuteToolStream(request, timeout=120))
+            chunks = list(stub.ExecuteToolStream(
+                request, timeout=120,
+                metadata=(("x-trace-id", trace_id),),
+            ))
             assert chunks[-1].final
 
         gen = threading.Thread(target=generate)
@@ -250,22 +416,30 @@ def main() -> int:
             for phase in ("queue_wait", "prefill", "decode", "detokenize"):
                 if phase not in names:
                     failures.append(f"last_trace missing {phase} span")
+
+        # ISSUE 10 surfaces: exemplars, debug endpoints, profiler.
+        failures += exemplar_checks(metrics.port)
+        failures += debug_checks(metrics.port, trace_id)
+        failures += profiler_checks(metrics.port, stub, pk)
         channel.close()
     finally:
         metrics.stop()
         server.stop(grace=None)
         service.close()
+        os.environ.pop("POLYKEY_DEBUG_ENDPOINTS", None)
 
     failures += pool_smoke()
 
     if failures:
-        print("metrics-smoke FAILED:")
+        print("obs-smoke FAILED:")
         for failure in failures:
             print(f"  - {failure}")
         return 1
-    print(f"metrics-smoke OK: {len(REQUIRED_FAMILIES)} families present, "
-          f"span tree complete, {len(POOL_FAMILIES)} replica-pool "
-          "families present, engine_stats aggregates across replicas")
+    print(f"obs-smoke OK: {len(REQUIRED_FAMILIES)} families present, "
+          "span tree complete, exemplars parse, debug surface gated + "
+          "serving, profiler single-flight round-trip, "
+          f"{len(POOL_FAMILIES)} replica-pool families present, "
+          "engine_stats aggregates across replicas")
     return 0
 
 

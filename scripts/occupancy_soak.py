@@ -112,6 +112,11 @@ def main() -> int:
                     help="exit 1 when measured avg_lanes/slots is below")
     ap.add_argument("--seed", type=int, default=29)
     ap.add_argument("--out", default="")
+    ap.add_argument("--timeline", default="",
+                    help="also export the engine's flight-deck timeline "
+                         "as Perfetto JSON to this path (ISSUE 10: the "
+                         "committed perf/timeline_*.json artifacts — "
+                         "open at https://ui.perfetto.dev)")
     args = ap.parse_args()
 
     rng = np.random.default_rng(args.seed)
@@ -284,6 +289,15 @@ def main() -> int:
                       - snap0["blocks_processed"]), 2),
             "host_stall_ms_p50": stats1.get("host_stall_ms_p50"),
             "lookahead_depth": stats1["lookahead_depth"],
+            # Device-time attribution over the same window (ISSUE 10):
+            # the device-busy share of inter-dispatch wall time — the
+            # soak-side twin of bench's overlap_ratio, from the recorded
+            # schedule rather than a separate probe.
+            "device_busy_fraction": round(
+                (snap1["device_busy_ms_total"]
+                 - snap0["device_busy_ms_total"])
+                / max(1e-9, snap1["dispatch_gap_ms_total"]
+                      - snap0["dispatch_gap_ms_total"]), 4),
             "tok_s": round(tokens / window_s, 1) if window_s else None,
             "interleave_max_tokens": stats1["interleave_max_tokens"],
             # Lifetime TTFT percentiles (incl. ramp — queue wait under
@@ -305,6 +319,26 @@ def main() -> int:
             f.write("\n")
         log(f"wrote {out_path}")
         print(json.dumps(result))
+
+        if args.timeline and engine.timeline is not None:
+            from polykey_tpu.obs.timeline import engine_timelines, to_perfetto
+
+            trace = to_perfetto(
+                engine_timelines(engine),
+                meta={
+                    "source": "occupancy_soak",
+                    "slots": args.slots,
+                    "lookahead_depth": stats1["lookahead_depth"],
+                    "occupancy": result["occupancy"],
+                    "device_busy_fraction": result["device_busy_fraction"],
+                    "measured_at": result["measured_at"],
+                },
+            )
+            with open(args.timeline, "w") as f:
+                json.dump(trace, f, indent=1)
+                f.write("\n")
+            log(f"wrote timeline {args.timeline} "
+                f"({len(trace['traceEvents'])} events)")
 
         if result["failed_in_window"]:
             log(f"FAIL: {result['failed_in_window']} requests errored "

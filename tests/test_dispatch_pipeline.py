@@ -8,8 +8,8 @@ latency rides under the device's compute instead of serializing with it
 synchronous). These tests pin that overlap on CPU so it cannot silently
 regress before the next hardware window:
 
-- the engine's pipeline flight recorder (`_pipe_events`, a bounded ring
-  of ("dispatch", seq) / ("process", seq, lookahead, queued) tuples)
+- the engine's flight-deck timeline (`engine.timeline`, the ISSUE 10
+  TimelineRecorder that replaced the ad-hoc `_pipe_events` ring)
   must show dispatch N+1 happening-before process N under steady decode
   at depth 2, and EXACT dispatch-then-read synchrony at depth 1;
 - greedy outputs must be bit-identical between depths (the pipeline is
@@ -86,7 +86,18 @@ def _run_greedy_burst(engine, n: int = 3, max_new: int = 24):
 
 
 def _events(engine) -> list[tuple]:
-    return list(engine._pipe_events)
+    """Legacy-shaped view of the timeline ring: ("dispatch", seq) and
+    ("process", seq, lookahead, queued_after) tuples in record order —
+    the happens-before assertions below predate the typed recorder and
+    read event ORDER, which the promotion preserved."""
+    out = []
+    for event in engine.timeline.events():
+        if event["kind"] == "dispatch":
+            out.append(("dispatch", event["seq"]))
+        elif event["kind"] == "process":
+            out.append(("process", event["seq"], event["lookahead"],
+                        event["queued_after"]))
+    return out
 
 
 def _drained(engine, timeout: float = 10.0) -> bool:

@@ -258,3 +258,67 @@ def test_metrics_http_server_serves_registry():
             )
     finally:
         srv.stop()
+
+
+# -- OpenMetrics exemplars (ISSUE 10) -----------------------------------------
+
+
+def test_histogram_exemplars_track_last_traced_observation():
+    h = Histogram(bounds=(10, 100, 1000))
+    h.observe(5.0)                                  # untraced: no exemplar
+    assert h.exemplars() is None
+    h.observe(7.0, trace_id="early")
+    h.observe(9.0, trace_id="late")                 # same bucket: last wins
+    h.observe(500.0, trace_id="slow")
+    h.observe(5000.0, trace_id="overflow")          # lands in +Inf
+    exemplars = h.exemplars()
+    assert exemplars[0][0:2] == (9.0, "late")
+    assert exemplars[2][0:2] == (500.0, "slow")
+    assert exemplars[3][0:2] == (5000.0, "overflow")
+    assert exemplars[1] is None
+
+
+def test_registry_renders_exemplars_only_in_openmetrics_mode():
+    reg = Registry()
+    h = Histogram(bounds=(10, 100))
+    h.observe(7.0, trace_id="abc123")
+    reg.histogram("polykey_test_ms", "Test latencies.", hist=h)
+
+    classic = reg.render()
+    assert "# EOF" not in classic
+    assert "trace_id" not in classic                # byte-stable page
+
+    om = reg.render(openmetrics=True)
+    assert om.rstrip().endswith("# EOF")
+    match = re.search(
+        r'polykey_test_ms_bucket\{le="10"\} 1 '
+        r'# \{trace_id="abc123"\} 7 \d+\.\d{3}',
+        om,
+    )
+    assert match, om
+
+
+def test_http_exposition_negotiates_openmetrics():
+    obs = Observability()
+    h = Histogram(bounds=(10, 100))
+    h.observe(3.0, trace_id="negotiate1")
+    obs.registry.histogram("polykey_test_ms", "Test latencies.", hist=h)
+    srv = MetricsHTTPServer(obs.registry, host="127.0.0.1", port=0).start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/metrics"
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            classic = resp.read().decode()
+            assert "text/plain" in resp.headers["Content-Type"]
+        assert "trace_id" not in classic
+
+        request = urllib.request.Request(
+            url, headers={"Accept": "application/openmetrics-text"}
+        )
+        with urllib.request.urlopen(request, timeout=5) as resp:
+            om = resp.read().decode()
+            assert "application/openmetrics-text" in \
+                resp.headers["Content-Type"]
+        assert om.rstrip().endswith("# EOF")
+        assert 'trace_id="negotiate1"' in om
+    finally:
+        srv.stop()

@@ -494,6 +494,66 @@ def test_grpc_pool_stream_carries_replica_and_restarted_trailers():
         service.close()
 
 
+def test_failover_keeps_trace_id_and_records_resume_span():
+    """Trace-id continuity across failover (ISSUE 10): a re-routed,
+    resumed stream keeps its ORIGINAL x-trace-id on the new replica —
+    echoed in the trailers of the same RPC — and the recorded span tree
+    carries an explicit `resume` child under the root naming both
+    replicas, so the failover is readable from the flight recorder."""
+    logger = Logger(stream=io.StringIO())
+    obs = Observability()
+    pool = _pool()
+    service = TpuService.create(pool, logger=logger, obs=obs)
+    server, _, port = gateway_server.build_server(
+        service, logger, address="127.0.0.1:0", obs=obs
+    )
+    server.start()
+    trace_id = "failover-trace-0001"
+    try:
+        with grpc.insecure_channel(f"127.0.0.1:{port}") as channel:
+            grpc.channel_ready_future(channel).result(timeout=10)
+            stub = PolykeyServiceStub(channel)
+
+            _arm_live(
+                pool, 0, "slow-step=0.05:replica=0,step-stall=1.0@1:replica=0"
+            )
+            request = pk.ExecuteToolRequest(tool_name="llm_generate")
+            request.parameters.update(
+                {"prompt": "trace continuity run", "max_tokens": 12}
+            )
+            call = stub.ExecuteToolStream(
+                request, timeout=120, metadata=(("x-trace-id", trace_id),)
+            )
+            chunks = list(call)
+            assert chunks[-1].final
+            trailers = dict(call.trailing_metadata() or ())
+            assert trailers.get("restarted") == "1", trailers
+            assert trailers.get("replica") == "1"
+            # The client's trace id survived the replica move.
+            assert trailers.get("x-trace-id") == trace_id
+
+            recorded = [
+                t for t in obs.recorder.traces()
+                if t.get("trace_id") == trace_id
+            ]
+            assert recorded, "resumed stream's span tree was not recorded"
+            tree = recorded[-1]
+            children = {c["name"]: c for c in tree.get("children", ())}
+            assert "resume" in children, sorted(children)
+            resume = children["resume"]
+            assert resume["trace_id"] == trace_id
+            assert resume["attrs"]["from_replica"] == 0
+            assert resume["attrs"]["to_replica"] == 1
+            # Decode work continued under the SAME root after the move.
+            assert "decode" in children
+            # Attribution followed the stream across replicas: the root
+            # carries accumulated device_ms spanning both attempts.
+            assert tree.get("attrs", {}).get("device_ms", 0) > 0
+    finally:
+        server.stop(grace=None)
+        service.close()
+
+
 def test_received_tokens_suppresses_prefix():
     # Server-side resume contract: received_tokens=k replays the greedy
     # generation and emits only the suffix — the client-resume path
