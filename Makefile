@@ -10,7 +10,8 @@ BUILD_DIR := build
 .PHONY: help run run-client test test-models native protos clean bench dryrun \
 	kernel-check tunnel-probe bench-tokenizer tpu-watch metrics-smoke \
 	obs-smoke chaos-smoke print-chaos occupancy-smoke occupancy-soak \
-	failover-smoke failover-soak timeline-capture
+	failover-smoke failover-soak timeline-capture perf-gate \
+	perf-gate-reference flightwatch
 
 help: ## Show available targets
 	@grep -E '^[a-zA-Z_-]+:.*?## .*$$' $(MAKEFILE_LIST) | \
@@ -55,6 +56,23 @@ obs-smoke: ## Boot the stack on CPU; assert families, exemplars, debug endpoints
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/obs_smoke.py
 
 metrics-smoke: obs-smoke ## Legacy alias for obs-smoke
+
+# Perf-regression sentinel (ISSUE 11): deterministic CPU soak compared
+# against the committed perf/slo_reference.json with explicit noise
+# tolerances — the first automated perf-trajectory gate. Regenerate the
+# reference (and commit it) after an INTENTIONAL perf change with
+# `make perf-gate-reference`.
+perf-gate: ## Deterministic CPU soak gated against perf/slo_reference.json
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/perf_gate.py
+
+perf-gate-reference: ## Regenerate perf/slo_reference.json from this machine
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/perf_gate.py --write-reference
+
+# Operator triage console (ISSUE 11): top-style live view over /metrics
+# + /debug/slo (set POLYKEY_DEBUG_ENDPOINTS=1 on the server for the
+# windowed + SLO sections). PORT=9464 by default.
+flightwatch: ## Live console over a running server's /metrics + /debug/slo
+	$(PYTHON) scripts/flightwatch.py $(if $(PORT),--port $(PORT),)
 
 # Flight-deck timeline capture (ISSUE 10): a short CPU occupancy soak
 # exporting the engine timeline as Perfetto JSON. The committed
@@ -190,13 +208,14 @@ scan: ## Security scan (Trivy fs over the tree + lockfile, CRITICAL/HIGH gate)
 	  --scanners vuln,secret \
 	  --severity CRITICAL,HIGH
 
-ci-check: ## Run the CI pipeline locally: lint+polylint+graphlint, chaos, failover, occupancy, obs, tests, native(+asan), scan
+ci-check: ## Run the CI pipeline locally: lint+polylint+graphlint, chaos, failover, occupancy, obs, perf-gate, tests, native(+asan), scan
 	@$(MAKE) lint
 	@$(MAKE) graphlint
 	@$(MAKE) chaos-smoke
 	@$(MAKE) failover-smoke
 	@$(MAKE) occupancy-smoke
 	@$(MAKE) obs-smoke
+	@$(MAKE) perf-gate
 	@$(MAKE) test
 	@$(MAKE) native
 	@$(MAKE) native-asan

@@ -188,6 +188,29 @@ class EngineConfig:
     # obs-less deployment pays nothing. POLYKEY_TIMELINE_CAPACITY.
     timeline_capacity: int = 4096
 
+    # SLO signal plane (ISSUE 11, obs/signals.py): seconds between ring
+    # samples of the metrics registry — monotone counters become
+    # windowed rates, cumulative histograms become delta-quantiles over
+    # 1m/5m/1h windows (POLYKEY_SIGNALS_WINDOWS), fixing the "p95 since
+    # boot" staleness and feeding burn-rate SLO evaluation
+    # (POLYKEY_SLO). Sampling rides engine-loop block boundaries with
+    # the idle tick as the low-rate fallback; the read side also
+    # samples, so windows advance even when the loop is wedged. 0
+    # DISABLES the plane entirely: no ring allocated,
+    # `metrics.signals is None`, one `is None` branch in the loop — the
+    # timeline_capacity=0 discipline. POLYKEY_SIGNALS_INTERVAL.
+    signals_interval_s: float = 5.0
+    # Window widths (comma-separated seconds, "" → the env /
+    # 60,300,3600 defaults) and the SLO policy spec (inline JSON,
+    # "@/path.json", or "default"; "" → POLYKEY_SLO). Carried on the
+    # config so programmatic constructions (perf_gate, tests, embedded
+    # engines) control them without mutating os.environ, and so a
+    # supervised restart rebuilds the plane from the SAME spec the
+    # original engine ran — engines built with the empty defaults fall
+    # back to the env at construction time.
+    signals_windows: str = ""
+    slo_policy: str = ""
+
     # Parallelism axes (parallel/mesh.py); 1 → axis unused. ep shards MoE
     # expert weights and rides token dispatch over the ep axis (Mixtral —
     # BASELINE.md measurement config 4); it requires an MoE model. sp
@@ -348,6 +371,17 @@ class EngineConfig:
             timeline_capacity=_env_int(
                 "POLYKEY_TIMELINE_CAPACITY", cls.timeline_capacity
             ),
+            signals_interval_s=_env_float(
+                "POLYKEY_SIGNALS_INTERVAL", cls.signals_interval_s
+            ),
+            # Captured as raw strings at from_env time so the config —
+            # and therefore every supervised-restart factory replay —
+            # pins the windows/policy the server booted with even if
+            # the process env mutates later.
+            signals_windows=os.environ.get(
+                "POLYKEY_SIGNALS_WINDOWS", cls.signals_windows
+            ),
+            slo_policy=os.environ.get("POLYKEY_SLO", cls.slo_policy),
             tp=_env_int("POLYKEY_TP", cls.tp),
             dp=_env_int("POLYKEY_DP", cls.dp),
             ep=_env_int("POLYKEY_EP", cls.ep),
@@ -426,6 +460,10 @@ class EngineConfig:
         if self.timeline_capacity < 0:
             raise ValueError(
                 "timeline_capacity must be >= 0 (0 disables the ring)"
+            )
+        if self.signals_interval_s < 0:
+            raise ValueError(
+                "signals_interval_s must be >= 0 (0 disables the plane)"
             )
         if self.quantize_bits not in (4, 8):
             raise ValueError("quantize_bits must be 4 or 8")

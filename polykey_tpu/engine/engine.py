@@ -748,6 +748,39 @@ class InferenceEngine:
             TimelineRecorder(config.timeline_capacity)
             if config.timeline_capacity > 0 else None
         )
+        # SLO signal plane (ISSUE 11): windowed rates/delta-quantiles
+        # over a ring of metrics snapshots, plus burn-rate evaluation of
+        # the declarative POLYKEY_SLO objectives. Attached to the
+        # METRICS object so the supervisor's adoption path carries the
+        # windows and budget state across restarts; the supervisor
+        # rebinds `timeline` to the fresh ring. signals_interval_s=0
+        # allocates nothing (`metrics.signals is None`) and the loop
+        # emission site below is one `is None` branch.
+        if config.signals_interval_s > 0 and self.metrics.signals is None:
+            from ..obs.signals import (
+                ENV_POLICY,
+                ENV_WINDOWS,
+                SignalPlane,
+                SloPolicy,
+                windows_from_spec,
+            )
+
+            # Config-first, env-fallback: an EngineConfig.from_env
+            # carries the boot-time specs (restart-stable); a
+            # programmatic config controls them without touching
+            # os.environ; the empty defaults read the env here.
+            self.metrics.signals = SignalPlane(
+                self.metrics,
+                windows=windows_from_spec(
+                    config.signals_windows
+                    or os.environ.get(ENV_WINDOWS, "")
+                ),
+                interval_s=config.signals_interval_s,
+                policy=SloPolicy.from_spec(
+                    config.slo_policy or os.environ.get(ENV_POLICY, "")
+                ),
+                timeline=self.timeline,
+            )
         self._dispatch_seq = 0
         # In-flight target for the CURRENT block size: when the adaptive
         # dispatcher shrinks K, the LOOKAHEAD portion deepens by the
@@ -917,6 +950,12 @@ class InferenceEngine:
             snap["occupancy"] = round(
                 snap["avg_lanes"] / max(1, self.config.max_decode_slots), 4
             )
+        signals = self.metrics.signals
+        if signals is not None:
+            # Windowed quantiles alongside the lifetime ones (ISSUE 11
+            # satellite): ttft_ms_p95_5m etc. reflect the last minutes,
+            # not the whole uptime — the staleness fix operators read.
+            snap.update(signals.stats_fields())
         if self._spec:
             snap["spec_gamma"] = self._gamma   # live dial value
         if self._prefix is not None:
@@ -1061,6 +1100,13 @@ class InferenceEngine:
                     self._process_step(self._inflight_q.popleft())
                     worked = True
                 _acc("process", t0)
+                # SLO signal plane (ISSUE 11): ring sample at block
+                # boundaries — idle iterations reach here too at ~20 Hz
+                # (the low-rate fallback timer). Time-gated inside to
+                # signals_interval_s; one `is None` branch when off.
+                signals = self.metrics.signals
+                if signals is not None:
+                    signals.maybe_sample()
                 if worked:
                     self.last_progress = time.monotonic()
                 else:

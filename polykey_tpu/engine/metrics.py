@@ -152,6 +152,13 @@ class EngineMetrics:
         # steady state" dial, from the recorded schedule.
         self.device_busy_ms_total = 0.0
         self.device_ms_hist = Histogram()
+        # SLO signal plane (ISSUE 11): attached by the engine when
+        # signals are enabled (obs.signals.SignalPlane), None otherwise.
+        # It lives HERE — not on the engine — because the supervisor's
+        # metrics-adoption path already carries this object to the fresh
+        # engine on restart, which is exactly the continuity the
+        # windowed ring and the SLO budget accounting need.
+        self.signals = None
 
     def on_process_block(self, lookahead: int,
                          stall_ms: Optional[float],
@@ -226,6 +233,39 @@ class EngineMetrics:
             )
         self.lanes_hist.observe(float(lanes))
         return counted_gap
+
+    def counter_sample(self) -> dict:
+        """Every monotone counter in ONE locked read — the signal
+        plane's ring entry (obs.signals). Raw values only: rates,
+        availability, and delta-quantiles are computed read-side from
+        two samples, so this stays cheap enough for a 5 s cadence (and
+        a 50 ms test cadence) on the engine thread."""
+        with self._lock:
+            return {
+                "requests_admitted": self.requests_admitted,
+                "requests_completed": self.requests_completed,
+                "requests_failed": self.requests_failed,
+                "requests_shed": self.requests_shed,
+                "deadline_expired_queued": self.deadline_expired["queued"],
+                "deadline_expired_prefill": self.deadline_expired["prefill"],
+                "deadline_expired_decode": self.deadline_expired["decode"],
+                "tokens_generated": self.tokens_generated,
+                "decode_steps": self.decode_steps,
+                "blocks_dispatched": self.blocks_dispatched,
+                "lanes_dispatched": self.lanes_dispatched,
+                "lane_steps": self.lane_steps,
+                "steps_dispatched": self.steps_dispatched,
+                "prefill_tokens_total": self.prefill_tokens_total,
+                "blocks_processed": self.blocks_processed,
+                "blocks_synced": self.blocks_synced,
+                "lookahead_sum": self.lookahead_sum,
+                "host_stall_ms_total": self.host_stall_ms_total,
+                "dispatch_gap_ms_total": self.dispatch_gap_ms_total,
+                "dispatch_gaps": self.dispatch_gaps,
+                "device_busy_ms_total": self.device_busy_ms_total,
+                "drafts_accepted": self.drafts_accepted,
+                "drafts_proposed": self.drafts_proposed,
+            }
 
     def lanes_snapshot(self) -> dict:
         """Occupancy counters alone — cheap enough for harnesses to poll

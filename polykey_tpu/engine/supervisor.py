@@ -186,6 +186,18 @@ class EngineSupervisor:
             # and the double-counted failures must not pollute the live
             # engine's counters — a counter reset is the lesser evil.
             fresh.metrics = old.metrics
+        # Signal-plane continuity (ISSUE 11): the plane rides the
+        # adopted metrics object, so its window ring and SLO budget
+        # state survive the swap — but its timeline binding points at
+        # the DEAD engine's ring. Rebind to the fresh engine's so
+        # breach/recovery notes land where to_perfetto exports from.
+        # (On the wedged path fresh.metrics is a new object whose plane
+        # was freshly built against the fresh timeline — nothing to do.)
+        signals = getattr(fresh.metrics, "signals", None)
+        if signals is not None:
+            signals.timeline = getattr(fresh, "timeline", None)
+            if signals.recorder is None:
+                signals.recorder = self.recorder
         self.restarts += 1
         self.engine = fresh
         for callback in self._listeners:

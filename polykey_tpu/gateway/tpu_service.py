@@ -85,6 +85,12 @@ class TpuService(Service):
             recorder=obs.recorder if obs is not None else None
         )
         if obs is not None:
+            # SLO breach events reach the flight recorder (ISSUE 11):
+            # every replica's signal plane gets the shared recorder so
+            # breaches sit next to watchdog trips in /debug/flight.
+            from ..obs.signals import bind_recorder
+
+            bind_recorder(engine, obs.recorder)
             # Bind the engine into the scrape registry. A registry holds
             # ONE engine's families (the names carry no engine label):
             # first service to register wins, later services sharing the

@@ -26,6 +26,12 @@ from .prometheus import (
     render_gauge,
     render_histogram,
 )
+from .signals import (
+    SignalPlane,
+    SloObjective,
+    SloPolicy,
+    signals_snapshot,
+)
 from .timeline import TimelineRecorder, engine_timelines, to_perfetto
 from .trace import (
     FlightRecorder,
@@ -60,7 +66,11 @@ __all__ = [
     "Observability",
     "ProfilerBusyError",
     "ProfilerCapture",
+    "SignalPlane",
+    "SloObjective",
+    "SloPolicy",
     "TimelineRecorder",
+    "signals_snapshot",
     "engine_collector",
     "engine_timelines",
     "Registry",

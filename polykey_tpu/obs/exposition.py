@@ -200,6 +200,65 @@ def _pool_lines(pool, members: list) -> list[str]:
     return lines
 
 
+def _slo_lines(members: list) -> list[str]:
+    """SLO signal-plane families (ISSUE 11), rendered from each
+    engine's cached last evaluation (`SignalPlane.slo_state()` — the
+    scrape never recomputes window math). Headers render whenever any
+    member carries a plane, so dashboards and the exposition-under-
+    churn gate see the families even before a policy is loaded; samples
+    appear per objective once a policy evaluates."""
+    states = []
+    for labels, engine, _snap in members:
+        plane = getattr(engine.metrics, "signals", None)
+        if plane is not None:
+            states.append((labels, plane.slo_state()))
+    if not states:
+        return []
+    lines = render_header(
+        "polykey_slo_budget_remaining_ratio",
+        "Error budget remaining over the longest window, per objective "
+        "(1 = untouched, 0 = exhausted).",
+        "gauge",
+    )
+    for labels, state in states:
+        for name in sorted(state):
+            lines.append(render_sample(
+                "polykey_slo_budget_remaining_ratio",
+                {**labels, "objective": name},
+                state[name]["budget_remaining"],
+            ))
+    lines += render_header(
+        "polykey_slo_burn_rate",
+        "Error-budget burn rate per objective and window (1 = burning "
+        "exactly at the objective's allowance; >1 exhausts early).",
+        "gauge",
+    )
+    for labels, state in states:
+        for name in sorted(state):
+            for window, burn in sorted(state[name]["burn_rate"].items()):
+                if burn is None:
+                    continue    # window carried no evidence: no sample
+                lines.append(render_sample(
+                    "polykey_slo_burn_rate",
+                    {**labels, "objective": name, "window": window},
+                    burn,
+                ))
+    lines += render_header(
+        "polykey_slo_breaches_total",
+        "Burn-threshold crossings per objective (breach events; each "
+        "also lands on the timeline and flight recorder).",
+        "counter",
+    )
+    for labels, state in states:
+        for name in sorted(state):
+            lines.append(render_sample(
+                "polykey_slo_breaches_total",
+                {**labels, "objective": name},
+                state[name]["breaches"],
+            ))
+    return lines
+
+
 def engine_collector(engine_or_provider):
     """Scrape-time collector over a live InferenceEngine OR a
     ReplicaPool: counters and gauges come from `stats()` snapshots (the
@@ -256,6 +315,7 @@ def engine_collector(engine_or_provider):
                         lines.append(render_sample(name, labels, snap[key]))
         if pool is not None:
             lines += _pool_lines(pool, members)
+        lines += _slo_lines(members)
         return lines
 
     return collect
@@ -266,6 +326,8 @@ class DebugSurface:
     metrics HTTP server and gated by ``POLYKEY_DEBUG_ENDPOINTS=1``:
 
     - ``/debug/engine``        — engine_stats snapshot as JSON
+    - ``/debug/slo``           — windowed signal-plane snapshot + SLO
+      burn/budget state (obs.signals.signals_snapshot; ISSUE 11)
     - ``/debug/timeline``      — Perfetto/Chrome-trace export of the
       engine timeline (one process per replica for a pool)
     - ``/debug/flight``        — flight-recorder span trees + events
@@ -325,6 +387,16 @@ class DebugSurface:
                 meta={"source": "polykey /debug/timeline"},
             )
             return 200, "application/json", _json_bytes(trace)
+        if path == "/debug/slo":
+            engine = self._engine()
+            if engine is None:
+                return 404, "text/plain", b"no engine wired\n"
+            from .signals import signals_snapshot
+
+            registry = self.obs.registry if self.obs is not None else None
+            return 200, "application/json", _json_bytes(
+                signals_snapshot(engine, registry=registry)
+            )
         if path == "/debug/flight":
             if self.obs is None:
                 return 404, "text/plain", b"no recorder wired\n"

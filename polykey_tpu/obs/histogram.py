@@ -45,6 +45,52 @@ def log_buckets(lo: float, hi: float, per_decade: int = 4) -> list[float]:
 DEFAULT_MS_BUCKETS = log_buckets(0.5, 120_000.0, per_decade=4)
 
 
+def estimate_quantile(bounds, counts, total: int, q: float) -> float:
+    """The q-th percentile (q in [0, 100]) of a raw per-bucket count
+    vector (`counts` aligned with `bounds`, +Inf bucket last; `total` is
+    the observation count). Shared by `Histogram.percentiles` and the
+    signal plane's DELTA quantiles (obs.signals): subtracting two ring
+    snapshots' counts yields a windowed histogram this estimates over —
+    the fix for "p95 since boot" staleness. Returns 0.0 when empty;
+    values beyond the largest finite bound clamp to it."""
+    if total <= 0:
+        return 0.0
+    rank = q / 100.0 * total
+    running = 0.0
+    for i, c in enumerate(counts[:-1]):
+        if running + c >= rank and c > 0:
+            upper = bounds[i]
+            lower = bounds[i - 1] if i > 0 else 0.0
+            frac = (rank - running) / c
+            return lower + (upper - lower) * min(1.0, max(0.0, frac))
+        running += c
+    return bounds[-1]
+
+
+def fraction_le(bounds, counts, threshold: float) -> Optional[float]:
+    """Fraction of observations <= `threshold` in a raw count vector
+    (+Inf bucket last), interpolating linearly inside the straddling
+    bucket — the good-event fraction a latency SLO needs ("P(TTFT <=
+    500 ms)") from bucket counts alone. None when empty. Everything in
+    the +Inf bucket is above any threshold."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    running = 0.0
+    lower = 0.0
+    for i, upper in enumerate(bounds):
+        c = counts[i]
+        if threshold < upper:
+            if threshold <= lower:
+                frac = 0.0
+            else:
+                frac = (threshold - lower) / (upper - lower)
+            return (running + c * frac) / total
+        running += c
+        lower = upper
+    return running / total          # threshold >= last bound: all finite
+
+
 class Histogram:
     """Thread-safe fixed-bucket histogram.
 
@@ -98,6 +144,14 @@ class Histogram:
         with self._lock:
             return list(self._exemplars) if self._exemplars else None
 
+    def counts_snapshot(self) -> tuple[tuple[int, ...], float]:
+        """One locked copy of the RAW per-bucket counts (+Inf last) plus
+        the sum — the signal plane's ring stores these and diffs two of
+        them into a windowed histogram (estimate_quantile/fraction_le
+        over the delta)."""
+        with self._lock:
+            return tuple(self._counts), self._sum
+
     @property
     def count(self) -> int:
         return self._count
@@ -143,15 +197,4 @@ class Histogram:
         return tuple(self._estimate(q, counts, total) for q in qs)
 
     def _estimate(self, q: float, counts: list[int], total: int) -> float:
-        if total == 0:
-            return 0.0
-        rank = q / 100.0 * total
-        running = 0.0
-        for i, c in enumerate(counts[:-1]):
-            if running + c >= rank and c > 0:
-                upper = self.bounds[i]
-                lower = self.bounds[i - 1] if i > 0 else 0.0
-                frac = (rank - running) / c
-                return lower + (upper - lower) * min(1.0, max(0.0, frac))
-            running += c
-        return self.bounds[-1]
+        return estimate_quantile(self.bounds, counts, total, q)

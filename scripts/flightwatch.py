@@ -1,0 +1,230 @@
+#!/usr/bin/env python3
+"""flightwatch: a top-style live console over /metrics + /debug/slo.
+
+Operator triage without Grafana: polls a running polykey server's
+Prometheus endpoint and (when POLYKEY_DEBUG_ENDPOINTS=1 on the server)
+the /debug/slo signal-plane snapshot, and redraws one screen of the
+numbers the runbooks reference — windowed TTFT/ITL tails, throughput,
+occupancy, device-busy fraction, queue depth, per-replica state, SLO
+budget remaining and burn rates.
+
+  make flightwatch                         # localhost:9464, 2 s refresh
+  python scripts/flightwatch.py --port 9464 --interval 1
+  python scripts/flightwatch.py --once     # one frame, no clear (CI/smoke)
+
+Stdlib only; degrades gracefully: no /debug/slo (gated off or older
+server) leaves the SLO/window sections empty instead of failing.
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+import urllib.error
+import urllib.request
+
+_LINE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>[^\s#]+)"
+)
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_metrics(text: str) -> dict:
+    """Prometheus text page -> {family: [(labels dict, float value)]}.
+    Exemplar tails and comment lines are ignored; unparsable values are
+    skipped (the watcher must never crash on a page it half-reads)."""
+    families: dict = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        match = _LINE_RE.match(line)
+        if match is None:
+            continue
+        try:
+            value = float(match.group("value"))
+        except ValueError:
+            continue
+        labels = dict(_LABEL_RE.findall(match.group("labels") or ""))
+        families.setdefault(match.group("name"), []).append((labels, value))
+    return families
+
+
+def metric(families: dict, name: str, default=None, **labels):
+    """First sample of `name` whose labels include `labels`."""
+    for sample_labels, value in families.get(name, ()):
+        if all(sample_labels.get(k) == v for k, v in labels.items()):
+            return value
+    return default
+
+
+def _fmt(value, spec="{:.1f}", none="-") -> str:
+    return none if value is None else spec.format(value)
+
+
+def _bar(fraction, width=20) -> str:
+    if fraction is None:
+        return "-" * width
+    filled = int(round(max(0.0, min(1.0, fraction)) * width))
+    return "#" * filled + "." * (width - filled)
+
+
+def render(families: dict, slo: dict, now: str, target: str) -> str:
+    """One frame. Pure function of the two payloads so the smoke test
+    can feed canned inputs and assert on the output."""
+    lines = [f"polykey flightwatch — {target} — {now}", ""]
+
+    slots = metric(families, "polykey_decode_slots")
+    lanes = metric(families, "polykey_live_lanes")
+    busy = metric(families, "polykey_device_busy_fraction")
+    lines += [
+        "ENGINE",
+        "  tok/s {:>8}   active {:>4}   queued {:>4}   shed {:>6}".format(
+            _fmt(metric(families, "polykey_tokens_per_sec")),
+            _fmt(metric(families, "polykey_active_requests"), "{:.0f}"),
+            _fmt(metric(families, "polykey_queue_depth"), "{:.0f}"),
+            _fmt(metric(families, "polykey_requests_shed_total"), "{:.0f}"),
+        ),
+        "  lanes {:>8}/{:<4} device_busy {:>7}   inflight {:>2}"
+        "   lookahead {:>2}".format(
+            _fmt(lanes), _fmt(slots, "{:.0f}"),
+            _fmt(busy, "{:.3f}"),
+            _fmt(metric(families, "polykey_dispatch_inflight"), "{:.0f}"),
+            _fmt(metric(families, "polykey_dispatch_lookahead_depth"),
+                 "{:.0f}"),
+        ),
+        "",
+    ]
+
+    aggregate = (slo or {}).get("aggregate") or {}
+    if aggregate:
+        lines.append("WINDOWS        ttft_p50  ttft_p95   itl_p95"
+                     "     tok/s     avail      busy")
+        for label, summary in aggregate.items():
+            if not summary:
+                lines.append(f"  {label:<11}  (no data)")
+                continue
+            lines.append(
+                "  {:<11} {:>9} {:>9} {:>9} {:>9} {:>9} {:>9}".format(
+                    label,
+                    _fmt(summary.get("ttft_ms_p50")),
+                    _fmt(summary.get("ttft_ms_p95")),
+                    _fmt(summary.get("itl_ms_p95")),
+                    _fmt(summary.get("tokens_per_sec")),
+                    _fmt(summary.get("availability"), "{:.4f}"),
+                    _fmt(summary.get("device_busy_fraction"), "{:.3f}"),
+                )
+            )
+        lines.append("")
+
+    replicas = (slo or {}).get("replicas") or {}
+    objectives: dict = {}
+    for index in sorted(replicas, key=int):
+        for name, state in (replicas[index].get("slo") or {}).items():
+            objectives.setdefault((index, name), state)
+    if objectives:
+        lines.append("SLO            budget remaining        burn(now)"
+                     "   breaches")
+        for (index, name), state in sorted(objectives.items()):
+            burns = state.get("burn_rate") or {}
+            burn = next(
+                (b for _, b in sorted(burns.items()) if b is not None), None
+            )
+            tag = f"{name}@{index}" if len(replicas) > 1 else name
+            flag = " BREACHED" if state.get("breached") else ""
+            lines.append(
+                "  {:<12} [{}] {:>5} {:>10} {:>10}{}".format(
+                    tag[:12], _bar(state.get("budget_remaining")),
+                    _fmt(state.get("budget_remaining"), "{:.2f}"),
+                    _fmt(burn, "{:.2f}"),
+                    _fmt(state.get("breaches"), "{:.0f}"),
+                    flag,
+                )
+            )
+        lines.append("")
+
+    if replicas:
+        lines.append("REPLICAS       state        q-delay    load")
+        for index in sorted(replicas, key=int):
+            now_sig = replicas[index].get("now") or {}
+            state = metric(families, "polykey_replica_state",
+                           replica=index, state="SERVING")
+            state_name = "SERVING" if state == 1 else (
+                next((s for s in ("DRAINING", "RESTARTING", "DEAD", "NEW")
+                      if metric(families, "polykey_replica_state",
+                                replica=index, state=s) == 1), "?")
+                if metric(families, "polykey_replica_state",
+                          replica=index, state="SERVING") is not None
+                else "-")
+            lines.append(
+                "  {:<12} {:<12} {:>7} {:>7}".format(
+                    f"replica {index}", state_name,
+                    _fmt(now_sig.get("queue_delay_s"), "{:.3f}"),
+                    _fmt(now_sig.get("load_fraction"), "{:.2f}"),
+                )
+            )
+        lines.append("")
+    return "\n".join(lines)
+
+
+def fetch_json(url: str, timeout: float = 5.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return json.loads(resp.read().decode())
+    except (urllib.error.URLError, OSError, ValueError):
+        return None
+
+
+def fetch_text(url: str, timeout: float = 5.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.read().decode()
+    except (urllib.error.URLError, OSError):
+        return None
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int,
+                    default=int(os.environ.get("POLYKEY_METRICS_PORT",
+                                               "9464") or 9464))
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit (no screen clears)")
+    args = ap.parse_args()
+    base = f"http://{args.host}:{args.port}"
+
+    while True:
+        page = fetch_text(f"{base}/metrics")
+        if page is None:
+            print(f"flightwatch: no /metrics at {base} "
+                  "(server down or POLYKEY_METRICS_PORT mismatch)",
+                  file=sys.stderr)
+            if args.once:
+                return 1
+            time.sleep(args.interval)
+            continue
+        families = parse_metrics(page)
+        slo = fetch_json(f"{base}/debug/slo")
+        frame = render(
+            families, slo,
+            time.strftime("%H:%M:%SZ", time.gmtime()), base,
+        )
+        if args.once:
+            print(frame)
+            return 0
+        sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+        if slo is None:
+            sys.stdout.write(
+                "(no /debug/slo — set POLYKEY_DEBUG_ENDPOINTS=1 on the "
+                "server for windowed + SLO sections)\n"
+            )
+        sys.stdout.flush()
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

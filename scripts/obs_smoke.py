@@ -25,11 +25,16 @@ import os
 import re
 import sys
 import threading
+import time
 import urllib.error
 import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Short signal-plane windows so the fault→breach→recovery cycle (ISSUE
+# 11) completes in smoke time: the shortest window is the breach
+# detector and must age the faulted requests out within seconds.
+os.environ.setdefault("POLYKEY_SIGNALS_WINDOWS", "2,5,15")
 
 import jax  # noqa: E402
 
@@ -78,6 +83,12 @@ REQUIRED_FAMILIES = (
     # histogram and the device-busy fraction gauge.
     "polykey_request_device_ms_bucket",
     "polykey_device_busy_fraction",
+    # SLO signal plane (ISSUE 11): family headers render whenever the
+    # plane exists; objective-labeled samples are asserted by
+    # slo_checks once a policy is installed.
+    "polykey_slo_budget_remaining_ratio",
+    "polykey_slo_burn_rate",
+    "polykey_slo_breaches_total",
 )
 
 # One exemplar line on the TTFT histogram, OpenMetrics syntax:
@@ -92,6 +103,7 @@ CONFIG = EngineConfig(
     max_decode_slots=4, page_size=8, num_pages=64, max_seq_len=64,
     prefill_buckets=(16, 32), max_new_tokens_cap=48,
     default_max_new_tokens=16,
+    signals_interval_s=0.1,       # smoke-speed signal-plane sampling
 )
 
 # Replica-tier families (ISSUE 9): present on a pool-backed stack, with
@@ -234,6 +246,136 @@ def profiler_checks(port: int, stub, pk_mod) -> list:
         result = json.loads(body)
         if result.get("files", 0) < 1:
             failures.append(f"profiler capture artifact dir empty: {result}")
+    return failures
+
+
+_BREACH_RE = re.compile(
+    r'polykey_slo_breaches_total\{objective="ttft_fault"\} (\d+)'
+)
+_BURN_RE = re.compile(
+    r'polykey_slo_burn_rate\{objective="ttft_fault",window="2s"\} '
+    r'([0-9.]+)'
+)
+
+
+def slo_checks(port: int, stub, service) -> list:
+    """The ISSUE 11 closed-loop cycle against the live stack: a
+    mid-run injected slow-step fault drives TTFT burn rate > 1,
+    increments polykey_slo_breaches_total, lands the breach on the
+    timeline, flight recorder, and /debug/slo — and the budget burn
+    STOPS once the fault clears (recovery event + burn back under 1)."""
+    from polykey_tpu import faults
+    from polykey_tpu.obs.signals import SloObjective, SloPolicy
+
+    failures: list[str] = []
+    engine = service.engine
+    plane = engine.metrics.signals
+    if plane is None:
+        return ["signal plane missing on the smoke engine"]
+    plane.set_policy(SloPolicy(objectives=(
+        SloObjective(name="ttft_fault", kind="latency", signal="ttft_ms",
+                     threshold_ms=900.0, target=0.7),
+    )))
+
+    def gen(prompt: str) -> None:
+        request = pk.ExecuteToolRequest(tool_name="llm_generate")
+        request.parameters.update({"prompt": prompt, "max_tokens": 16})
+        chunks = list(stub.ExecuteToolStream(request, timeout=120))
+        assert chunks[-1].final
+
+    def breaches() -> int:
+        match = _BREACH_RE.search(scrape(port))
+        return int(match.group(1)) if match else 0
+
+    # Clean traffic: the short window holds good evidence before the
+    # fault lands (and pins that clean serving does not breach).
+    for i in range(3):
+        gen(f"slo clean {i}")
+    time.sleep(0.3)
+    plane.sample_now()
+    breaches_before = breaches()
+
+    # Mid-run fault: hand a fresh injector to the LIVE engine (engines
+    # cache it at construction); every decode dispatch now sleeps 1.1 s
+    # so TTFT blows the 900 ms threshold. Budget-bounded so it cannot
+    # outlive this check.
+    engine._faults = faults.install("slow-step=1.1@10")
+    try:
+        for i in range(2):
+            gen(f"slo fault {i}")
+        plane.sample_now()
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if breaches() > breaches_before:
+                break
+            time.sleep(0.3)
+            plane.sample_now()
+        else:
+            failures.append(
+                "fault never incremented polykey_slo_breaches_total"
+            )
+        match = _BURN_RE.search(scrape(port))
+        if match is None or float(match.group(1)) <= 1.0:
+            failures.append(
+                f"TTFT burn rate not > 1 under fault (got "
+                f"{match.group(1) if match else 'no sample'})"
+            )
+    finally:
+        faults.clear()
+        engine._faults = None
+
+    os.environ["POLYKEY_DEBUG_ENDPOINTS"] = "1"
+    status, ctype, body = fetch(port, "/debug/slo")
+    if status != 200 or "json" not in ctype:
+        failures.append(f"/debug/slo: {status} {ctype}")
+    else:
+        snap = json.loads(body)
+        slo = snap.get("replicas", {}).get("0", {}).get("slo", {})
+        if "ttft_fault" not in slo:
+            failures.append("/debug/slo missing the ttft_fault objective")
+        if snap.get("gateway", {}).get("rpcs_ok", 0) < 1:
+            failures.append("/debug/slo missing gateway availability")
+    os.environ.pop("POLYKEY_DEBUG_ENDPOINTS", None)
+    status, _, _ = fetch(port, "/debug/slo")
+    if status != 404:
+        failures.append(f"/debug/slo served while gated off: {status}")
+    os.environ["POLYKEY_DEBUG_ENDPOINTS"] = "1"
+
+    # Recovery: clean traffic ages the faulted TTFTs out of the short
+    # window; burn must drop back under 1 (breached flag clears) and
+    # the breach counter must stop moving.
+    breaches_peak = breaches()
+    recovered = False
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        gen("slo recovery probe")
+        time.sleep(0.3)
+        plane.sample_now()
+        state = plane.slo_state().get("ttft_fault", {})
+        if state and not state.get("breached"):
+            recovered = True
+            break
+    if not recovered:
+        failures.append("burn never recovered after the fault cleared")
+    if breaches() != breaches_peak:
+        failures.append("breach counter kept burning after recovery")
+
+    # The cycle is visible on the flight deck: timeline notes + flight
+    # recorder events for both transitions.
+    status, _, body = fetch(port, "/debug/timeline")
+    names = {e.get("name") for e in json.loads(body).get("traceEvents", [])} \
+        if status == 200 else set()
+    for note in ("slo_breach", "slo_recovered"):
+        if note not in names:
+            failures.append(f"timeline missing {note} note")
+    status, _, body = fetch(port, "/debug/flight")
+    kinds = {e.get("kind") for e in json.loads(body).get("events", [])} \
+        if status == 200 else set()
+    if "slo_breach" not in kinds:
+        failures.append("flight recorder missing slo_breach event")
+
+    plane.set_policy(None)
+    os.environ.pop("POLYKEY_DEBUG_ENDPOINTS", None)
     return failures
 
 
@@ -421,6 +563,8 @@ def main() -> int:
         failures += exemplar_checks(metrics.port)
         failures += debug_checks(metrics.port, trace_id)
         failures += profiler_checks(metrics.port, stub, pk)
+        # ISSUE 11: the SLO fault→breach→recovery cycle.
+        failures += slo_checks(metrics.port, stub, service)
         channel.close()
     finally:
         metrics.stop()
@@ -438,6 +582,7 @@ def main() -> int:
     print(f"obs-smoke OK: {len(REQUIRED_FAMILIES)} families present, "
           "span tree complete, exemplars parse, debug surface gated + "
           "serving, profiler single-flight round-trip, "
+          "SLO fault→breach→recovery cycle closed, "
           f"{len(POOL_FAMILIES)} replica-pool families present, "
           "engine_stats aggregates across replicas")
     return 0

@@ -1,0 +1,325 @@
+#!/usr/bin/env python3
+"""Perf-regression sentinel (``make perf-gate`` — ISSUE 11).
+
+Runs a short DETERMINISTIC CPU soak (fixed seed, fixed request set,
+closed-loop saturation against a tiny hermetic engine), summarizes it
+through the signal plane's windowed math (obs.signals.summarize_deltas
+over exact open/close metric snapshots — the same delta-histogram
+quantiles /metrics burn rates are built on), and compares the result
+against the committed reference ``perf/slo_reference.json`` with
+EXPLICIT per-metric noise tolerances. Exit nonzero on regression: the
+repo's first automated perf-trajectory gate — a PR that silently
+regresses occupancy, throughput, or latency tails now fails CI instead
+of shipping.
+
+Tolerances are deliberately generous on wall-clock metrics (CI runners
+are slow and noisy 2-core boxes; a 2x throughput swing is machine, not
+regression) and tight on scheduling-shape metrics (occupancy and
+device_busy_fraction are load-determined, not machine-determined). They
+live IN the reference file so a reviewer sees exactly what the gate
+forgives.
+
+Regenerate the reference after an intentional perf change (documented
+one-liner, perf/README.md):
+
+  JAX_PLATFORMS=cpu python scripts/perf_gate.py --write-reference
+
+Other modes:
+  --compare-only REPORT   gate an existing report without re-running
+                          the soak (the teeth test uses this)
+  --out PATH              where the run report goes (default /tmp)
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_REFERENCE = os.path.join(REPO, "perf", "slo_reference.json")
+
+# Per-metric tolerance specs written into a fresh reference:
+# direction "higher" = regression when measured falls below
+#   value * (1 - rel_tol) - abs_tol;
+# direction "lower"  = regression when measured rises above
+#   value * (1 + rel_tol) + abs_tol.
+DEFAULT_TOLERANCES = {
+    # Scheduling shape: machine-speed independent, keep tight.
+    "occupancy": {"direction": "higher", "rel_tol": 0.20, "abs_tol": 0.05},
+    "device_busy_fraction": {
+        "direction": "higher", "rel_tol": 0.25, "abs_tol": 0.05,
+    },
+    # Wall-clock rates/latencies: CI boxes swing wildly; the gate only
+    # catches collapses, not percent-level drift.
+    "tokens_per_sec": {"direction": "higher", "rel_tol": 0.65},
+    "ttft_ms_p95": {"direction": "lower", "rel_tol": 2.0, "abs_tol": 300.0},
+    "itl_ms_p95": {"direction": "lower", "rel_tol": 2.0, "abs_tol": 60.0},
+    "host_stall_ms_p50": {
+        "direction": "lower", "rel_tol": 4.0, "abs_tol": 25.0,
+    },
+}
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def compare(report: dict, reference: dict) -> list:
+    """Gate `report` against `reference`; returns the list of failure
+    strings (empty = pass). Pure so the teeth test can feed it a
+    deliberately degraded reference and assert the gate bites."""
+    failures = []
+    metrics = report.get("metrics", {})
+    for name, spec in reference.get("metrics", {}).items():
+        measured = metrics.get(name)
+        if measured is None:
+            failures.append(f"{name}: missing from report")
+            continue
+        value = spec["value"]
+        rel = spec.get("rel_tol", 0.0)
+        abs_ = spec.get("abs_tol", 0.0)
+        if spec.get("direction", "higher") == "higher":
+            floor = value * (1.0 - rel) - abs_
+            if measured < floor:
+                failures.append(
+                    f"{name}: {measured:g} < allowed floor {floor:g} "
+                    f"(reference {value:g}, rel_tol {rel:g}, "
+                    f"abs_tol {abs_:g})"
+                )
+        else:
+            ceiling = value * (1.0 + rel) + abs_
+            if measured > ceiling:
+                failures.append(
+                    f"{name}: {measured:g} > allowed ceiling {ceiling:g} "
+                    f"(reference {value:g}, rel_tol {rel:g}, "
+                    f"abs_tol {abs_:g})"
+                )
+    for name in reference.get("require_zero", ["requests_failed"]):
+        if report.get(name, 0) != 0:
+            failures.append(f"{name}: {report.get(name)} != 0")
+    return failures
+
+
+def run_soak(args) -> dict:
+    """The deterministic CPU soak: warm compiles with a burst, then
+    drain a fixed seeded request set at closed-loop saturation and
+    summarize the measurement window through the signal-plane delta
+    math."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from polykey_tpu.engine.config import EngineConfig
+    from polykey_tpu.engine.engine import GenRequest, InferenceEngine
+    from polykey_tpu.obs.signals import (
+        HIST_SIGNALS,
+        signals_snapshot,
+        summarize_deltas,
+    )
+
+    cfg = EngineConfig(
+        model="tiny-llama", tokenizer="byte", dtype="float32",
+        max_decode_slots=args.slots, page_size=16,
+        num_pages=args.slots * 16 + 64, max_seq_len=256,
+        prefill_buckets=(32, 64), prefill_chunk=64,
+        max_new_tokens_cap=args.max_new + 8,
+        decode_block_steps=args.block, lookahead_blocks=2,
+        max_queue_depth=0, supervise=False,
+        # The gate runs THROUGH the plane so a regression in the signal
+        # path itself (sampling stalls, broken windows) also fails it.
+        signals_interval_s=0.25,
+    )
+    rng = np.random.default_rng(args.seed)
+
+    def prompt() -> str:
+        r = rng.random()
+        if r < 0.15:
+            n = int(rng.integers(96, 140))     # chunked-prefill path
+        elif r < 0.55:
+            n = int(rng.integers(8, 30))
+        else:
+            n = int(rng.integers(33, 62))
+        return "".join(chr(c) for c in rng.integers(97, 123, n))
+
+    engine = InferenceEngine(cfg)
+    try:
+        def drain(requests):
+            for request in requests:
+                deadline = time.monotonic() + 600
+                while True:
+                    kind, value = request.out.get(
+                        timeout=max(0.1, deadline - time.monotonic())
+                    )
+                    if kind == "done":
+                        break
+                    if kind == "error":
+                        raise RuntimeError(f"soak request failed: {value}")
+
+        # Warm: pay every XLA compile (bucket groups, chunk, both block
+        # sizes, merges) outside the measurement window.
+        warm = [GenRequest(prompt=prompt(), max_new_tokens=args.max_new)
+                for _ in range(max(4, args.slots))]
+        for request in warm:
+            engine.submit(request)
+        drain(warm)
+
+        metrics = engine.metrics
+        t0 = time.monotonic()
+        c0 = metrics.counter_sample()
+        h0 = {
+            name: getattr(metrics, attr).counts_snapshot()
+            for name, attr in HIST_SIGNALS.items()
+        }
+        measured = [
+            GenRequest(prompt=prompt(), max_new_tokens=args.max_new)
+            for _ in range(args.requests)
+        ]
+        for request in measured:
+            engine.submit(request)
+        drain(measured)
+        wall = time.monotonic() - t0
+        c1 = metrics.counter_sample()
+        h1 = {
+            name: getattr(metrics, attr).counts_snapshot()
+            for name, attr in HIST_SIGNALS.items()
+        }
+        deltas = {
+            "covered_s": wall,
+            "counters": {k: c1[k] - c0[k] for k in c1},
+            "hists": {
+                name: (
+                    tuple(e - b for e, b in zip(h1[name][0], h0[name][0])),
+                    h1[name][1] - h0[name][1],
+                )
+                for name in h1
+            },
+        }
+        plane = metrics.signals
+        summary = summarize_deltas(deltas, plane._bounds)
+
+        # The live plane must have been sampling the whole time — a
+        # soak that measures well but whose signal plane went dark is a
+        # regression in its own right. Pin the end boundary: the
+        # periodic sampler may lag the last finish by one interval.
+        plane.sample_now()
+        snap = signals_snapshot(engine)
+        windows = snap["replicas"][str(engine.replica_id)]["windows"]
+        plane_ttft = max(
+            (w or {}).get("ttft_ms_count", 0) for w in windows.values()
+        )
+
+        report = {
+            "config": {
+                "slots": args.slots, "requests": args.requests,
+                "max_new": args.max_new, "block": args.block,
+                "seed": args.seed,
+            },
+            "wall_s": round(wall, 2),
+            "requests_failed": summary["requests_failed"],
+            "signal_plane_samples": snap["replicas"][
+                str(engine.replica_id)]["samples"],
+            "signal_plane_ttft_count": plane_ttft,
+            "metrics": {
+                "occupancy": round(
+                    (summary["avg_lanes"] or 0.0) / args.slots, 4
+                ),
+                "tokens_per_sec": summary["tokens_per_sec"],
+                "ttft_ms_p95": summary.get("ttft_ms_p95"),
+                "itl_ms_p95": summary.get("itl_ms_p95"),
+                "host_stall_ms_p50": summary.get("host_stall_ms_p50"),
+                "device_busy_fraction": summary["device_busy_fraction"],
+            },
+            "platform": jax.devices()[0].platform,
+            "measured_at": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            ),
+        }
+        if plane_ttft < args.requests:
+            report["requests_failed"] = report["requests_failed"] or 0
+            report.setdefault("structural_failures", []).append(
+                f"signal plane windows saw {plane_ttft} TTFTs "
+                f"< {args.requests} measured requests"
+            )
+        return report
+    finally:
+        engine.shutdown()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--reference", default=DEFAULT_REFERENCE)
+    ap.add_argument("--out", default="/tmp/perf_gate_report.json")
+    ap.add_argument("--write-reference", action="store_true",
+                    help="write the reference from this run instead of "
+                         "gating against it (commit the result)")
+    ap.add_argument("--compare-only", default="",
+                    help="gate an existing report JSON; skip the soak")
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--requests", type=int, default=48)
+    ap.add_argument("--max-new", type=int, default=32)
+    ap.add_argument("--block", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args()
+
+    if args.compare_only:
+        with open(args.compare_only) as f:
+            report = json.load(f)
+    else:
+        log(f"perf-gate soak: {args.requests} requests @ {args.slots} "
+            f"slots (seed {args.seed}) ...")
+        report = run_soak(args)
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+            f.write("\n")
+        log(f"wrote report {args.out}")
+        print(json.dumps(report["metrics"]))
+
+    if report.get("structural_failures"):
+        for failure in report["structural_failures"]:
+            log(f"FAIL (structural): {failure}")
+        return 1
+
+    if args.write_reference:
+        reference = {
+            "generated_by":
+                "JAX_PLATFORMS=cpu python scripts/perf_gate.py "
+                "--write-reference",
+            "config": report["config"],
+            "measured_at": report["measured_at"],
+            "require_zero": ["requests_failed"],
+            "metrics": {
+                name: {"value": report["metrics"][name],
+                       **DEFAULT_TOLERANCES[name]}
+                for name in DEFAULT_TOLERANCES
+                if report["metrics"].get(name) is not None
+            },
+        }
+        with open(args.reference, "w") as f:
+            json.dump(reference, f, indent=1)
+            f.write("\n")
+        log(f"wrote reference {args.reference}")
+        return 0
+
+    if not os.path.exists(args.reference):
+        log(f"FAIL: no reference at {args.reference} — generate one with "
+            "--write-reference and commit it")
+        return 1
+    with open(args.reference) as f:
+        reference = json.load(f)
+    failures = compare(report, reference)
+    if failures:
+        log("perf-gate FAILED (regression vs committed reference):")
+        for failure in failures:
+            log(f"  - {failure}")
+        return 1
+    log("perf-gate OK: all windowed signals within reference tolerances")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -418,6 +418,17 @@ def test_exposition_under_engine_swap_and_replica_flip():
                     )
                 if "polykey_replica_state" not in page:
                     failures.append("missing pool families mid-churn")
+                # ISSUE 11: the SLO signal-plane families must survive
+                # the same churn — planes ride the adopted metrics, so
+                # a swap must never tear or drop them.
+                slo_header = "# TYPE polykey_slo_budget_remaining_ratio gauge"
+                if page.count(slo_header) != 1:
+                    failures.append(
+                        f"torn slo family: {page.count(slo_header)} "
+                        f"x {slo_header}"
+                    )
+                if "polykey_slo_breaches_total" not in page:
+                    failures.append("missing slo families mid-churn")
             except Exception as e:  # any scrape failure is the bug
                 failures.append(f"scrape raised: {e!r}")
 
