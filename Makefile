@@ -11,7 +11,7 @@ BUILD_DIR := build
 	kernel-check tunnel-probe bench-tokenizer tpu-watch metrics-smoke \
 	obs-smoke chaos-smoke print-chaos occupancy-smoke occupancy-soak \
 	failover-smoke failover-soak timeline-capture perf-gate \
-	perf-gate-reference flightwatch
+	perf-gate-reference flightwatch ragged-smoke ragged-soak
 
 help: ## Show available targets
 	@grep -E '^[a-zA-Z_-]+:.*?## .*$$' $(MAKEFILE_LIST) | \
@@ -103,6 +103,18 @@ occupancy-smoke: ## Poisson-load occupancy soak at CI scale (gated >= 0.7)
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/occupancy_soak.py \
 	  --slots 8 --duration 10 --min-occupancy 0.7 \
 	  --out /tmp/occupancy_smoke.json
+
+# Ragged dispatch (ISSUE 12): the interpret-mode kernel path (fp +
+# int8) and the engine's greedy bit-identity vs the bucketed path are
+# exercised on every commit; the A/B soak below is the padding-waste
+# acceptance measurement.
+ragged-smoke: ## Ragged kernel interpret parity + engine bit-identity vs bucketed
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/ragged_smoke.py
+
+ragged-soak: ## 48-slot A/B soak: bucketed vs ragged padding waste (writes perf/)
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/occupancy_soak.py \
+	  --slots 48 --duration 45 --ramp 15 --ab-ragged --min-occupancy 0.7 \
+	  --out perf/ragged_soak_$$(date -u +%Y%m%d_%H%M%S).json
 
 # Timestamped output so a rerun never clobbers a committed, cited
 # acceptance artifact (the script's date-only default would).
@@ -208,12 +220,13 @@ scan: ## Security scan (Trivy fs over the tree + lockfile, CRITICAL/HIGH gate)
 	  --scanners vuln,secret \
 	  --severity CRITICAL,HIGH
 
-ci-check: ## Run the CI pipeline locally: lint+polylint+graphlint, chaos, failover, occupancy, obs, perf-gate, tests, native(+asan), scan
+ci-check: ## Run the CI pipeline locally: lint+polylint+graphlint, chaos, failover, occupancy, ragged, obs, perf-gate, tests, native(+asan), scan
 	@$(MAKE) lint
 	@$(MAKE) graphlint
 	@$(MAKE) chaos-smoke
 	@$(MAKE) failover-smoke
 	@$(MAKE) occupancy-smoke
+	@$(MAKE) ragged-smoke
 	@$(MAKE) obs-smoke
 	@$(MAKE) perf-gate
 	@$(MAKE) test

@@ -125,6 +125,25 @@ class EngineConfig:
     # should fill all slots at once). POLYKEY_PREFILL_BUDGET.
     prefill_budget: int = 0
 
+    # Ragged dispatch (ISSUE 12, PAPERS.md "Ragged Paged Attention"):
+    # admissions and chunk advancement become token-range appends into
+    # ONE flat mixed prefill+decode dispatch per engine-loop iteration
+    # (all live decode lanes' single tokens + up to ~prefill_budget
+    # prefill tokens), replacing the per-bucket prefill executables
+    # ({1,2,4,8} pads × buckets × greedy variants) and the separate
+    # chunk dispatch with a single resident ragged executable (≤2
+    # greedy variants). Steady-state decode (no prefill work) keeps the
+    # K-step block path, so the PR 6 lookahead pipeline and its
+    # amortization are untouched. Attention rides the ragged Pallas
+    # kernel on TPU (ops/ragged_paged_attention_kernel.py) and its
+    # per-token gather fallback off-TPU — the bit-identity reference:
+    # greedy streams match the bucketed path token-for-token.
+    # POLYKEY_RAGGED=1 enables; POLYKEY_DISABLE_RAGGED=1 is the
+    # operational kill-switch (wins over config/env enablement, the
+    # POLYKEY_DISABLE_PAGED_KERNEL pattern). Requires dp=sp=pp=1 and no
+    # draft model (the spec round has no ragged formulation yet).
+    ragged_dispatch: bool = False
+
     # Automatic prefix caching (engine/prefix_cache.py): requests sharing a
     # page-aligned prompt prefix reuse its KV pages and prefill only the
     # suffix. prefix_cache_pages caps the cache's own page references
@@ -348,6 +367,7 @@ class EngineConfig:
             default_max_new_tokens=_env_int(
                 "POLYKEY_DEFAULT_MAX_NEW_TOKENS", cls.default_max_new_tokens
             ),
+            ragged_dispatch=_env_bool("POLYKEY_RAGGED"),
             prefix_cache=_env_bool("POLYKEY_PREFIX_CACHE"),
             prefix_cache_pages=_env_int(
                 "POLYKEY_PREFIX_CACHE_PAGES", cls.prefix_cache_pages
@@ -449,6 +469,21 @@ class EngineConfig:
             )
         if self.prefill_chunk < 0:
             raise ValueError("prefill_chunk must be >= 0 (0 → max bucket)")
+        if self.ragged_dispatch:
+            if self.draft_model is not None:
+                raise ValueError(
+                    "ragged_dispatch has no speculative formulation yet "
+                    "(the spec round verifies gamma-token windows, not a "
+                    "flat mixed stream) — unset POLYKEY_RAGGED or the "
+                    "draft model"
+                )
+            if self.dp * self.num_slices > 1 or self.sp > 1 or self.pp > 1:
+                raise ValueError(
+                    "ragged_dispatch serves tp-at-most meshes: the flat "
+                    "token stream does not shard over dp/sp/pp (got "
+                    f"dp={self.dp}×slices={self.num_slices}, sp={self.sp}, "
+                    f"pp={self.pp})"
+                )
         if self.prefill_budget < 0:
             raise ValueError(
                 "prefill_budget must be >= 0 (0 → 2 x prefill chunk)"

@@ -69,7 +69,12 @@ import numpy as np
 from ..faults import get_injector
 from ..models.config import ModelConfig, get_config
 from ..obs.timeline import TimelineRecorder
-from ..models.transformer import forward_paged, init_params, unembed
+from ..models.transformer import (
+    forward_paged,
+    forward_ragged,
+    init_params,
+    unembed,
+)
 from ..parallel.mesh import MeshConfig, create_mesh
 from ..parallel.sharding import paged_kv_sharding, shard_params
 from .config import EngineConfig
@@ -271,6 +276,97 @@ def _decode_fn(
     return packed, last, seq, act, paged
 
 
+def _ragged_fn(
+    params, cfg: ModelConfig, paged,
+    last_tokens, seq_lens, page_tables, active, caps, seeds, temperature,
+    top_p, top_k,
+    pre_tokens, pre_pos, pre_table_idx, pre_tables,
+    pre_range_start, pre_range_len, pre_range_kv, pre_range_table,
+    pre_sample_idx, pre_sample_pos, pre_seeds, pre_temp, pre_top_p,
+    pre_top_k,
+    *, greedy: bool, eos_id: int, candidates: int = 0, mesh=None,
+):
+    """ONE ragged dispatch for mixed prefill+decode (ISSUE 12): every
+    decode lane advances exactly one step AND up to `W` prefill tokens
+    (admission prompts and chunk advancement, appended as token ranges
+    by the host-side batch builder) prefill — through a single flat
+    [B+W]-token forward (models/transformer.forward_ragged; ragged
+    Pallas kernel on TPU, per-token gather fallback elsewhere).
+
+    Layout: flat rows [0, B) are the decode lanes' single tokens (row b
+    = slot b, position seq_lens[b]-1 — inactive lanes compute masked
+    garbage through their garbage tables exactly as in _decode_fn);
+    rows [B, B+W) are the prefill stream. `pre_table_idx[w]` maps each
+    prefill row to its owning slot's HOST-side page table in
+    `pre_tables` [B, P] (index B → an all-garbage row: padding tokens
+    write to and attend over the reserved page 0, like inactive lanes).
+    `pre_range_*` [B] describe the appended ranges for the ragged
+    kernel's per-sequence metadata (ascending flat offsets; unused rows
+    are empty ranges past the stream end).
+
+    Sampling mirrors the bucketed paths EXACTLY (bit-identity):
+    - decode rows sample with position key seq_lens (the position the
+      new token lands at), advance seq/active with the same EOS/cap
+      stopping as _decode_fn, and return the same packed [1, B] emit
+      row a steps=1 decode block would — so the result rides the
+      lookahead pipeline's _process_step unchanged;
+    - per slot b, `pre_sample_idx[b]` names the prefill-stream row
+      whose hidden state samples that slot's FIRST token at position
+      key `pre_sample_pos[b]` (= prompt_len, matching _prefill_fn's
+      start + last_rel + 1); the host merges only final-chunk slots,
+      the other rows' draws are discarded.
+    """
+    B = last_tokens.shape[0]
+    W = pre_tokens.shape[0]
+    dec_pos = jnp.maximum(seq_lens - 1, 0)
+    tokens = jnp.concatenate([last_tokens, pre_tokens])          # [B+W]
+    positions = jnp.concatenate([dec_pos, pre_pos])
+    garbage_row = jnp.zeros_like(pre_tables[:1])
+    tables_ext = jnp.concatenate([pre_tables, garbage_row])      # [B+1, P]
+    token_tables = jnp.concatenate(
+        [page_tables, tables_ext[pre_table_idx]]
+    )                                                            # [B+W, P]
+    # Ragged sequence metadata (kernel path): B decode singles then the
+    # prefill ranges, starts ascending (unused ranges sit past the end).
+    rng_starts = jnp.concatenate([
+        jnp.arange(B, dtype=jnp.int32), B + pre_range_start,
+    ])
+    rng_lens = jnp.concatenate([
+        jnp.ones((B,), jnp.int32), pre_range_len,
+    ])
+    rng_kv = jnp.concatenate([
+        jnp.maximum(seq_lens, 1), pre_range_kv,
+    ])
+    seq_tables = jnp.concatenate(
+        [page_tables, tables_ext[pre_range_table]]
+    )                                                            # [2B, P]
+
+    hidden, paged = forward_ragged(
+        params, cfg, tokens, positions, paged, token_tables,
+        rng_starts, rng_lens, rng_kv, seq_tables, mesh=mesh,
+    )
+
+    # Decode rows: one _decode_fn step, verbatim semantics.
+    logits = unembed(params, cfg, hidden[:B])                    # [B, V]
+    dec = sample_tail(
+        logits, seeds, seq_lens, temperature, top_p, top_k, greedy,
+        candidates,
+    )
+    dec = jnp.where(active, dec, 0)
+    new_seq = seq_lens + active.astype(jnp.int32)
+    cont = active & (dec != eos_id) & (new_seq < caps)
+    packed = jnp.where(active, dec, -1)[None, :]                 # [1, B]
+
+    # Prefill first tokens: one row per slot (garbage for slots without
+    # a final chunk this dispatch — the host never reads those).
+    rows = hidden[B + jnp.clip(pre_sample_idx, 0, W - 1)]        # [B, H]
+    first = sample_tail(
+        unembed(params, cfg, rows), pre_seeds, pre_sample_pos,
+        pre_temp, pre_top_p, pre_top_k, greedy, candidates,
+    )
+    return packed, dec, new_seq, cont, first, paged
+
+
 def _merge_lane_fn(
     last_tokens, seq_lens, page_tables, active, caps, temperature, top_p,
     top_k, seeds, tokens_vec, row, slot, seq_len, cap, temp, tp, tk,
@@ -317,6 +413,31 @@ def _retire_lane_fn(last_tokens, seq_lens, page_tables, active, caps, slot):
         page_tables.at[slot].set(jnp.zeros_like(page_tables[0])),
         active.at[slot].set(False),
         caps.at[slot].set(0),
+    )
+
+
+def ragged_zero_operands(B: int, W: int, P: int) -> tuple:
+    """The 14 positional prefill operands of `_ragged_fn`, all-zero /
+    all-garbage (no ranges, no sample rows) — the SINGLE builder for
+    every synthetic ragged call (engine warmup, graphlint's donation
+    audit and jaxpr trace). The operands are positionally typed int32/
+    float32 arrays, so hand-built copies that drift from the signature
+    would trace clean and compute garbage; build them here only."""
+    return (
+        np.zeros((W,), np.int32),            # pre_tokens
+        np.zeros((W,), np.int32),            # pre_pos
+        np.full((W,), B, np.int32),          # pre_table_idx → garbage row
+        np.zeros((B, P), np.int32),          # pre_tables
+        np.full((B,), W, np.int32),          # pre_range_start → past end
+        np.zeros((B,), np.int32),            # pre_range_len
+        np.zeros((B,), np.int32),            # pre_range_kv
+        np.full((B,), B, np.int32),          # pre_range_table → garbage
+        np.zeros((B,), np.int32),            # pre_sample_idx
+        np.zeros((B,), np.int32),            # pre_sample_pos
+        np.zeros((B, 2), np.int32),          # pre_seeds
+        np.zeros((B,), np.float32),          # pre_temp
+        np.ones((B,), np.float32),           # pre_top_p
+        np.zeros((B,), np.int32),            # pre_top_k
     )
 
 
@@ -601,6 +722,43 @@ class InferenceEngine:
             if config.adaptive_block else config.decode_block_steps
         )
         self._last_dispatch_steps = 0    # observability (bench step_costs)
+
+        # --- Ragged dispatch (ISSUE 12): admissions + chunk advancement
+        # become token-range appends into ONE flat mixed prefill+decode
+        # dispatch (_ragged_fn) whenever prefill work exists; pure-decode
+        # iterations keep the K-step block path. POLYKEY_DISABLE_RAGGED
+        # is the operational kill-switch (wins over config/env
+        # enablement — the POLYKEY_DISABLE_PAGED_KERNEL pattern): a
+        # ragged regression must be containable by falling back to the
+        # bucketed executables without a config rollout.
+        self._ragged = config.ragged_dispatch and os.environ.get(
+            "POLYKEY_DISABLE_RAGGED", ""
+        ).lower() not in ("1", "true")
+        self._jit_ragged = None
+        if self._ragged:
+            # Static prefill-stream width: the per-iteration token
+            # budget, floored at one chunk and padded so the full flat
+            # stream (B + W) tiles the ragged kernel's token_tile. ONE
+            # width ⇒ one resident executable per greedy variant — the
+            # census collapse GL001 asserts.
+            from ..ops.ragged_paged_attention_kernel import TOKEN_TILE
+
+            W = max(self._prefill_budget, self._chunk)
+            W += (-(B + W)) % TOKEN_TILE
+            self._ragged_width = W
+            self._jit_ragged = jax.jit(
+                _ragged_fn,
+                static_argnames=(
+                    "cfg", "greedy", "eos_id", "candidates", "mesh",
+                ),
+                donate_argnames=(
+                    "paged", "last_tokens", "seq_lens", "active",
+                ),
+                out_shardings=(
+                    self._dp_steps, self._dp_vec, self._dp_vec,
+                    self._dp_vec, self._repl, self._pool_sharding,
+                ),
+            )
 
         # --- Speculative decoding: draft model + its own page pool, same
         # page tables (position → (page, offset) is model-independent).
@@ -942,8 +1100,14 @@ class InferenceEngine:
                 # (host_stall_ms_p50, lookahead_observed_*).
                 "lookahead_depth": self._depth,
                 "lookahead_target": self._depth_target,
+                # Ragged dispatch (ISSUE 12): whether the single-
+                # executable mixed prefill+decode path is live, and its
+                # static prefill-stream width.
+                "ragged": self._ragged,
             }
         )
+        if self._ragged:
+            snap["ragged_width"] = self._ragged_width
         if snap.get("avg_lanes") is not None:
             # Measured occupancy fraction: step-weighted mean live lanes
             # over the slot count (the ≥0.8 target ISSUE 4 soaks against).
@@ -1027,15 +1191,24 @@ class InferenceEngine:
                 t0 = _t()
                 worked, spent = self._admit(budget=budget)
                 _acc("admit", t0)
-                t0 = _t()
-                remaining = None if budget is None else max(0, budget - spent)
-                chunked = self._advance_chunked_prefills(remaining)
-                if chunked:
-                    _acc("chunk", t0)
-                    worked = True
-                self.metrics.on_prefill_interleave(
-                    spent + chunked, decode_live
-                )
+                if self._ragged:
+                    # Ragged mode: admissions only REGISTER (token-range
+                    # appends happen in _dispatch_step's batch builder,
+                    # which owns the budget and the interleave
+                    # accounting) — no separate chunk dispatch exists.
+                    chunked = 0
+                else:
+                    t0 = _t()
+                    remaining = (
+                        None if budget is None else max(0, budget - spent)
+                    )
+                    chunked = self._advance_chunked_prefills(remaining)
+                    if chunked:
+                        _acc("chunk", t0)
+                        worked = True
+                    self.metrics.on_prefill_interleave(
+                        spent + chunked, decode_live
+                    )
                 if self._dev_dirty and self._inflight_q:
                     # Rare full transition (init/recovery): a mirror upload
                     # may never rewind live device state, so the whole
@@ -1053,25 +1226,29 @@ class InferenceEngine:
                 # _process_step. Spec rounds carry the same device-side
                 # stop, so both block kinds pipeline alike.
                 dispatched = False
-                if self._active.any():
+                if self._active.any() or (
+                    self._ragged and self._has_pending_prefill()
+                ):
                     t0 = _t()
-                    self._inflight_q.append(self._dispatch_step())
+                    block = self._dispatch_step()
                     _acc("dispatch", t0)
-                    if trace:
-                        tacc["blocks"] = tacc.get("blocks", 0) + 1
-                        tacc["max_depth"] = max(
-                            tacc.get("max_depth", 0), self._depth_target
-                        )
-                        tacc["disp_steps"] = (
-                            tacc.get("disp_steps", 0)
-                            + self._last_dispatch_steps
-                        )
-                        tacc["disp_lanes"] = (
-                            tacc.get("disp_lanes", 0)
-                            + int(self._active.sum())
-                        )
-                    dispatched = True
-                    worked = True
+                    if block is not None:
+                        self._inflight_q.append(block)
+                        if trace:
+                            tacc["blocks"] = tacc.get("blocks", 0) + 1
+                            tacc["max_depth"] = max(
+                                tacc.get("max_depth", 0), self._depth_target
+                            )
+                            tacc["disp_steps"] = (
+                                tacc.get("disp_steps", 0)
+                                + self._last_dispatch_steps
+                            )
+                            tacc["disp_lanes"] = (
+                                tacc.get("disp_lanes", 0)
+                                + int(self._active.sum())
+                            )
+                        dispatched = True
+                        worked = True
                 t0 = _t()
                 self._resolve_prefills()
                 _acc("resolve", t0)
@@ -1324,6 +1501,17 @@ class InferenceEngine:
         slot.prompt_len = prompt_len
         slot.prompt_ids = ids
 
+        if self._ragged:
+            # Ragged mode: EVERY prompt registers as a pending token
+            # range — admissions and chunk advancement are the same
+            # operation (token-range appends into the next ragged
+            # dispatch's flat stream; _build_ragged_batch). A prefix-
+            # cache hit just starts the range at the cached offset.
+            slot.pending = ids
+            slot.filled = len(matched) * cfg.page_size
+            self._slots[slot_idx] = slot
+            return None
+
         if matched:
             # Prefill only the suffix. A bucket-sized suffix rides the
             # batched bucket path at its own width (a hit must not cost
@@ -1422,10 +1610,200 @@ class InferenceEngine:
                 if self._slots[slot_idx] is slot:
                     self._finish(slot_idx, error=f"prefill failed: {e}")
             return
+        # Padding-waste accounting: the group computed n_pad × bucket
+        # token rows for Σ len(ids) real prompt tokens.
+        self.metrics.on_padding_tokens(
+            n_pad * bucket, sum(len(ids) for _, _, ids, _ in group)
+        )
         for r, (slot_idx, slot, _, _) in enumerate(group):
             if self.timeline is not None:
                 self.timeline.prefill(slot_idx, bucket, True)
             self._merge_slot(slot_idx, slot, toks_dev, r)
+
+    def _has_pending_prefill(self) -> bool:
+        return any(
+            s is not None and s.pending is not None for s in self._slots
+        )
+
+    def _build_ragged_batch(self) -> list:
+        """Collect the next ragged dispatch's token ranges: round-robin
+        from the `_chunk_rr` cursor over slots with pending prompt
+        tokens, one range of up to a chunk per slot, until the prefill
+        budget (while decode lanes are live) or the stream width W is
+        spent — the same fairness + progress-floor semantics as
+        _advance_chunked_prefills (the first range always proceeds; the
+        budget is a soft bound at range granularity). Returns
+        [(slot_idx, slot, take)]; empty means no prefill work this
+        iteration (steady-state decode keeps the K-step block path)."""
+        W = self._ragged_width
+        decode_live = bool(self._active.any())
+        budget = min(self._prefill_budget, W) if decode_live else W
+        ranges: list = []
+        spent = 0
+        B = len(self._slots)
+        starved = None
+        for off in range(B):
+            i = (self._chunk_rr + off) % B
+            s = self._slots[i]
+            if s is None or s.pending is None:
+                continue
+            if s.request.cancelled.is_set():
+                self._finish(i, error="cancelled")
+                continue
+            if self._deadline_expired(s.request):
+                # Expired mid-prefill: remaining ranges never dispatch.
+                self.metrics.on_deadline_expired("prefill")
+                self._finish(i, error=f"{DEADLINE_MSG} during prefill")
+                continue
+            if spent >= budget and ranges:
+                starved = i     # goes first next iteration
+                break
+            take = min(self._chunk, len(s.pending) - s.filled, W - spent)
+            if take <= 0:
+                if ranges:
+                    starved = i
+                break
+            ranges.append((i, s, take))
+            spent += take
+        if starved is not None:
+            self._chunk_rr = starved
+        else:
+            self._chunk_rr = (self._chunk_rr + 1) % B
+        return ranges
+
+    def _dispatch_ragged(self, ranges: list):
+        """ONE flat mixed prefill+decode dispatch (ISSUE 12): the token
+        ranges from _build_ragged_batch plus every decode lane's single
+        token, through the resident ragged executable. Returns an
+        _InflightBlock whose packed [1, B] decode emissions ride the
+        lookahead pipeline's _process_step unchanged (None on a
+        contained prefill failure — the caller falls through to the
+        plain paths)."""
+        cfg = self.config
+        W = self._ragged_width
+        B = cfg.max_decode_slots
+        P = cfg.pages_per_seq
+        pre_tokens = np.zeros((W,), np.int32)
+        pre_pos = np.zeros((W,), np.int32)
+        pre_tidx = np.full((W,), B, np.int32)     # B → garbage table row
+        pre_tables = np.zeros((B, P), np.int32)
+        rng_start = np.full((B,), W, np.int32)    # unused → past the end
+        rng_len = np.zeros((B,), np.int32)
+        rng_kv = np.zeros((B,), np.int32)
+        rng_tidx = np.full((B,), B, np.int32)
+        smp_idx = np.zeros((B,), np.int32)
+        smp_pos = np.zeros((B,), np.int32)
+        smp_seeds = np.zeros((B, 2), np.int32)
+        smp_temp = np.zeros((B,), np.float32)
+        smp_top_p = np.ones((B,), np.float32)
+        smp_top_k = np.zeros((B,), np.int32)
+        off = 0
+        useful = 0
+        for r, (i, s, take) in enumerate(ranges):
+            pre_tokens[off:off + take] = s.pending[s.filled:s.filled + take]
+            pre_pos[off:off + take] = np.arange(s.filled, s.filled + take)
+            pre_tidx[off:off + take] = i
+            pre_tables[i] = s.table[0]
+            rng_start[r] = off
+            rng_len[r] = take
+            rng_kv[r] = s.filled + take
+            rng_tidx[r] = i
+            if s.filled + take >= len(s.pending):
+                # Final range: sample this slot's first token from its
+                # last prefill row at position key prompt_len — exactly
+                # _prefill_fn's start + last_rel + 1.
+                smp_idx[i] = off + take - 1
+                smp_pos[i] = s.filled + take
+                smp_seeds[i] = s.seed_row
+                smp_temp[i] = s.request.temperature
+                smp_top_p[i] = s.request.top_p
+                smp_top_k[i] = self._eff_top_k(s.request)
+            off += take
+            useful += take
+
+        dev = self._dev
+        act = self._active
+        lanes = int(act.sum())
+        # Static greedy variant, batch-keyed like the other dispatch
+        # paths: all live decode lanes AND all sampled-this-dispatch
+        # prefill rows greedy (non-final rows default 0.0 → neutral).
+        greedy = bool(np.all(self._temperature[act] == 0.0)) and bool(
+            np.all(smp_temp == 0.0)
+        )
+        self._depth_target = self._depth
+        self._last_dispatch_steps = 1
+        gap_ms = self.metrics.on_dispatch(lanes, 1, slots=B)
+        # Padding-waste accounting: the device computes W prefill rows
+        # of which `useful` carry real prompt tokens (decode rows are
+        # charged by on_dispatch's slots/lanes split).
+        self.metrics.on_padding_tokens(W, useful)
+        self.metrics.on_prefill_interleave(useful, lanes > 0)
+        live = tuple(int(i) for i in np.flatnonzero(act))
+        put = partial(jax.device_put, device=self._repl)
+        try:
+            if self._faults is not None:
+                self._faults.maybe_raise(
+                    "prefill-error", replica=self.replica_id
+                )
+            with jax.profiler.TraceAnnotation("polykey/ragged"):
+                (packed_dev, last_dev, seq_dev, act_dev, first_dev,
+                 self.paged) = self._jit_ragged(
+                    self.params, self.model_cfg, self.paged,
+                    dev["last_tokens"], dev["seq_lens"],
+                    dev["page_tables"], dev["active"], dev["caps"],
+                    dev["seeds"], dev["temperature"], dev["top_p"],
+                    dev["top_k"],
+                    put(pre_tokens), put(pre_pos), put(pre_tidx),
+                    put(pre_tables),
+                    put(rng_start), put(rng_len), put(rng_kv),
+                    put(rng_tidx),
+                    put(smp_idx), put(smp_pos), put(smp_seeds),
+                    put(smp_temp), put(smp_top_p), put(smp_top_k),
+                    greedy=greedy, eos_id=self.tokenizer.eos_id,
+                    candidates=self.config.top_p_candidates,
+                    mesh=self.mesh,
+                )
+                dev["last_tokens"] = last_dev
+                dev["seq_lens"] = seq_dev
+                dev["active"] = act_dev
+        except Exception as e:
+            # Contain to the ranged slots (each must be finished or its
+            # client hangs — the prefill-group containment contract);
+            # the conservative dirty flag re-folds mirrors next
+            # iteration. Decode lanes keep their state: the failure
+            # (fault injection raises before dispatch) never advanced
+            # them.
+            for i, s, _take in ranges:
+                if self._slots[i] is s:
+                    self._finish(i, error=f"prefill failed: {e}")
+            self._dev_dirty = True
+            return None
+        try:
+            packed_dev.copy_to_host_async()
+        except Exception:
+            # Best-effort copy hint only (same as the block dispatch).
+            pass
+        self._dispatch_seq += 1
+        if self.timeline is not None:
+            self.timeline.dispatch(
+                self._dispatch_seq, "ragged", lanes, 1, gap_ms
+            )
+        for i, s, take in ranges:
+            final = s.filled + take >= len(s.pending)
+            if self.timeline is not None:
+                self.timeline.prefill(i, take, final)
+            if final:
+                # The sampled first token (row i of the ragged call's
+                # first-token vector, still device-resident) activates
+                # the lane via the usual merge — it joins the NEXT
+                # dispatch, exactly like a bucketed admission.
+                self._merge_slot(i, s, first_dev, i)
+            else:
+                s.filled += take
+        return _InflightBlock(
+            "plain", packed_dev, self._snapshot_requests(),
+            self._dispatch_seq, gap_ms, live,
+        )
 
     def _compile_warmup(self) -> None:
         """Pre-compile the greedy prefill group shapes and the greedy
@@ -1448,7 +1826,45 @@ class InferenceEngine:
         self._upload_slot_state()
         dev = self._dev
         zrow = np.zeros((cfg.pages_per_seq,), np.int32)
-        for bucket in cfg.prefill_buckets:
+        if self._ragged:
+            # Ragged mode: the per-bucket prefill executables never
+            # compile — ONE ragged executable per greedy variant serves
+            # every admission and chunk shape (the census collapse GL001
+            # asserts). The lane merge warms against the ragged call's
+            # own first-token output (committedness is part of the jit
+            # key, same rule as the bucketed warmup below).
+            W = self._ragged_width
+            put = partial(jax.device_put, device=self._repl)
+            pre = tuple(
+                put(a) for a in
+                ragged_zero_operands(B, W, cfg.pages_per_seq)
+            )
+            first_dev = None
+            for greedy in greedy_variants:
+                (_, dev["last_tokens"], dev["seq_lens"], dev["active"],
+                 first_dev, self.paged) = self._jit_ragged(
+                    self.params, self.model_cfg, self.paged,
+                    dev["last_tokens"], dev["seq_lens"],
+                    dev["page_tables"], dev["active"], dev["caps"],
+                    dev["seeds"], dev["temperature"], dev["top_p"],
+                    dev["top_k"], *pre,
+                    greedy=greedy, eos_id=self.tokenizer.eos_id,
+                    candidates=self.config.top_p_candidates,
+                    mesh=self.mesh,
+                )
+            self._jit_merge(
+                dev["last_tokens"], dev["seq_lens"],
+                dev["page_tables"], dev["active"], dev["caps"],
+                dev["temperature"], dev["top_p"], dev["top_k"],
+                dev["seeds"],
+                first_dev, np.int32(0), np.int32(0),
+                np.int32(1), np.int32(2), np.float32(0.0),
+                np.float32(1.0), np.int32(0), zrow,
+                np.zeros((2,), np.int32),
+                eos_id=self.tokenizer.eos_id,
+            )
+        bucket_list = () if self._ragged else cfg.prefill_buckets
+        for bucket in bucket_list:
             for n in pads:
                 window = (
                     jax.device_put(
@@ -1798,6 +2214,8 @@ class InferenceEngine:
             return
         if self.timeline is not None:
             self.timeline.prefill(slot_idx, take, final)
+        # The chunk window is C tokens wide; `take` carried real ones.
+        self.metrics.on_padding_tokens(C, take)
         if final:
             # The final chunk's sampled token activates the lane (on-device
             # merge; the host delivers it to the client once its async copy
@@ -1840,6 +2258,20 @@ class InferenceEngine:
             # already drained in-flight blocks).
             self._resolve_prefills(block=True)
             self._upload_slot_state()
+        if self._ragged:
+            # Ragged mode (ISSUE 12): any pending prefill work rides ONE
+            # mixed dispatch with the decode lanes' single tokens; pure-
+            # decode iterations fall through to the K-step block below
+            # (the PR 6 amortization is untouched at steady state).
+            ranges = self._build_ragged_batch()
+            if ranges:
+                block = self._dispatch_ragged(ranges)
+                if block is not None:
+                    return block
+            if not self._active.any():
+                # Prefill-only iteration that dispatched nothing (e.g.
+                # contained failure): no decode block to fall through to.
+                return None
         dev = self._dev
         # top_p composes with speculation via truncated rejection sampling
         # (sampling.truncated_dist), which needs the top-k prefilter
@@ -1872,7 +2304,9 @@ class InferenceEngine:
             # draft steps + one verify — the step weight that makes its
             # lane-seconds comparable to a plain K-step block's.
             lanes = int(act.sum())
-            gap_ms = self.metrics.on_dispatch(lanes, self._gamma + 1)
+            gap_ms = self.metrics.on_dispatch(
+                lanes, self._gamma + 1, slots=len(self._slots)
+            )
             live = tuple(int(i) for i in np.flatnonzero(act))
             data = self._dispatch_spec(dev, spec_candidates)
             self._dispatch_seq += 1
@@ -1916,7 +2350,7 @@ class InferenceEngine:
             blocks_needed,
         )
         lanes = int(act.sum())
-        gap_ms = self.metrics.on_dispatch(lanes, steps)
+        gap_ms = self.metrics.on_dispatch(lanes, steps, slots=len(self._slots))
         live = tuple(int(i) for i in np.flatnonzero(act))
         with jax.profiler.TraceAnnotation("polykey/decode"):
             (packed_dev, last_dev, seq_dev, act_dev,
@@ -2084,7 +2518,7 @@ class InferenceEngine:
             return
         t_sync = time.monotonic()
         with _host_crossing():
-            # polylint: disable=PL001(block resolve point; one packed D2H read per block)
+            # polylint: disable=PL001(block resolve point; one packed D2H read per block), PL008(process-side read; reachable from dispatch only via the ragged merge's dev-dirty cold path, behind a full pipeline drain)
             packed = np.asarray(data)     # [K, B]; blocks until block done
         # Host stall: how long the processed frontier blocked waiting for
         # this block's copy to land — ~0 when lookahead hid the roundtrip,
@@ -2219,9 +2653,9 @@ class InferenceEngine:
         packed_dev, stats_dev = data
         t_sync = time.monotonic()
         with _host_crossing():
-            # polylint: disable=PL001(spec-round resolve point; packed D2H read)
+            # polylint: disable=PL001(spec-round resolve point; packed D2H read), PL008(process-side read; dispatch reaches it only via the merge drain cold path)
             packed = np.asarray(packed_dev)  # [B, gamma+1]; blocks until done
-            # polylint: disable=PL001(device-owned acceptance stats feed the gamma dial)
+            # polylint: disable=PL001(device-owned acceptance stats feed the gamma dial), PL008(process-side read; dispatch reaches it only via the merge drain cold path)
             accepted, proposed = (int(v) for v in np.asarray(stats_dev))
         stall_ms = (time.monotonic() - t_sync) * 1e3
         self.metrics.on_process_block(

@@ -121,6 +121,17 @@ class EngineMetrics:
         # overshoot semantics).
         self.prefill_tokens_total = 0
         self.interleave_max_tokens = 0
+        # Padding-waste accounting (ISSUE 12): per dispatch, how many
+        # token rows the device COMPUTED vs how many were useful work.
+        # Decode blocks charge slots×steps dispatched / lanes×steps
+        # useful (dead-lane padding); bucketed prefill charges the
+        # padded group width (n_pad × bucket, or the chunk width C) vs
+        # the real token count; the ragged dispatch charges its static
+        # stream width vs the tokens appended. tokens_useful /
+        # tokens_dispatched is the occupancy-soak's padding-waste
+        # ratio — the number the ragged path exists to raise.
+        self.tokens_dispatched_total = 0
+        self.tokens_useful_total = 0
         # Lookahead pipeline accounting (ISSUE 6): per processed block,
         # the OBSERVED lookahead (blocks dispatched after it, before its
         # readback — ≥1 means the dispatch frontier ran ahead of the
@@ -205,14 +216,29 @@ class EngineMetrics:
             if decode_live and tokens > self.interleave_max_tokens:
                 self.interleave_max_tokens = tokens
 
-    def on_dispatch(self, lanes: int, steps: int) -> float:
+    def on_padding_tokens(self, dispatched: int, useful: int) -> None:
+        """Token rows computed vs useful for one prefill dispatch
+        (bucketed group / chunk / ragged stream) — the padding-waste
+        counters the occupancy soak diffs."""
+        with self._lock:
+            self.tokens_dispatched_total += dispatched
+            self.tokens_useful_total += useful
+
+    def on_dispatch(self, lanes: int, steps: int,
+                    slots: int = 0) -> float:
         """One decode block (or spec round) dispatched with `lanes` live
         decode lanes for `steps` device steps. Returns the counted
         dispatch gap in ms (0.0 for the first dispatch or an idle-capped
-        gap) — the attribution window the engine charges to the block."""
+        gap) — the attribution window the engine charges to the block.
+        `slots` (the static batch width) feeds the padding-waste
+        counters: the device computes slots×steps token rows of which
+        lanes×steps are useful."""
         now = time.monotonic()
         counted_gap = 0.0
         with self._lock:
+            if slots > 0:
+                self.tokens_dispatched_total += slots * steps
+                self.tokens_useful_total += lanes * steps
             if self._last_dispatch_t:
                 gap_ms = (now - self._last_dispatch_t) * 1e3
                 # Idle gaps (no active lanes → no dispatch) are load
@@ -256,6 +282,8 @@ class EngineMetrics:
                 "lane_steps": self.lane_steps,
                 "steps_dispatched": self.steps_dispatched,
                 "prefill_tokens_total": self.prefill_tokens_total,
+                "tokens_dispatched_total": self.tokens_dispatched_total,
+                "tokens_useful_total": self.tokens_useful_total,
                 "blocks_processed": self.blocks_processed,
                 "blocks_synced": self.blocks_synced,
                 "lookahead_sum": self.lookahead_sum,
@@ -290,6 +318,10 @@ class EngineMetrics:
                 "dispatch_gap_ms_total": self.dispatch_gap_ms_total,
                 "dispatch_gaps": self.dispatch_gaps,
                 "device_busy_ms_total": self.device_busy_ms_total,
+                # Padding-waste counters (ISSUE 12): harnesses diff these
+                # over a window; useful/dispatched is the waste ratio.
+                "tokens_dispatched_total": self.tokens_dispatched_total,
+                "tokens_useful_total": self.tokens_useful_total,
             }
 
     def on_admit(self) -> None:
@@ -403,6 +435,15 @@ class EngineMetrics:
                 "lanes_ewma": round(self._lanes_ewma, 2),
                 "prefill_tokens_total": self.prefill_tokens_total,
                 "interleave_max_tokens": self.interleave_max_tokens,
+                "tokens_dispatched": self.tokens_dispatched_total,
+                "tokens_useful": self.tokens_useful_total,
+                # Fraction of computed token rows that were useful work
+                # (1 − padding waste) — the dial the ragged path raises.
+                "tokens_useful_fraction": (
+                    round(self.tokens_useful_total
+                          / self.tokens_dispatched_total, 4)
+                    if self.tokens_dispatched_total else None
+                ),
                 "blocks_processed": self.blocks_processed,
                 "lookahead_observed_max": self.lookahead_max,
                 "lookahead_observed_mean": (

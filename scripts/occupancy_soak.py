@@ -52,11 +52,15 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def build_engine(args):
+def build_engine(args, ragged: bool = False):
     from polykey_tpu.engine.config import EngineConfig
     from polykey_tpu.engine.engine import InferenceEngine
 
     cfg = EngineConfig(
+        # Ragged dispatch (ISSUE 12): admissions/chunks ride one flat
+        # mixed prefill+decode dispatch instead of the bucket table —
+        # the padding-waste A/B this harness measures (--ab-ragged).
+        ragged_dispatch=ragged,
         model=args.model,
         dtype="float32",
         kv_dtype=args.kv_dtype,
@@ -112,13 +116,87 @@ def main() -> int:
                     help="exit 1 when measured avg_lanes/slots is below")
     ap.add_argument("--seed", type=int, default=29)
     ap.add_argument("--out", default="")
+    ap.add_argument("--ragged", action="store_true",
+                    help="enable the ragged mixed prefill+decode "
+                         "dispatch (ISSUE 12)")
+    ap.add_argument("--ab-ragged", action="store_true",
+                    help="run the soak TWICE — bucketed baseline then "
+                         "ragged — same seed and knobs, and write ONE "
+                         "combined artifact with the measured "
+                         "padding-waste reduction (ISSUE 12 acceptance)")
     ap.add_argument("--timeline", default="",
                     help="also export the engine's flight-deck timeline "
                          "as Perfetto JSON to this path (ISSUE 10: the "
                          "committed perf/timeline_*.json artifacts — "
                          "open at https://ui.perfetto.dev)")
     args = ap.parse_args()
+    return run_main(args)
 
+
+def run_main(args) -> int:
+    if args.ab_ragged:
+        if args.timeline:
+            # One flag, two engines — ambiguous target. Refuse loudly
+            # instead of silently writing neither.
+            log("--timeline is not supported with --ab-ragged (two "
+                "engines, one path); run the modes separately for a "
+                "Perfetto trace")
+            return 2
+        log("=== A/B: bucketed baseline ===")
+        bucketed = run_soak(args, ragged=False)
+        log("=== A/B: ragged ===")
+        ragged = run_soak(args, ragged=True)
+        result = {
+            "mode": "ab_ragged",
+            "bucketed": bucketed,
+            "ragged": ragged,
+            # The acceptance number: padding waste (1 − useful/dispatched)
+            # bucketed vs ragged at equal offered load and seed.
+            "padding_waste_bucketed": bucketed["padding_waste"],
+            "padding_waste_ragged": ragged["padding_waste"],
+            "waste_reduction": round(
+                bucketed["padding_waste"] - ragged["padding_waste"], 4
+            ),
+        }
+        failures = (bucketed["failed_in_window"] + ragged["failed_in_window"])
+    else:
+        result = run_soak(args, ragged=args.ragged)
+        failures = result["failed_in_window"]
+
+    out_path = args.out or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "perf",
+        f"occupancy_soak_{time.strftime('%Y-%m-%d', time.gmtime())}.json",
+    )
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+        f.write("\n")
+    log(f"wrote {out_path}")
+    print(json.dumps(result))
+
+    if failures:
+        log(f"FAIL: {failures} requests errored inside the window")
+        return 1
+    gates = (
+        [result] if not args.ab_ragged
+        else [result["bucketed"], result["ragged"]]
+    )
+    for res in gates:
+        if args.min_occupancy and res["occupancy"] < args.min_occupancy:
+            log(f"FAIL: occupancy {res['occupancy']:.3f} < "
+                f"{args.min_occupancy}")
+            return 1
+        log(f"OK: {res['avg_lanes']:.2f}/{args.slots} lanes "
+            f"(occupancy {res['occupancy']:.3f}, padding waste "
+            f"{res['padding_waste']:.3f}) over {res['window_s']:.0f}s")
+    if args.ab_ragged:
+        log(f"padding waste: bucketed {result['padding_waste_bucketed']:.3f}"
+            f" -> ragged {result['padding_waste_ragged']:.3f} "
+            f"(reduction {result['waste_reduction']:.3f})")
+    return 0
+
+
+def run_soak(args, ragged: bool) -> dict:
     rng = np.random.default_rng(args.seed)
 
     def prompt() -> str:
@@ -136,7 +214,7 @@ def main() -> int:
 
     from polykey_tpu.engine.engine import GenRequest
 
-    engine = build_engine(args)
+    engine = build_engine(args, ragged=ragged)
     try:
         def completed() -> int:
             return (engine.metrics.requests_completed
@@ -243,9 +321,15 @@ def main() -> int:
         occupancy = avg_lanes / args.slots
         tokens = stats1["tokens_generated"] - stats0["tokens_generated"]
 
+        tokens_dispatched = (snap1["tokens_dispatched_total"]
+                             - snap0["tokens_dispatched_total"])
+        tokens_useful = (snap1["tokens_useful_total"]
+                         - snap0["tokens_useful_total"])
+
         result = {
             "config": {
                 "slots": args.slots, "model": args.model,
+                "ragged": ragged,
                 "kv_dtype": args.kv_dtype or "fp",
                 "max_new": args.max_new, "block_steps": args.block,
                 "prefill_budget": stats1["prefill_budget"],
@@ -299,6 +383,18 @@ def main() -> int:
                 / max(1e-9, snap1["dispatch_gap_ms_total"]
                       - snap0["dispatch_gap_ms_total"]), 4),
             "tok_s": round(tokens / window_s, 1) if window_s else None,
+            # Padding-waste accounting (ISSUE 12), first-class: token
+            # rows the device computed vs rows that were useful work
+            # over the window (decode dead lanes + prefill padding —
+            # bucket/pad-group padding on the bucketed path, stream-tail
+            # padding on the ragged path). waste = 1 − useful/dispatched
+            # is the number the ragged dispatch exists to cut.
+            "tokens_dispatched": tokens_dispatched,
+            "tokens_useful": tokens_useful,
+            "tokens_useful_fraction": round(
+                tokens_useful / max(1, tokens_dispatched), 4),
+            "padding_waste": round(
+                1.0 - tokens_useful / max(1, tokens_dispatched), 4),
             "interleave_max_tokens": stats1["interleave_max_tokens"],
             # Lifetime TTFT percentiles (incl. ramp — queue wait under
             # deliberate oversubscription is the honest shape here).
@@ -309,18 +405,7 @@ def main() -> int:
                 "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         }
 
-        out_path = args.out or os.path.join(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            "perf",
-            f"occupancy_soak_{time.strftime('%Y-%m-%d', time.gmtime())}.json",
-        )
-        with open(out_path, "w") as f:
-            json.dump(result, f, indent=1)
-            f.write("\n")
-        log(f"wrote {out_path}")
-        print(json.dumps(result))
-
-        if args.timeline and engine.timeline is not None:
+        if args.timeline and not args.ab_ragged and engine.timeline is not None:
             from polykey_tpu.obs.timeline import engine_timelines, to_perfetto
 
             trace = to_perfetto(
@@ -340,17 +425,7 @@ def main() -> int:
             log(f"wrote timeline {args.timeline} "
                 f"({len(trace['traceEvents'])} events)")
 
-        if result["failed_in_window"]:
-            log(f"FAIL: {result['failed_in_window']} requests errored "
-                "inside the window")
-            return 1
-        if args.min_occupancy and occupancy < args.min_occupancy:
-            log(f"FAIL: occupancy {occupancy:.3f} < "
-                f"{args.min_occupancy} ({avg_lanes:.2f}/{args.slots} lanes)")
-            return 1
-        log(f"OK: {avg_lanes:.2f}/{args.slots} lanes "
-            f"(occupancy {occupancy:.3f}) over {window_s:.0f}s")
-        return 0
+        return result
     finally:
         engine.shutdown()
 
