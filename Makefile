@@ -11,7 +11,8 @@ BUILD_DIR := build
 	kernel-check tunnel-probe bench-tokenizer tpu-watch metrics-smoke \
 	obs-smoke chaos-smoke print-chaos occupancy-smoke occupancy-soak \
 	failover-smoke failover-soak timeline-capture perf-gate \
-	perf-gate-reference flightwatch ragged-smoke ragged-soak
+	perf-gate-reference flightwatch ragged-smoke ragged-soak \
+	disagg-smoke disagg-soak
 
 help: ## Show available targets
 	@grep -E '^[a-zA-Z_-]+:.*?## .*$$' $(MAKEFILE_LIST) | \
@@ -90,7 +91,8 @@ timeline-capture: ## Capture a CPU soak timeline to perf/ (Perfetto JSON)
 # retries, health transitions, replica-pool failover/resume — all on
 # CPU with test-scaled timeouts.
 CHAOS_TESTS := tests/test_chaos.py tests/test_faults.py tests/test_health.py \
-	tests/test_client_retry.py tests/test_replica_pool.py
+	tests/test_client_retry.py tests/test_replica_pool.py \
+	tests/test_disagg.py tests/test_kv_wire.py
 
 chaos-smoke: ## Run the fault-injection/resilience test suite on CPU
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest $(CHAOS_TESTS) -q
@@ -136,6 +138,22 @@ failover-soak: ## The 3-replica / 30 s acceptance drill (writes perf/)
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/failover_soak.py \
 	  --replicas 3 --duration 30 \
 	  --out perf/failover_soak_$$(date -u +%Y%m%d_%H%M%S).json
+
+# Disaggregated-tier drill (ISSUE 13): real worker PROCESSES over
+# localhost, a prefill worker killed mid-handoff + a decode worker
+# killed mid-stream — gates zero failed RPCs, token-complete streams,
+# and greedy streams bit-identical to a single-process reference run.
+# Smoke scale (2 prefill + 1 decode) for CI; the acceptance artifact
+# comes from disagg-soak (2x2, both kills, longer window).
+disagg-smoke: ## Kill-workers-mid-handoff drill at CI scale (2p+1d, 10 s)
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/failover_soak.py --disagg \
+	  --prefill 2 --decode 1 --duration 10 \
+	  --out /tmp/disagg_smoke.json
+
+disagg-soak: ## The 2x2-worker / 30 s acceptance drill (writes perf/)
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/failover_soak.py --disagg \
+	  --prefill 2 --decode 2 --duration 30 \
+	  --out perf/disagg_soak_$$(date -u +%Y%m%d_%H%M%S).json
 
 print-chaos: ## Print the chaos test file list (CI's single source of truth)
 	@echo $(CHAOS_TESTS)
@@ -220,11 +238,12 @@ scan: ## Security scan (Trivy fs over the tree + lockfile, CRITICAL/HIGH gate)
 	  --scanners vuln,secret \
 	  --severity CRITICAL,HIGH
 
-ci-check: ## Run the CI pipeline locally: lint+polylint+graphlint, chaos, failover, occupancy, ragged, obs, perf-gate, tests, native(+asan), scan
+ci-check: ## Run the CI pipeline locally: lint+polylint+graphlint, chaos, failover, disagg, occupancy, ragged, obs, perf-gate, tests, native(+asan), scan
 	@$(MAKE) lint
 	@$(MAKE) graphlint
 	@$(MAKE) chaos-smoke
 	@$(MAKE) failover-smoke
+	@$(MAKE) disagg-smoke
 	@$(MAKE) occupancy-smoke
 	@$(MAKE) ragged-smoke
 	@$(MAKE) obs-smoke

@@ -332,6 +332,35 @@ class EngineConfig:
     # exactly the single-engine contract).
     max_reroutes: int = 3
 
+    # -- Disaggregated prefill/decode tiers (ISSUE 13) -----------------------
+    # POLYKEY_DISAGG="PxD" (e.g. "2x2") or "prefill=P,decode=D" serves
+    # through CROSS-PROCESS worker tiers (engine/disagg_pool.py): P
+    # prefill-tier + D decode-tier worker processes on localhost, each a
+    # supervised engine behind a socket control plane
+    # (engine/worker.py), with finished prefill KV shipped to a
+    # NetKV-scored decode worker in the versioned kv_cache wire format.
+    # "" (the default) builds NO worker processes and NO pool — every
+    # single-process path is byte-identical. Mutually exclusive with
+    # POLYKEY_REPLICAS > 1 (the in-process stage-(a) pool).
+    disagg: str = ""
+    # This engine's tier identity inside a disaggregated worker
+    # ("prefill" / "decode"; set by engine/worker.py via
+    # dataclasses.replace, not an env knob). Scopes ":tier=" fault
+    # targeting; "" for every non-disaggregated engine.
+    disagg_tier: str = ""
+    # Worker liveness: the coordinator heartbeats every worker's control
+    # plane at this interval and declares death after `disagg_miss`
+    # consecutive misses (process exit via poll() is detected
+    # immediately either way). POLYKEY_DISAGG_HEARTBEAT /
+    # POLYKEY_DISAGG_MISS.
+    disagg_heartbeat_s: float = 0.5
+    disagg_miss: int = 3
+    # How long a re-route waits for a tier to regain a SERVING worker
+    # (a supervised worker restart takes seconds on CPU; giving up
+    # sooner would turn every restart window into failed RPCs).
+    # POLYKEY_DISAGG_RECOVERY_WAIT.
+    disagg_recovery_wait_s: float = 30.0
+
     @property
     def pages_per_seq(self) -> int:
         return self.max_seq_len // self.page_size
@@ -446,7 +475,46 @@ class EngineConfig:
                 "POLYKEY_ROUTE_W_DELAY", cls.route_delay_weight
             ),
             max_reroutes=_env_int("POLYKEY_MAX_REROUTES", cls.max_reroutes),
+            disagg=os.environ.get("POLYKEY_DISAGG", cls.disagg),
+            disagg_heartbeat_s=_env_float(
+                "POLYKEY_DISAGG_HEARTBEAT", cls.disagg_heartbeat_s
+            ),
+            disagg_miss=_env_int("POLYKEY_DISAGG_MISS", cls.disagg_miss),
+            disagg_recovery_wait_s=_env_float(
+                "POLYKEY_DISAGG_RECOVERY_WAIT", cls.disagg_recovery_wait_s
+            ),
         )
+
+    def disagg_tiers(self) -> Optional[tuple[int, int]]:
+        """Parse the `disagg` spec into (prefill_workers, decode_workers),
+        or None when unset. Accepts "PxD" ("2x2") and
+        "prefill=P,decode=D" (any order). Raises ValueError on malformed
+        specs — a typo must not silently serve single-process."""
+        spec = self.disagg.strip().lower()
+        if not spec:
+            return None
+        try:
+            if "x" in spec and "=" not in spec:
+                p_s, d_s = spec.split("x", 1)
+                tiers = {"prefill": int(p_s), "decode": int(d_s)}
+            else:
+                tiers = {}
+                for part in spec.split(","):
+                    key, _, value = part.strip().partition("=")
+                    tiers[key.strip()] = int(value)
+                if set(tiers) != {"prefill", "decode"}:
+                    raise ValueError(f"tiers {sorted(tiers)}")
+        except (ValueError, TypeError) as e:
+            raise ValueError(
+                f"malformed POLYKEY_DISAGG spec {self.disagg!r}: expected "
+                f"'PxD' or 'prefill=P,decode=D' ({e})"
+            ) from None
+        if tiers["prefill"] < 1 or tiers["decode"] < 1:
+            raise ValueError(
+                "POLYKEY_DISAGG needs >= 1 worker per tier, got "
+                f"{self.disagg!r}"
+            )
+        return tiers["prefill"], tiers["decode"]
 
     def validate(self) -> None:
         if self.max_seq_len % self.page_size != 0:
@@ -521,6 +589,31 @@ class EngineConfig:
             raise ValueError("replica index must be >= 0")
         if self.max_reroutes < 0:
             raise ValueError("max_reroutes must be >= 0 (0 → no failover)")
+        self.disagg_tiers()      # raises on a malformed spec
+        if self.disagg and self.replicas > 1:
+            raise ValueError(
+                "POLYKEY_DISAGG and POLYKEY_REPLICAS>1 are mutually "
+                "exclusive: the disaggregated tier replaces the "
+                "in-process replica pool (each tier already scales by "
+                "worker count)"
+            )
+        if self.disagg and self.draft_model is not None:
+            raise ValueError(
+                "disaggregated tiers have no speculative formulation yet "
+                "(the KV handoff ships one pool; the draft pool would "
+                "need its own) — unset POLYKEY_DISAGG or the draft model"
+            )
+        if self.disagg_tier not in ("", "prefill", "decode"):
+            raise ValueError(
+                f"disagg_tier must be '', 'prefill', or 'decode'; got "
+                f"{self.disagg_tier!r}"
+            )
+        if self.disagg_heartbeat_s <= 0:
+            raise ValueError("disagg_heartbeat_s must be > 0")
+        if self.disagg_miss < 1:
+            raise ValueError("disagg_miss must be >= 1")
+        if self.disagg_recovery_wait_s < 0:
+            raise ValueError("disagg_recovery_wait_s must be >= 0")
         if self.route_prefix_weight < 0 or self.route_delay_weight < 0:
             raise ValueError("routing weights must be >= 0")
         for name in ("tp", "dp", "ep", "sp", "pp", "num_slices"):

@@ -1,0 +1,1106 @@
+"""Disaggregated prefill/decode tiers: cross-process workers with
+crash-safe KV handoff (ISSUE 13, ROADMAP item 2 stages (b)/(c)).
+
+PR 7's replica pool scaled the engine INSIDE one process: a prefill
+burst still steals decode device time, and a process death still takes
+every replica down. This coordinator takes the same contracts across
+process boundaries:
+
+- **Tiers.** ``POLYKEY_DISAGG="PxD"`` runs P prefill-tier and D
+  decode-tier worker processes (engine/worker.py) on localhost, each an
+  independently supervised engine behind a socket control plane. Prefill
+  never shares a process with decode, so tier capacity scales
+  independently and a prefill burst cannot inflate decode ITL.
+- **KV handoff.** A finished prefill ships as one versioned wire blob
+  (kv_cache.serialize_kv_state: pages + block-table order + prompt/seed
+  metadata, raw bytes — fp32 and int8 pair-form pools round-trip
+  bit-identically). The hand-over is two-phase: the prefill worker
+  RETAINS the serialized state until the coordinator releases it after
+  decode completes, so a decode-side death re-ships the same blob
+  instead of re-running prefill.
+- **NetKV routing** (PAPERS.md): the decode worker is chosen by
+  estimated KV-transfer cost (blob bytes over a measured per-worker
+  bandwidth EWMA) plus the queue-delay EWMA its heartbeat reports —
+  route to where the transfer is cheap AND the queue is short. Prefill
+  routing is session-sticky: multi-turn prompts hash to a session key
+  (first page-aligned token window) and return to the worker holding
+  their warm prefix; a restarted worker re-advertises its persisted
+  prefix index, so stickiness survives worker death.
+- **Crash safety.** Worker death at ANY phase — queued, mid-prefill,
+  mid-handoff, mid-decode — re-routes through the PR 7 resume machinery:
+  the orchestration replays from the earliest surviving artifact (the
+  retained blob if the prefill side still holds it, a fresh prefill
+  otherwise) with the delivered token prefix suppressed, bounded by
+  ``max_reroutes``. Greedy streams stay bit-identical to a
+  single-process run (same params/seed/positions; the decode worker
+  replays and the coordinator drops what the client already holds).
+  Heartbeat liveness (+ process exit) feeds the PR 7 replica state
+  machine: NEW → SERVING → DRAINING → RESTARTING → DEAD, with aggregate
+  health flipping only when a TIER loses its last serving worker.
+
+``POLYKEY_DISAGG`` unset builds no processes and no pool — every
+single-process path is untouched. The pool quacks like an engine where
+the gateway needs it to (config/tokenizer/submit/stats/dead/shutdown),
+exactly like ReplicaPool.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..obs.histogram import Histogram
+from ..obs.timeline import TimelineRecorder
+from .config import EngineConfig
+from .engine import EngineDeadError, EngineOverloadedError, GenRequest
+from .kv_cache import KVWireError, validate_kv_blob
+from .replica_pool import _ADDITIVE_KEYS  # shared aggregation contract
+from .replica_pool import DEAD, DRAINING, NEW, RESTARTING, SERVING
+from .tokenizer import load_tokenizer
+from .worker import WorkerConn, session_key
+
+PREFILL = "prefill"
+DECODE = "decode"
+
+# Handoff outcome labels (polykey_handoffs_total{outcome}).
+_OUTCOMES = ("ok", "retried", "aborted")
+
+# Bandwidth prior before the first measured ship (bytes/s). Localhost
+# sockets measure orders of magnitude above this; the prior only has to
+# make the transfer term non-zero so routing is defined on a cold pool.
+_BW_PRIOR = 200e6
+
+
+class _HandoffRetry(Exception):
+    """One attempt failed at a recoverable phase. `restart_prefill`
+    says whether the retained blob is gone/bad (re-run prefill) or
+    still shippable (re-route decode only); `mark_down` distinguishes
+    worker death (heartbeat will confirm; re-route now) from flow
+    control like a shed (the worker is fine, just busy)."""
+
+    def __init__(self, cause: str, phase: str, restart_prefill: bool,
+                 mark_down: bool = True, flow_control: bool = False,
+                 retry_after_s: float = 0.0):
+        super().__init__(cause)
+        self.phase = phase
+        self.restart_prefill = restart_prefill
+        self.mark_down = mark_down
+        # Flow control (a worker SHED, not a worker death): the retry
+        # waits out the worker's retry-after hint, never burns the
+        # re-route budget, and never counts as a failover metric —
+        # mirroring how a shed at the gateway is RESOURCE_EXHAUSTED,
+        # not a failure.
+        self.flow_control = flow_control
+        self.retry_after_s = retry_after_s
+        self.delivered = 0
+
+
+@dataclass
+class _Worker:
+    tier: str
+    index: int
+    addr: Optional[tuple] = None
+    proc: Optional[subprocess.Popen] = None
+    spawn: Optional[Callable[[], tuple]] = None   # () -> (addr, proc)
+    state: str = NEW
+    misses: int = 0
+    restarts: int = 0
+    restart_times: list = field(default_factory=list)
+    ping: dict = field(default_factory=dict)
+    stats: dict = field(default_factory=dict)
+    bw_ewma: float = 0.0          # measured ship bandwidth, bytes/s
+
+    @property
+    def name(self) -> str:
+        return f"{self.tier}/{self.index}"
+
+
+class DisaggPool:
+    """Engine-shaped coordinator over the prefill and decode worker
+    tiers. One orchestration thread per in-flight request drives the
+    prefill → handoff → decode pipeline over the workers' control
+    planes and forwards tokens into the request's out queue."""
+
+    def __init__(self, config: EngineConfig, health=None, logger=None,
+                 recorder=None):
+        config.validate()
+        self.config = config
+        self.health = health
+        self.logger = logger
+        self.recorder = recorder
+        self.tokenizer = load_tokenizer(config.tokenizer)
+        self.workers: list[_Worker] = []
+        self._lock = threading.Lock()
+        self._closing = False
+        self._serving_advertised = True
+        self._inflight = 0
+        self._heartbeat_thread: Optional[threading.Thread] = None
+        self._stop_heartbeat = threading.Event()
+        # Handoff observability (ISSUE 13 satellites): counters +
+        # latency histogram owned HERE (the coordinator is the only
+        # process that sees a handoff end to end), plus a pool-level
+        # timeline ring for handoff_start/ack/abort events
+        # (obs.timeline.to_perfetto renders notes on the engine-events
+        # track; /debug/timeline reaches it through engine_timelines).
+        self.handoffs = {outcome: 0 for outcome in _OUTCOMES}
+        self.handoff_bytes = 0
+        self.handoff_ms = Histogram()
+        self.timeline = (
+            TimelineRecorder(config.timeline_capacity)
+            if config.timeline_capacity > 0 else None
+        )
+        self.requests_rerouted = 0
+        self.streams_resumed = 0
+        # Session stickiness (stage (c)): session key → worker index,
+        # per tier. Prefill stickiness lands multi-turn users on their
+        # warm prefix; decode stickiness amortizes the router's
+        # transfer-cost learning per session.
+        self._sticky: dict[str, dict[str, int]] = {PREFILL: {}, DECODE: {}}
+        self._seed_rng = np.random.default_rng()
+        self._stats_cache: dict = {}
+        self._stats_cache_t = 0.0
+
+    # -- construction ---------------------------------------------------------
+
+    @classmethod
+    def create(
+        cls,
+        config: EngineConfig,
+        health=None,
+        logger=None,
+        obs=None,
+        seed: int = 0,
+        workers: Optional[list] = None,
+        restart_cb: Optional[Callable] = None,
+        state_dir: Optional[str] = None,
+        ready_timeout_s: float = 300.0,
+        heartbeat: bool = True,
+    ) -> "DisaggPool":
+        """Build and start a wired pool.
+
+        Default mode spawns ``config.disagg_tiers()`` worker PROCESSES
+        (``python -m polykey_tpu.engine.worker``) and learns their ports
+        from the readiness handshake. Tests pass ``workers`` as
+        ``[(tier, (host, port)), ...]`` for pre-started in-process
+        servers, plus ``restart_cb(worker) -> addr | None`` to stand in
+        for process respawn."""
+        tiers = config.disagg_tiers()
+        if tiers is None and workers is None:
+            raise ValueError("DisaggPool needs a POLYKEY_DISAGG spec or "
+                             "an explicit worker list")
+        recorder = obs.recorder if obs is not None else None
+        pool = cls(config, health=health, logger=logger, recorder=recorder)
+        pool._seed = seed
+        pool._state_dir = state_dir
+        pool._ready_timeout_s = ready_timeout_s
+        pool._restart_cb = restart_cb
+        if workers is not None:
+            counts: dict[str, int] = {}
+            for tier, addr in workers:
+                index = counts.get(tier, 0)
+                counts[tier] = index + 1
+                pool.workers.append(_Worker(
+                    tier=tier, index=index, addr=tuple(addr), state=SERVING,
+                ))
+        else:
+            n_prefill, n_decode = tiers
+            for tier, count in ((PREFILL, n_prefill), (DECODE, n_decode)):
+                for i in range(count):
+                    worker = _Worker(tier=tier, index=i)
+                    worker.spawn = pool._spawner(worker)
+                    pool.workers.append(worker)
+            # Spawn concurrently: each worker pays jax import + engine
+            # build + warmup before its readiness line, and the spawns
+            # are independent — serial boot would cost N × that wall.
+            spawn_errors: list = []
+
+            def _boot(worker: _Worker) -> None:
+                try:
+                    worker.addr, worker.proc = worker.spawn()
+                    worker.state = SERVING
+                except Exception as e:
+                    spawn_errors.append((worker.name, e))
+
+            boot_threads = [
+                threading.Thread(target=_boot, args=(w,), daemon=True)
+                for w in pool.workers
+            ]
+            for thread in boot_threads:
+                thread.start()
+            for thread in boot_threads:
+                thread.join(timeout=ready_timeout_s + 10)
+            if spawn_errors:
+                pool.shutdown()
+                name, error = spawn_errors[0]
+                raise RuntimeError(
+                    f"disagg worker {name} failed to start: {error}"
+                )
+        # Seed stickiness from the workers' persisted prefix indexes
+        # (warm rejoin: a restarted tier comes back knowing its users).
+        for worker in pool.workers:
+            pool._absorb_warm_sessions(worker)
+        if heartbeat:
+            pool._heartbeat_thread = threading.Thread(
+                target=pool._heartbeat_loop, name="polykey-disagg-heartbeat",
+                daemon=True,
+            )
+            pool._heartbeat_thread.start()
+        if recorder is not None:
+            recorder.event(
+                "disagg_pool_started",
+                prefill=sum(w.tier == PREFILL for w in pool.workers),
+                decode=sum(w.tier == DECODE for w in pool.workers),
+            )
+        if logger is not None:
+            logger.info(
+                "disagg pool started",
+                prefill=sum(w.tier == PREFILL for w in pool.workers),
+                decode=sum(w.tier == DECODE for w in pool.workers),
+                model=config.model,
+            )
+        return pool
+
+    def _spawner(self, worker: _Worker) -> Callable[[], tuple]:
+        """Process factory for one tier slot: spawn, wait for the
+        readiness handshake, return (addr, proc)."""
+
+        def spawn() -> tuple:
+            env = dict(os.environ)
+            # Ship THIS pool's config: workers rebuild EngineConfig from
+            # env, and a programmatically-constructed pool (soaks,
+            # tests) would otherwise spawn default-geometry engines —
+            # breaking bit-identity with the coordinator's reference.
+            env.update(_config_env(self.config))
+            env["POLYKEY_DISAGG"] = ""          # workers never recurse
+            env["POLYKEY_REPLICAS"] = "1"
+            env["POLYKEY_METRICS_PORT"] = "0"   # no port clash with the
+            # gateway's exposition sidecar
+            repo_root = os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))
+            ))
+            env["PYTHONPATH"] = (
+                repo_root + os.pathsep + env.get("PYTHONPATH", "")
+            ).rstrip(os.pathsep)
+            cmd = [
+                sys.executable, "-m", "polykey_tpu.engine.worker",
+                "--tier", worker.tier, "--replica", str(worker.index),
+                "--port", "0", "--seed", str(self._seed),
+            ]
+            stderr = subprocess.DEVNULL
+            if self._state_dir:
+                cmd += ["--state-dir", self._state_dir]
+                os.makedirs(self._state_dir, exist_ok=True)
+                stderr = open(os.path.join(
+                    self._state_dir, f"worker-{worker.name.replace('/', '-')}.log"
+                ), "ab")
+            proc = subprocess.Popen(
+                cmd, cwd=repo_root, env=env, stdout=subprocess.PIPE,
+                stderr=stderr, start_new_session=True,
+            )
+            line_q: queue.Queue = queue.Queue()
+            threading.Thread(
+                target=lambda: line_q.put(proc.stdout.readline()),
+                daemon=True,
+            ).start()
+            try:
+                line = line_q.get(timeout=self._ready_timeout_s)
+                ready = json.loads(line)
+                assert ready.get("ready")
+            except Exception:
+                proc.kill()
+                raise RuntimeError(
+                    f"worker {worker.name} never became ready "
+                    f"(within {self._ready_timeout_s}s)"
+                ) from None
+            if self.logger is not None:
+                self.logger.info("disagg worker ready", worker=worker.name,
+                                 port=ready["port"], pid=ready.get("pid"))
+            return ("127.0.0.1", int(ready["port"])), proc
+
+        return spawn
+
+    def _absorb_warm_sessions(self, worker: _Worker) -> None:
+        """Fold the worker's advertised warm-session keys into the
+        sticky map (first claim wins — a session already stuck
+        elsewhere stays there)."""
+        try:
+            with WorkerConn(worker.addr, timeout=5.0) as conn:
+                reply, _ = conn.request({"op": "ping"}, timeout=5.0)
+        except (OSError, ConnectionError, ValueError):
+            return
+        worker.ping = reply
+        sticky = self._sticky[worker.tier]
+        with self._lock:
+            for key in reply.get("warm_sessions", ()):
+                sticky.setdefault(key, worker.index)
+
+    # -- state machine / liveness --------------------------------------------
+
+    def _transition(self, worker: _Worker, state: str,
+                    only_from: Optional[tuple] = None) -> None:
+        flip_down = flip_up = False
+        with self._lock:
+            if worker.state == state or worker.state == DEAD:
+                return
+            if only_from is not None and worker.state not in only_from:
+                return
+            previous = worker.state
+            worker.state = state
+            serving = self._tiers_serving_locked()
+            if self._serving_advertised and not serving:
+                self._serving_advertised = False
+                flip_down = True
+            elif not self._serving_advertised and serving:
+                self._serving_advertised = True
+                flip_up = True
+        if self.timeline is not None:
+            self.timeline.note(
+                "worker_state", worker=worker.name, state=state,
+                previous=previous,
+            )
+        if self.recorder is not None:
+            self.recorder.event(
+                "disagg_worker_state", worker=worker.name, state=state,
+                previous=previous,
+            )
+        if self.logger is not None:
+            self.logger.info("disagg worker state change",
+                             worker=worker.name, state=state,
+                             previous=previous)
+        if self.health is not None and not self._closing:
+            # Aggregate health flips on the "every tier has >= 1
+            # SERVING worker" boundary — one worker's death is the
+            # pool's problem, a whole tier's death is the balancer's.
+            if flip_down:
+                self.health.shutdown()
+            elif flip_up:
+                self.health.resume_serving()
+
+    def _tiers_serving_locked(self) -> bool:
+        return all(
+            any(w.tier == tier and w.state == SERVING for w in self.workers)
+            for tier in (PREFILL, DECODE)
+        )
+
+    def _on_worker_down(self, worker: _Worker, cause: str) -> None:
+        self._transition(worker, DRAINING, only_from=(NEW, SERVING))
+        with self._lock:
+            if worker.state != DRAINING:
+                return
+            now = time.monotonic()
+            worker.restart_times = [
+                t for t in worker.restart_times
+                if now - t < self.config.restart_window_s
+            ]
+            budget_left = (
+                len(worker.restart_times) < self.config.max_engine_restarts
+            )
+            can_restart = (
+                worker.spawn is not None or self._restart_cb is not None
+            )
+            if budget_left and can_restart and not self._closing:
+                worker.state = RESTARTING
+                worker.restart_times.append(now)
+            else:
+                worker.state = DEAD
+        if worker.state == DEAD:
+            self._transition(worker, DEAD)   # re-aggregate health + log
+            return
+        if self.logger is not None:
+            self.logger.warn("disagg worker down; restarting",
+                             worker=worker.name, cause=cause)
+        threading.Thread(
+            target=self._restart_worker, args=(worker,), daemon=True,
+        ).start()
+
+    def _restart_worker(self, worker: _Worker) -> None:
+        if worker.proc is not None:
+            try:
+                worker.proc.kill()
+            except OSError:
+                pass
+        if self._closing:
+            self._transition(worker, DEAD)
+            return
+        try:
+            if worker.spawn is not None:
+                worker.addr, worker.proc = worker.spawn()
+            else:
+                addr = self._restart_cb(worker)
+                if addr is None:
+                    self._transition(worker, DEAD)
+                    return
+                worker.addr = tuple(addr)
+        except Exception as e:
+            if self.logger is not None:
+                self.logger.error("disagg worker restart failed",
+                                  worker=worker.name, error=str(e))
+            self._transition(worker, DEAD)
+            return
+        if self._closing:
+            # shutdown() raced the seconds-long spawn: its worker pass
+            # already ran, so the FRESH process is ours to reap — left
+            # alone it would outlive the pool with its port bound.
+            if worker.proc is not None:
+                try:
+                    worker.proc.kill()
+                except OSError:
+                    pass
+            self._transition(worker, DEAD)
+            return
+        worker.misses = 0
+        worker.restarts += 1
+        self._absorb_warm_sessions(worker)   # rejoin warm (persisted index)
+        self._transition(worker, SERVING, only_from=(RESTARTING,))
+
+    def _heartbeat_loop(self) -> None:
+        interval = self.config.disagg_heartbeat_s
+        while not self._stop_heartbeat.wait(interval):
+            for worker in list(self.workers):
+                if worker.state in (RESTARTING, DEAD) or self._closing:
+                    continue
+                if worker.proc is not None and worker.proc.poll() is not None:
+                    self._on_worker_down(worker, "process exited")
+                    continue
+                try:
+                    with WorkerConn(worker.addr, timeout=interval) as conn:
+                        reply, _ = conn.request({"op": "ping"},
+                                                timeout=interval)
+                    worker.ping = reply
+                    worker.misses = 0
+                    if reply.get("state") == "DEAD":
+                        self._transition(worker, DEAD)
+                    elif reply.get("state") == "SERVING":
+                        self._transition(worker, SERVING,
+                                         only_from=(NEW, DRAINING))
+                except (OSError, ConnectionError, ValueError):
+                    worker.misses += 1
+                    if worker.misses >= self.config.disagg_miss:
+                        self._on_worker_down(worker, "heartbeat missed")
+
+    # -- engine-shaped surface ------------------------------------------------
+
+    @property
+    def dead(self) -> Optional[str]:
+        if self._closing:
+            return "engine is shut down"
+        with self._lock:
+            for tier in (PREFILL, DECODE):
+                members = [w for w in self.workers if w.tier == tier]
+                if members and all(w.state == DEAD for w in members):
+                    return (f"all {tier}-tier workers dead "
+                            "(restart budgets exhausted)")
+        return None
+
+    @property
+    def busy(self) -> bool:
+        with self._lock:
+            return self._inflight > 0
+
+    def submit(self, request: GenRequest) -> None:
+        """Tier-aware admission + one orchestration thread per request.
+        Sheds (RESOURCE_EXHAUSTED + retry-after) when the in-flight set
+        already oversubscribes the decode tier's slot capacity by the
+        configured queue bound — the coordinator's O(1) mirror of the
+        engine's bounded-queue discipline."""
+        dead = self.dead
+        if dead is not None:
+            raise EngineDeadError(
+                dead, retry_after_ms=int(
+                    1000 * self.config.disagg_heartbeat_s * 2
+                ),
+            )
+        limit = self.config.max_queue_depth
+        if limit > 0:
+            decode_slots = sum(
+                self.config.max_decode_slots
+                for w in self.workers if w.tier == DECODE
+            )
+            with self._lock:
+                over = self._inflight >= decode_slots + limit
+            if over:
+                raise EngineOverloadedError(
+                    f"disagg pool saturated ({self._inflight} in flight)",
+                    retry_after_ms=100,
+                )
+        if request.seed is None and request.temperature > 0.0:
+            # Fix the sampling root NOW: a re-routed attempt must replay
+            # the same stream (the replica_pool contract).
+            request.seed = int(self._seed_rng.integers(0, 1 << 63))
+        with self._lock:
+            self._inflight += 1
+        threading.Thread(
+            target=self._serve_request, args=(request,), daemon=True,
+        ).start()
+
+    def shutdown(self, timeout: float = 10.0) -> None:
+        self._closing = True
+        self._stop_heartbeat.set()
+        if self._heartbeat_thread is not None:
+            self._heartbeat_thread.join(timeout=2.0)
+        for worker in self.workers:
+            if worker.addr is not None:
+                try:
+                    with WorkerConn(worker.addr, timeout=2.0) as conn:
+                        conn.request({"op": "exit"}, timeout=2.0)
+                except (OSError, ConnectionError, ValueError):
+                    pass
+            if worker.proc is not None:
+                try:
+                    worker.proc.terminate()
+                    worker.proc.wait(timeout=timeout)
+                except (OSError, subprocess.TimeoutExpired):
+                    try:
+                        worker.proc.kill()
+                    except OSError:
+                        pass
+
+    # -- routing --------------------------------------------------------------
+
+    def _serving(self, tier: str) -> list[_Worker]:
+        with self._lock:
+            return [
+                w for w in self.workers
+                if w.tier == tier and w.state == SERVING
+            ]
+
+    def _wait_for_worker(self, tier: str, skey: str,
+                         payload_bytes: int = 0) -> Optional[_Worker]:
+        """Pick the best SERVING worker of `tier`; when the tier is
+        momentarily empty (a restart in flight), wait up to the
+        recovery budget — the zero-loss contract rides on re-routes
+        outlasting a supervised worker restart. Failed workers need no
+        explicit exclusion: a death already moved them out of SERVING
+        via the state machine."""
+        deadline = time.monotonic() + self.config.disagg_recovery_wait_s
+        while True:
+            candidates = self._serving(tier)
+            if candidates:
+                return self._score(tier, candidates, skey, payload_bytes)
+            if time.monotonic() >= deadline or self._closing:
+                return None
+            time.sleep(min(0.05, self.config.disagg_heartbeat_s))
+
+    def _score(self, tier: str, candidates: list[_Worker], skey: str,
+               payload_bytes: int) -> _Worker:
+        """NetKV-style selection. Decode: minimize estimated transfer
+        cost (bytes / measured bandwidth EWMA) + queue-delay EWMA, with
+        a small session-sticky bonus. Prefill: session-sticky first
+        (warm prefix beats any queue-delay difference at these scales),
+        then least delay. Ties break on the lowest index —
+        deterministic given equal state."""
+        sticky = self._sticky[tier].get(skey)
+        if tier == PREFILL and sticky is not None:
+            for worker in candidates:
+                if worker.index == sticky:
+                    return worker
+        scored = []
+        for worker in candidates:
+            delay = float(worker.ping.get("queue_delay_s", 0.0) or 0.0)
+            load = float(worker.ping.get("load", 0.0) or 0.0)
+            transfer = 0.0
+            if tier == DECODE and payload_bytes:
+                bw = worker.bw_ewma or _BW_PRIOR
+                transfer = payload_bytes / bw
+            bonus = 0.001 if sticky == worker.index else 0.0
+            score = transfer + delay + 1e-3 * load - bonus
+            scored.append((score, worker.index, worker))
+        scored.sort(key=lambda entry: (entry[0], entry[1]))
+        chosen = scored[0][2]
+        with self._lock:
+            self._sticky[tier][skey] = chosen.index
+        return chosen
+
+    # -- per-request orchestration --------------------------------------------
+
+    def _serve_request(self, request: GenRequest) -> None:
+        try:
+            self._orchestrate(request)
+        except _Terminal:
+            pass   # the request already received its terminal event
+        except Exception as e:  # the thread must never die silently
+            request.out.put(("error", f"engine: disagg orchestration "
+                                      f"crashed: {e}"))
+            if self.logger is not None:
+                import traceback
+
+                self.logger.error("disagg orchestration crashed",
+                                  error=str(e),
+                                  traceback=traceback.format_exc())
+        finally:
+            with self._lock:
+                self._inflight -= 1
+
+    def _orchestrate(self, request: GenRequest) -> None:
+        ids = np.asarray(self.tokenizer.encode(request.prompt), np.int32)
+        skey = session_key(ids, self.config.page_size)
+        handoff_id = uuid.uuid4().hex
+        blob: Optional[bytes] = None
+        meta: dict = {}
+        source: Optional[_Worker] = None
+        delivered = 0
+        reroutes = 0
+        # Flow-control retries (worker sheds) wait out the worker's
+        # retry-after hint instead of burning the re-route budget; this
+        # cap only backstops a tier that sheds for minutes on end.
+        flow_retries = 0
+        while True:
+            if request.cancelled.is_set():
+                request.out.put(("error", "cancelled"))
+                return
+            if (request.deadline is not None
+                    and time.monotonic() >= request.deadline):
+                request.out.put((
+                    "error", "deadline exceeded while re-routing",
+                ))
+                return
+            t_handoff = time.monotonic()
+            try:
+                if blob is None:
+                    prefill_worker = self._wait_for_worker(PREFILL, skey)
+                    if prefill_worker is None:
+                        request.out.put((
+                            "error",
+                            "engine: no serving prefill-tier worker",
+                        ))
+                        self._count("aborted")
+                        return
+                    blob, meta, source = self._run_prefill(
+                        prefill_worker, request, handoff_id, skey
+                    )
+                decode_worker = self._wait_for_worker(
+                    DECODE, skey, payload_bytes=len(blob)
+                )
+                if decode_worker is None:
+                    request.out.put((
+                        "error", "engine: no serving decode-tier worker",
+                    ))
+                    self._count("aborted")
+                    return
+                delivered = self._run_decode(
+                    decode_worker, request, blob, meta, delivered, source,
+                    t_handoff,
+                )
+                self._count("ok")
+                self._release(source, handoff_id)
+                return
+            except _HandoffRetry as e:
+                delivered = max(delivered, getattr(e, "delivered", delivered))
+                if e.restart_prefill:
+                    blob = None
+                    source = None
+                if e.flow_control:
+                    # A shed, not a death: honor the worker's
+                    # retry-after hint; no budget burn, no failover
+                    # metrics (the gateway-level shed already carries
+                    # the client-facing RESOURCE_EXHAUSTED contract).
+                    flow_retries += 1
+                    if flow_retries > 100:
+                        self._count("aborted")
+                        request.out.put((
+                            "error",
+                            f"engine: tier kept shedding ({e.phase}: {e})",
+                        ))
+                        return
+                    time.sleep(max(0.02, e.retry_after_s))
+                    continue
+                reroutes += 1
+                if self.timeline is not None:
+                    self.timeline.note(
+                        "handoff_abort", phase=e.phase, cause=str(e),
+                        reroutes=reroutes,
+                    )
+                if self.recorder is not None:
+                    self.recorder.event(
+                        "disagg_handoff_abort", phase=e.phase,
+                        cause=str(e), reroutes=reroutes,
+                    )
+                if reroutes > self.config.max_reroutes:
+                    self._count("aborted")
+                    request.out.put((
+                        "error",
+                        f"engine: handoff failed after {reroutes - 1} "
+                        f"re-routes ({e.phase}: {e})",
+                    ))
+                    return
+                self._count("retried")
+                with self._lock:
+                    self.requests_rerouted += 1
+                    if delivered > 0:
+                        self.streams_resumed += 1
+                if delivered > 0:
+                    request.restarted = True
+                if not e.mark_down:
+                    time.sleep(0.05)   # link event, not death: brief pause
+
+    def _count(self, outcome: str) -> None:
+        with self._lock:
+            self.handoffs[outcome] += 1
+
+    def _release(self, source: Optional[_Worker], handoff_id: str) -> None:
+        """Phase 2 of the hand-over: decode is done, the source may drop
+        its retained copy. Best-effort — a dead source already lost it."""
+        if source is None or source.addr is None:
+            return
+        try:
+            with WorkerConn(source.addr, timeout=2.0) as conn:
+                conn.request({"op": "release", "handoff_id": handoff_id},
+                             timeout=2.0)
+        except (OSError, ConnectionError, ValueError):
+            pass
+
+    def _deadline_in_s(self, request: GenRequest) -> Optional[float]:
+        if request.deadline is None:
+            return None
+        return max(0.0, request.deadline - time.monotonic())
+
+    def _req_dict(self, request: GenRequest) -> dict:
+        return {
+            "prompt": request.prompt,
+            "max_new_tokens": request.max_new_tokens,
+            "temperature": request.temperature,
+            "top_p": request.top_p,
+            "top_k": request.top_k,
+            "seed": request.seed,
+            "deadline_in_s": self._deadline_in_s(request),
+        }
+
+    def _run_prefill(self, worker: _Worker, request: GenRequest,
+                     handoff_id: str, skey: str) -> tuple:
+        """Prefill + fetch: returns (blob, meta, worker). Any failure —
+        socket death, worker error, corrupt blob — marks the worker and
+        raises a retryable _HandoffRetry (the blob never half-applies:
+        validation precedes any ship)."""
+        if self.timeline is not None:
+            self.timeline.note(
+                "handoff_start", worker=worker.name,
+                handoff_id=handoff_id, session=skey,
+            )
+        try:
+            with WorkerConn(worker.addr, timeout=30.0) as conn:
+                req = self._req_dict(request)
+                req["handoff_id"] = handoff_id
+                conn.send({"op": "prefill", "req": req})
+                meta: dict = {}
+                timeout = self.config.request_timeout_s
+                while True:
+                    event, _ = conn.recv(timeout=timeout)
+                    kind = event.get("event")
+                    if kind == "handoff_ready":
+                        meta = event
+                        request.timings.prompt_tokens = int(
+                            event.get("prompt_tokens", 0)
+                        )
+                    elif kind == "done":
+                        break
+                    elif kind == "error":
+                        if event.get("shed"):
+                            raise _HandoffRetry(
+                                event.get("message", "shed"),
+                                "prefill", restart_prefill=True,
+                                mark_down=False, flow_control=True,
+                                retry_after_s=(
+                                    event.get("retry_after_ms") or 100
+                                ) / 1000.0,
+                            )
+                        message = event.get("message", "prefill failed")
+                        if message.startswith("engine"):
+                            raise _HandoffRetry(message, "prefill",
+                                                restart_prefill=True)
+                        # Request-outcome failure (deadline, bad input):
+                        # not the worker's fault, never re-routed.
+                        request.out.put(("error", message))
+                        raise _Terminal()
+                    else:
+                        raise _HandoffRetry(
+                            f"unexpected prefill event {kind!r}",
+                            "prefill", restart_prefill=True,
+                        )
+                if not meta:
+                    raise _HandoffRetry("prefill produced no handoff",
+                                        "prefill", restart_prefill=True)
+                reply, blob = conn.request(
+                    {"op": "fetch", "handoff_id": handoff_id},
+                    timeout=timeout,
+                )
+                if not reply.get("ok"):
+                    raise _HandoffRetry(
+                        reply.get("error", "fetch failed"), "handoff",
+                        restart_prefill=True,
+                    )
+        except _Terminal:
+            raise
+        except _HandoffRetry as e:
+            if e.mark_down:
+                self._on_worker_down(worker, "prefill attempt failed")
+            raise
+        except (OSError, ConnectionError, ValueError) as e:
+            self._on_worker_down(worker, f"prefill/handoff failed: {e}")
+            raise _HandoffRetry(str(e) or "connection lost", "handoff",
+                                restart_prefill=True) from e
+        try:
+            validate_kv_blob(blob)
+        except KVWireError as e:
+            # Partial write / corrupt ship: clean re-route (re-run the
+            # prefill), never a half-applied pool — the decode tier
+            # never sees this blob. The worker itself stays SERVING: a
+            # torn transfer is a link event, and killing the source
+            # would turn one bad ship into lost tier capacity.
+            raise _HandoffRetry(str(e), "handoff", restart_prefill=True,
+                                mark_down=False) from e
+        with self._lock:
+            self.handoff_bytes += len(blob)
+        return blob, meta, worker
+
+    def _run_decode(self, worker: _Worker, request: GenRequest,
+                    blob: bytes, meta: dict, delivered: int,
+                    source: Optional[_Worker],
+                    t_handoff: float) -> int:
+        """Ship the blob, stream the decode, forward the suffix the
+        client is missing. Returns the total delivered count; raises
+        _HandoffRetry carrying it on a recoverable failure."""
+        seen = 0
+        try:
+            with WorkerConn(worker.addr, timeout=30.0) as conn:
+                t_ship = time.monotonic()
+                conn.send({"op": "decode", "req": self._req_dict(request)},
+                          blob)
+                timeout = self.config.request_timeout_s
+                event, _ = conn.recv(timeout=timeout)
+                if event.get("event") != "accepted":
+                    message = event.get("message", "decode rejected")
+                    if event.get("shed"):
+                        raise _HandoffRetry(
+                            message, "decode", restart_prefill=False,
+                            mark_down=False, flow_control=True,
+                            retry_after_s=(
+                                event.get("retry_after_ms") or 100
+                            ) / 1000.0,
+                        )
+                    if "kv-handoff" in message:
+                        # The blob itself was rejected (the engine wraps
+                        # the typed marker as "admission failed:
+                        # kv-handoff rejected: …"): re-run prefill —
+                        # re-shipping the same bytes cannot succeed.
+                        raise _HandoffRetry(message, "decode",
+                                            restart_prefill=True,
+                                            mark_down=False)
+                    if message.startswith("engine"):
+                        raise _HandoffRetry(message, "decode",
+                                            restart_prefill=False)
+                    request.out.put(("error", message))
+                    raise _Terminal()
+                ship_s = max(1e-6, time.monotonic() - t_ship)
+                measured = len(blob) / ship_s
+                worker.bw_ewma = (
+                    measured if worker.bw_ewma == 0.0
+                    else 0.7 * worker.bw_ewma + 0.3 * measured
+                )
+                self.handoff_ms.observe(
+                    (time.monotonic() - t_handoff) * 1e3
+                )
+                if self.timeline is not None:
+                    self.timeline.note(
+                        "handoff_ack", worker=worker.name,
+                        bytes=len(blob),
+                        ship_ms=round(ship_s * 1e3, 3),
+                    )
+                request.replica = worker.index
+                request.tier = (
+                    f"prefill={source.index if source else '?'},"
+                    f"decode={worker.index}"
+                )
+                while True:
+                    event, _ = conn.recv(timeout=timeout)
+                    kind = event.get("event")
+                    if kind == "token":
+                        seen += 1
+                        if seen <= delivered:
+                            continue     # client already holds it
+                        delivered += 1
+                        timings = request.timings
+                        if timings.first_token == 0.0:
+                            timings.first_token = time.monotonic()
+                            if timings.prefill_start == 0.0:
+                                timings.prefill_start = timings.enqueued
+                        request.out.put(("token", int(event["id"])))
+                        if request.cancelled.is_set():
+                            request.out.put(("error", "cancelled"))
+                            raise _Terminal()
+                    elif kind == "done":
+                        timings = request.timings
+                        timings.finished = time.monotonic()
+                        timings.completion_tokens = delivered
+                        remote = event.get("timings") or {}
+                        timings.device_ms += float(
+                            remote.get("device_ms", 0.0) or 0.0
+                        )
+                        request.out.put(("done", timings))
+                        return delivered
+                    elif kind == "error":
+                        message = event.get("message", "decode failed")
+                        if "kv-handoff" in message:
+                            raise _HandoffRetry(message, "decode",
+                                                restart_prefill=True,
+                                                mark_down=False)
+                        if message.startswith("engine"):
+                            raise _HandoffRetry(message, "decode",
+                                                restart_prefill=False)
+                        request.out.put(("error", message))
+                        raise _Terminal()
+                    else:
+                        raise _HandoffRetry(
+                            f"unexpected decode event {kind!r}", "decode",
+                            restart_prefill=False,
+                        )
+        except (_Terminal, _HandoffRetry) as e:
+            if isinstance(e, _HandoffRetry):
+                e.delivered = delivered
+                if e.mark_down:
+                    self._on_worker_down(worker,
+                                         f"decode attempt failed: {e}")
+            raise
+        except (OSError, ConnectionError, ValueError) as e:
+            self._on_worker_down(worker, f"decode stream died: {e}")
+            retry = _HandoffRetry(str(e) or "connection lost", "decode",
+                                  restart_prefill=False)
+            retry.delivered = delivered
+            raise retry from e
+
+    # -- stats / exposition ---------------------------------------------------
+
+    def _worker_stats(self, worker: _Worker) -> dict:
+        try:
+            with WorkerConn(worker.addr, timeout=3.0) as conn:
+                reply, _ = conn.request({"op": "stats"}, timeout=3.0)
+            if reply.get("ok"):
+                worker.stats = reply["stats"]
+        except (OSError, ConnectionError, ValueError):
+            pass  # keep the cached snapshot; liveness is heartbeat's job
+        snap = dict(worker.stats)
+        snap["tier"] = worker.tier
+        snap["replica"] = worker.index
+        snap["state"] = worker.state
+        snap["worker_restarts"] = worker.restarts
+        return snap
+
+    def stats(self) -> dict:
+        """Aggregate pool stats, replica_pool-shaped: additive engine
+        counters summed across workers, per-worker snapshots under
+        `per_worker`, tier/handoff extras on top. Snapshots refresh at
+        most every 0.5 s so scrape storms never amplify into control-
+        plane storms."""
+        now = time.monotonic()
+        with self._lock:
+            cached = self._stats_cache if (
+                self._stats_cache and now - self._stats_cache_t < 0.5
+            ) else None
+        if cached is not None:
+            return cached
+        per = [self._worker_stats(w) for w in list(self.workers)]
+        agg: dict = {}
+        for snap in per:
+            for key, value in snap.items():
+                if key in _ADDITIVE_KEYS and isinstance(value, (int, float)):
+                    agg[key] = agg.get(key, 0) + value
+        agg["model"] = self.config.model
+        with self._lock:
+            agg["workers_total"] = len(self.workers)
+            agg["workers_serving"] = sum(
+                w.state == SERVING for w in self.workers
+            )
+            agg["tier_states"] = {
+                w.name: w.state for w in self.workers
+            }
+            agg["tiers"] = {
+                tier: {
+                    "total": sum(w.tier == tier for w in self.workers),
+                    "serving": sum(
+                        w.tier == tier and w.state == SERVING
+                        for w in self.workers
+                    ),
+                }
+                for tier in (PREFILL, DECODE)
+            }
+            agg["requests_rerouted"] = self.requests_rerouted
+            agg["streams_resumed"] = self.streams_resumed
+            agg["handoffs"] = dict(self.handoffs)
+            agg["handoff_bytes"] = self.handoff_bytes
+            agg["inflight_requests"] = self._inflight
+        agg["handoff_ms_p50"] = round(self.handoff_ms.percentile(50), 2)
+        agg["handoff_ms_p95"] = round(self.handoff_ms.percentile(95), 2)
+        agg["per_worker"] = per
+        with self._lock:
+            self._stats_cache = agg
+            self._stats_cache_t = now
+        return agg
+
+
+class _Terminal(Exception):
+    """The request already received its terminal event; unwind only."""
+
+
+def _config_env(config: EngineConfig) -> dict:
+    """Render the engine-geometry knobs as the POLYKEY_* env vars
+    `EngineConfig.from_env` reads — the spawn-time config channel.
+    Identical geometry on every worker (and any in-process reference)
+    is what makes the disaggregated greedy stream bit-identical."""
+    flag = "1"
+    return {
+        "POLYKEY_MODEL": config.model,
+        "POLYKEY_TOKENIZER": config.tokenizer,
+        "POLYKEY_DTYPE": config.dtype,
+        "POLYKEY_KV_DTYPE": config.kv_dtype,
+        "POLYKEY_QUANTIZE": (
+            ("int4" if config.quantize_bits == 4 else "int8")
+            if config.quantize else "0"
+        ),
+        "POLYKEY_MAX_DECODE_SLOTS": str(config.max_decode_slots),
+        "POLYKEY_PAGE_SIZE": str(config.page_size),
+        "POLYKEY_NUM_PAGES": str(config.num_pages),
+        "POLYKEY_MAX_SEQ_LEN": str(config.max_seq_len),
+        "POLYKEY_PREFILL_BUCKETS": ",".join(
+            str(b) for b in config.prefill_buckets
+        ),
+        "POLYKEY_PREFILL_CHUNK": str(config.prefill_chunk),
+        "POLYKEY_PREFILL_BUDGET": str(config.prefill_budget),
+        "POLYKEY_MAX_NEW_TOKENS_CAP": str(config.max_new_tokens_cap),
+        "POLYKEY_DEFAULT_MAX_NEW_TOKENS": str(
+            config.default_max_new_tokens
+        ),
+        "POLYKEY_RAGGED": flag if config.ragged_dispatch else "0",
+        "POLYKEY_PREFIX_CACHE": flag if config.prefix_cache else "0",
+        "POLYKEY_PREFIX_CACHE_PAGES": str(config.prefix_cache_pages),
+        "POLYKEY_COMPILE_WARMUP": flag if config.compile_warmup else "0",
+        "POLYKEY_DECODE_BLOCK": str(config.decode_block_steps),
+        "POLYKEY_ADAPTIVE_BLOCK": flag if config.adaptive_block else "0",
+        "POLYKEY_DISPATCH_LOOKAHEAD": str(config.lookahead_blocks),
+        "POLYKEY_TIMELINE_CAPACITY": str(config.timeline_capacity),
+        "POLYKEY_SIGNALS_INTERVAL": str(config.signals_interval_s),
+        "POLYKEY_TOP_P_CANDIDATES": str(config.top_p_candidates),
+        "POLYKEY_WATCHDOG_TIMEOUT": str(config.watchdog_timeout_s),
+        "POLYKEY_REQUEST_TIMEOUT": str(config.request_timeout_s),
+        "POLYKEY_MAX_QUEUE": str(config.max_queue_depth),
+        "POLYKEY_SUPERVISE": flag if config.supervise else "0",
+        "POLYKEY_MAX_RESTARTS": str(config.max_engine_restarts),
+        "POLYKEY_RESTART_WINDOW": str(config.restart_window_s),
+        # Weights + mesh: a programmatic config with a checkpoint (or
+        # tp>1) must not spawn random-init single-device workers.
+        "POLYKEY_CHECKPOINT": config.checkpoint_path or "",
+        "POLYKEY_TP": str(config.tp),
+        "POLYKEY_DP": str(config.dp),
+        "POLYKEY_EP": str(config.ep),
+        "POLYKEY_SP": str(config.sp),
+        "POLYKEY_PP": str(config.pp),
+        "POLYKEY_NUM_SLICES": str(config.num_slices),
+    }

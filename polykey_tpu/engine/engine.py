@@ -78,7 +78,14 @@ from ..models.transformer import (
 from ..parallel.mesh import MeshConfig, create_mesh
 from ..parallel.sharding import paged_kv_sharding, shard_params
 from .config import EngineConfig
-from .kv_cache import AllocationError, BlockAllocator, PagedKV, init_paged_kv
+from .kv_cache import (
+    AllocationError,
+    BlockAllocator,
+    KVHandoffState,
+    KVWireError,
+    PagedKV,
+    init_paged_kv,
+)
 from .metrics import EngineMetrics, RequestTimings
 from .sampling import sample_tail
 from .tokenizer import load_tokenizer
@@ -148,6 +155,15 @@ class GenRequest:
     # prefill / decode children; decode gets per-block children as blocks
     # are processed.
     trace: Optional["Span"] = None
+    # Disaggregated tiers (ISSUE 13). `prefill_only`: run prefill, then
+    # instead of decoding emit ("handoff", KVHandoffState) + ("done", …)
+    # — the prefill-tier worker's mode. `resume_state`: a deserialized
+    # KVHandoffState; the engine skips tokenize/prefill entirely, maps
+    # the shipped pages into its own pool, and resumes decode at
+    # seq_len = prompt_len + 1 — the decode-tier worker's mode. Both
+    # default off; every non-disaggregated path never sets them.
+    prefill_only: bool = False
+    resume_state: Optional[object] = None
 
 
 @dataclass
@@ -416,6 +432,29 @@ def _retire_lane_fn(last_tokens, seq_lens, page_tables, active, caps, slot):
     )
 
 
+def _kv_restore_fn(paged: PagedKV, idx, k, v):
+    """Scatter handed-off page contents into the pool at the target's
+    own page ids (ISSUE 13 decode-side restore). `idx`/`k`/`v` are
+    padded to a FIXED width (pages_per_seq) so one compiled executable
+    serves every handoff size — pad rows target the reserved garbage
+    page 0, whose contents are never read (inactive lanes write it
+    constantly anyway). The pool is donated: the restore is an in-place
+    page write ordered after every in-flight dispatch through the
+    donation chain, exactly like a prefill's KV writes."""
+    return paged.replace(
+        k=paged.k.at[:, idx].set(k), v=paged.v.at[:, idx].set(v)
+    )
+
+
+def _kv_restore_quant_fn(paged: PagedKV, idx, k, v, ks, vs):
+    """Int8 pair-form variant of `_kv_restore_fn`: the value pools and
+    their bf16 scale pools restore together, byte-for-byte."""
+    return paged.replace(
+        k=paged.k.at[:, idx].set(k), v=paged.v.at[:, idx].set(v),
+        ks=paged.ks.at[:, idx].set(ks), vs=paged.vs.at[:, idx].set(vs),
+    )
+
+
 def ragged_zero_operands(B: int, W: int, P: int) -> tuple:
     """The 14 positional prefill operands of `_ragged_fn`, all-zero /
     all-garbage (no ranges, no sample rows) — the SINGLE builder for
@@ -464,7 +503,16 @@ class _InflightBlock(NamedTuple):
 
 
 class EngineDeadError(RuntimeError):
-    pass
+    """The engine (or pool) cannot take work. `retry_after_ms`, when the
+    raiser can estimate it (a replica pool with a supervised restart in
+    flight), is the recovery hint the gateway ships as the
+    `retry-after-ms` trailer on the resulting UNAVAILABLE — without it,
+    well-behaved clients hammer a recovering tier at their own backoff
+    schedule instead of the server's."""
+
+    def __init__(self, message: str, retry_after_ms: Optional[int] = None):
+        super().__init__(message)
+        self.retry_after_ms = retry_after_ms
 
 
 class EngineOverloadedError(RuntimeError):
@@ -519,6 +567,10 @@ class InferenceEngine:
         # targeting (":replica=N") and per-replica metric labels key on
         # it. A standalone engine is replica 0.
         self.replica_id = config.replica
+        # Tier identity within a disaggregated worker (engine/worker.py):
+        # scopes ":tier=prefill|decode" fault targeting. None everywhere
+        # else, so tier-targeted faults can never fire in-process.
+        self._tier = config.disagg_tier or None
         self._dtype = jnp.dtype(config.dtype)
 
         # --- Serving mesh: tp shards heads/hidden (Megatron specs,
@@ -650,6 +702,15 @@ class InferenceEngine:
         )
         self._jit_retire = jax.jit(
             _retire_lane_fn, out_shardings=lane_out[:5],
+        )
+        # KV handoff restore (ISSUE 13): scatter shipped pages into this
+        # pool at the receiving slot's page ids. Donates the pool like
+        # every other pool-touching dispatch; the fixed padded width
+        # (pages_per_seq) keeps it ONE executable per engine.
+        self._jit_kv_restore = jax.jit(
+            _kv_restore_quant_fn if self._kv_quantized else _kv_restore_fn,
+            donate_argnames=("paged",),
+            out_shardings=self._pool_sharding,
         )
         # Per-request RNG roots for seedless requests (GenRequest.seed
         # None): drawn once per admission from the engine seed.
@@ -1418,10 +1479,14 @@ class InferenceEngine:
         spec engines alike) or None for long prompts (registered for
         chunked prefill)."""
         cfg = self.config
+        if request.resume_state is not None:
+            # Decode-tier resume (ISSUE 13): the prompt's KV arrives
+            # with the request; nothing tokenizes or prefills here.
+            return self._admit_resume(slot_idx, request)
         request.timings.prefill_start = time.monotonic()
 
         if self._faults is not None:
-            self._faults.maybe_raise("tokenizer-error", replica=self.replica_id)
+            self._faults.maybe_raise("tokenizer-error", replica=self.replica_id, tier=self._tier)
         prompt_ids = self.tokenizer.encode(request.prompt)
         max_new = max(
             1,
@@ -1455,7 +1520,8 @@ class InferenceEngine:
                 # Inside the try: the AllocationError path below must
                 # still release the prefix-cache lookup's page refs.
                 self._faults.maybe_raise(
-                    "alloc-fail", AllocationError, replica=self.replica_id
+                    "alloc-fail", AllocationError, replica=self.replica_id,
+                    tier=self._tier,
                 )
             try:
                 fresh = self.allocator.alloc(need)
@@ -1579,7 +1645,7 @@ class InferenceEngine:
         )
         try:
             if self._faults is not None:
-                self._faults.maybe_raise("prefill-error", replica=self.replica_id)
+                self._faults.maybe_raise("prefill-error", replica=self.replica_id, tier=self._tier)
             with jax.profiler.TraceAnnotation("polykey/prefill"):
                 if self._spec:
                     # Spec burst admissions batch exactly like plain ones
@@ -1743,7 +1809,8 @@ class InferenceEngine:
         try:
             if self._faults is not None:
                 self._faults.maybe_raise(
-                    "prefill-error", replica=self.replica_id
+                    "prefill-error", replica=self.replica_id,
+                    tier=self._tier,
                 )
             with jax.profiler.TraceAnnotation("polykey/ragged"):
                 (packed_dev, last_dev, seq_dev, act_dev, first_dev,
@@ -2019,7 +2086,7 @@ class InferenceEngine:
             put(np.asarray([self._eff_top_k(request)], dtype=np.int32)),
         )
         if self._faults is not None:
-            self._faults.maybe_raise("prefill-error", replica=self.replica_id)
+            self._faults.maybe_raise("prefill-error", replica=self.replica_id, tier=self._tier)
         with jax.profiler.TraceAnnotation("polykey/prefill"):
             if self._spec:
                 first_token, self.paged, self.d_paged = self._jit_spec_prefill(
@@ -2051,6 +2118,21 @@ class InferenceEngine:
         flush. The host keeps a handle to the token purely for client
         delivery (_resolve_prefills)."""
         request = slot.request
+        if request.prefill_only:
+            # Prefill-tier mode (ISSUE 13): the lane never activates —
+            # the sampled first token and the written KV pages ARE this
+            # request's product; decode happens on the decode tier after
+            # the handoff. The token handle still resolves through
+            # _resolve_prefills, which routes to the handoff export.
+            slot.merged = False
+            slot.pending = None
+            slot.token_dev = toks_dev
+            slot.token_row = row
+            try:
+                toks_dev.copy_to_host_async()
+            except Exception:
+                pass  # harmless: np.asarray at resolve time starts the copy
+            return
         if self._dev_dirty:
             # Cold start / post-recovery: fold mirrors in before merging.
             self._drain_inflight()
@@ -2130,6 +2212,13 @@ class InferenceEngine:
             # consumer's own prefill dispatches after this point, so
             # device-order still guarantees the pages are written first.
             self._prefix.insert(slot.prompt_ids, slot.pages)
+        if request.prefill_only:
+            # Prefill-tier product (ISSUE 13): instead of activating
+            # decode, gather the prompt's KV pages and hand the state to
+            # the worker harness (which serializes + retains it until
+            # the coordinator acks — the two-phase hand-over).
+            self._export_handoff(slot_idx, slot, token)
+            return
         self._last_tokens[slot_idx] = token
         request.timings.first_token = time.monotonic()
         slot.last_emit = request.timings.first_token
@@ -2150,6 +2239,182 @@ class InferenceEngine:
             )
         request.out.put(("token", token))
         self._maybe_finish(slot_idx, token)
+
+    def _export_handoff(self, slot_idx: int, slot: _Slot,
+                        token: int) -> None:
+        """Prefill-tier export (ISSUE 13): gather the slot's prompt KV
+        pages to host, emit ("handoff", KVHandoffState) then the usual
+        ("done", timings), and release the slot. The gathered host copy
+        is the retained artifact of the two-phase hand-over (the worker
+        harness keeps its serialized form until the coordinator acks);
+        the device pages themselves release with the slot — block-table
+        order is preserved by the gather, so the target re-maps pages to
+        its own ids without any index translation."""
+        request = slot.request
+        cfg = self.config
+        n_kv = -(-slot.prompt_len // cfg.page_size)
+        try:
+            # polylint: disable=PL008(tiny page-index upload, not a readback; prefill_only cold path)
+            idx = jnp.asarray(np.asarray(slot.pages[:n_kv], np.int32))
+            with _host_crossing():
+                # polylint: disable=PL008(handoff export: deliberate one-shot gather; prefill_only cold path never taken by in-process serving)
+                k = np.asarray(jnp.take(self.paged.k, idx, axis=1))
+                # polylint: disable=PL008(handoff export gather; prefill_only cold path)
+                v = np.asarray(jnp.take(self.paged.v, idx, axis=1))
+                ks = vs = None
+                if self.paged.quantized:
+                    # polylint: disable=PL008(handoff export gather; prefill_only cold path)
+                    ks = np.asarray(jnp.take(self.paged.ks, idx, axis=1))
+                    # polylint: disable=PL008(handoff export gather; prefill_only cold path)
+                    vs = np.asarray(jnp.take(self.paged.vs, idx, axis=1))
+        except Exception as e:
+            self._finish(slot_idx, error=f"handoff export failed: {e}")
+            return
+        halves = slot.seed_row.view(np.uint32).astype(np.uint64)
+        seed = int((halves[0] << np.uint64(32)) | halves[1])
+        state = KVHandoffState(
+            model=self.model_cfg.name, page_size=cfg.page_size,
+            prompt_len=slot.prompt_len, first_token=int(token), seed=seed,
+            prompt_ids=slot.prompt_ids, k=k, v=v, ks=ks, vs=vs,
+        )
+        request.timings.first_token = time.monotonic()
+        if self.timeline is not None:
+            self.timeline.note(
+                "handoff_export", slot=slot_idx,
+                prompt_tokens=slot.prompt_len, pages=n_kv,
+            )
+        request.out.put(("handoff", state))
+        self._finish(slot_idx)
+
+    def _admit_resume(self, slot_idx: int, request: GenRequest) -> None:
+        """Decode-tier admission (ISSUE 13): map a handed-off KV state
+        into this pool and splice the slot state a single-process run
+        would hold at seq_len = prompt_len + 1 — no tokenize, no
+        prefill dispatch. Greedy continuation is then bit-identical to
+        an uninterrupted run (same params, same seed, same position
+        keys). Geometry/dtype mismatches reject as typed 'kv-handoff
+        rejected' failures BEFORE any pool write; AllocationError takes
+        the usual requeue backpressure path (the resume_state rides the
+        request, so a retry re-admits cleanly)."""
+        cfg = self.config
+        state: KVHandoffState = request.resume_state
+        request.timings.prefill_start = time.monotonic()
+        try:
+            state.validate_for(
+                self.model_cfg, cfg.page_size, self._kv_quantized
+            )
+            if jnp.dtype(state.k.dtype) != self.paged.k.dtype:
+                raise KVWireError(
+                    f"kv-handoff pool dtype mismatch: blob "
+                    f"{state.k.dtype}, target {self.paged.k.dtype}"
+                )
+        except KVWireError as e:
+            # _admit wraps as "admission failed: kv-handoff ..." — the
+            # coordinator matches the marker and re-routes cleanly.
+            raise RuntimeError(f"kv-handoff rejected: {e}") from e
+        prompt_len = state.prompt_len
+        request.timings.prompt_tokens = prompt_len
+        max_new = max(
+            1,
+            min(request.max_new_tokens, cfg.max_new_tokens_cap,
+                cfg.max_seq_len - 1 - self._gamma_max),
+        )
+        total_len = prompt_len + max_new
+        if total_len + self._gamma_max > cfg.max_seq_len:
+            raise RuntimeError(
+                f"kv-handoff rejected: prompt_len {prompt_len} + max_new "
+                f"{max_new} exceeds this worker's position budget "
+                f"({cfg.max_seq_len})"
+            )
+        need = -(-(total_len + self._gamma_max) // cfg.page_size)
+        if self._faults is not None:
+            self._faults.maybe_raise(
+                "alloc-fail", AllocationError, replica=self.replica_id,
+                tier=self._tier,
+            )
+        pages = self.allocator.alloc(need)
+        P = cfg.pages_per_seq
+        n_kv = state.num_pages
+        idx = np.zeros((P,), np.int32)     # pad rows → garbage page 0
+        idx[:n_kv] = pages[:n_kv]
+
+        def _pad(arr: np.ndarray) -> np.ndarray:
+            out = np.zeros((arr.shape[0], P) + arr.shape[2:], arr.dtype)
+            out[:, :n_kv] = arr
+            return out
+
+        try:
+            put = partial(jax.device_put, device=self._repl)
+            operands = [put(idx), put(_pad(state.k)), put(_pad(state.v))]
+            if self._kv_quantized:
+                operands += [put(_pad(state.ks)), put(_pad(state.vs))]
+            # _host_crossing: the padded page payload rides up as one
+            # deliberate upload (the handoff's whole point).
+            with _host_crossing():
+                self.paged = self._jit_kv_restore(self.paged, *operands)
+        except Exception as e:
+            self.allocator.release_all(pages)
+            raise RuntimeError(f"kv-handoff restore failed: {e}") from e
+        if request.trace is not None:
+            request.trace.child(
+                "queue_wait",
+                start=request.timings.enqueued,
+                end=request.timings.prefill_start,
+            )
+        seed = state.seed & 0xFFFFFFFFFFFFFFFF
+        seed_row = np.array(
+            [(seed >> 32) & 0xFFFFFFFF, seed & 0xFFFFFFFF], np.uint32
+        ).view(np.int32)
+        slot = _Slot(request=request, pages=pages, position_cap=total_len)
+        slot.generated = 1
+        slot.seed_row = seed_row
+        slot.prompt_len = prompt_len
+        slot.prompt_ids = np.asarray(state.prompt_ids, np.int32)
+        self._slots[slot_idx] = slot
+        token = int(state.first_token)
+        seq_len = prompt_len + 1
+        live = token != self.tokenizer.eos_id and seq_len < total_len
+        # Host mirrors become the source of truth; the dirty flag folds
+        # them (and the restored pool) in before the next dispatch —
+        # the same full-transition discipline as recovery.
+        table = np.zeros((P,), np.int32)
+        table[:len(pages)] = pages
+        self._page_tables[slot_idx] = table
+        self._seq_lens[slot_idx] = seq_len
+        self._last_tokens[slot_idx] = token
+        self._caps[slot_idx] = total_len
+        self._temperature[slot_idx] = request.temperature
+        self._top_p[slot_idx] = request.top_p
+        self._top_k[slot_idx] = self._eff_top_k(request)
+        self._seeds[slot_idx] = seed_row
+        self._active[slot_idx] = live
+        slot.merged = live
+        self._dev_dirty = True
+        if self.timeline is not None:
+            self.timeline.admit(
+                slot_idx, self._trace_id_of(request), prompt_len
+            )
+            self.timeline.note(
+                "handoff_restore", slot=slot_idx, pages=n_kv,
+                seq_len=seq_len,
+            )
+        request.timings.first_token = time.monotonic()
+        slot.last_emit = request.timings.first_token
+        if self.timeline is not None:
+            self.timeline.slot_start(slot_idx, self._trace_id_of(request))
+        if request.trace is not None:
+            request.trace.child(
+                "prefill",
+                start=request.timings.prefill_start,
+                end=request.timings.first_token,
+                prompt_tokens=prompt_len, handoff=True,
+            )
+            slot.decode_span = request.trace.child(
+                "decode", start=request.timings.first_token
+            )
+        request.out.put(("token", token))
+        self._maybe_finish(slot_idx, token)
+        return None
 
     def _drain_inflight(self) -> None:
         """Process every in-flight block and deliver every pending first
@@ -2249,8 +2514,8 @@ class InferenceEngine:
             # device call: they block the engine thread exactly where the
             # real dispatch would, so the watchdog's no-progress clock
             # sees the genuine failure shape.
-            self._faults.maybe_sleep("step-stall", replica=self.replica_id)
-            self._faults.maybe_sleep("slow-step", replica=self.replica_id)
+            self._faults.maybe_sleep("step-stall", replica=self.replica_id, tier=self._tier)
+            self._faults.maybe_sleep("slow-step", replica=self.replica_id, tier=self._tier)
         if self._dev_dirty:
             # Rare (init / retire-failure recovery): mirrors must be
             # complete before they become the device state — deliver any

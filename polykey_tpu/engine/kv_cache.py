@@ -19,11 +19,16 @@ slots point at it so masked lanes always have a safe write target.
 from __future__ import annotations
 
 import ctypes
+import json
 import os
+import struct as _struct
+import zlib
+from dataclasses import dataclass
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
 
 from ..models.config import ModelConfig
@@ -191,3 +196,238 @@ def kv_pool_bytes(
     else:
         per_slot = cfg.num_kv_heads * cfg.head_dim * jnp.dtype(dtype).itemsize
     return 2 * cfg.num_layers * num_pages * page_size * per_slot
+
+
+# -- KV handoff wire format (ISSUE 13) ----------------------------------------
+# A prefill-tier worker ships a finished prompt's KV state to a
+# decode-tier worker as one self-describing byte blob: gathered page
+# contents (k/v, plus the int8 pair-form scale pools when quantized),
+# the block-table ordering (implicit: pages ship in table order and the
+# target re-maps them to its own page ids), and the prefix/prompt
+# metadata the target needs to resume decode bit-identically (prompt
+# ids, first sampled token, RNG seed). Everything is raw array bytes —
+# no dtype conversion anywhere — so fp32 and int8 pools round-trip
+# bit-identically; bf16 rides ml_dtypes through numpy unchanged.
+#
+# Layout:  MAGIC(4) | version u16 | header_len u32 | header JSON |
+#          payload bytes | crc32(payload) u32
+# The header's `arrays` table records each array's dtype/shape/offset
+# within the payload. A truncated blob fails the length check (or the
+# trailing CRC) and raises KVWireError — a typed, recoverable rejection
+# the coordinator turns into a clean re-route instead of a corrupted
+# target pool.
+
+KV_WIRE_MAGIC = b"PKKV"
+KV_WIRE_VERSION = 1
+
+
+class KVWireError(RuntimeError):
+    """The handoff blob cannot be (safely) applied: bad magic/version,
+    geometry mismatch against the target pool, or a truncated/corrupt
+    payload. Always raised BEFORE any target-pool write, so a rejected
+    handoff never leaves partial state behind."""
+
+
+@dataclass
+class KVHandoffState:
+    """One request's prefill-complete KV state, host-side.
+
+    Arrays use the pool layout with the page axis restricted to this
+    request's pages in block-table order: k/v are
+    [L, n_pages, page_size, Hk, D]; ks/vs (int8 pools only) are
+    [L, n_pages, page_size, Hk]. `prompt_ids` is the tokenized (and
+    possibly tail-truncated) prompt — positions 0..prompt_len-1 are the
+    ones the pages hold KV for. `first_token` was sampled at position
+    key prompt_len with `seed`, exactly as a single-process prefill
+    would; the target resumes decode at seq_len = prompt_len + 1."""
+
+    model: str
+    page_size: int
+    prompt_len: int
+    first_token: int
+    seed: int
+    prompt_ids: np.ndarray
+    k: np.ndarray
+    v: np.ndarray
+    ks: Optional[np.ndarray] = None
+    vs: Optional[np.ndarray] = None
+
+    @property
+    def num_pages(self) -> int:
+        return int(self.k.shape[1])
+
+    @property
+    def quantized(self) -> bool:
+        return self.ks is not None
+
+    def validate_for(self, cfg: ModelConfig, page_size: int,
+                     quantized: bool) -> None:
+        """Raise KVWireError unless this state fits the target pool's
+        geometry exactly — the guard that keeps a mismatched handoff a
+        typed rejection instead of silent pool corruption."""
+        expect = (cfg.num_layers, self.num_pages, page_size,
+                  cfg.num_kv_heads, cfg.head_dim)
+        if self.model != cfg.name:
+            raise KVWireError(
+                f"kv-handoff model mismatch: blob for {self.model!r}, "
+                f"target serves {cfg.name!r}"
+            )
+        if self.page_size != page_size:
+            raise KVWireError(
+                f"kv-handoff page_size mismatch: blob {self.page_size}, "
+                f"target pool {page_size}"
+            )
+        if tuple(self.k.shape) != expect or tuple(self.v.shape) != expect:
+            raise KVWireError(
+                f"kv-handoff geometry mismatch: pages {self.k.shape} vs "
+                f"target {expect}"
+            )
+        if quantized != self.quantized:
+            raise KVWireError(
+                "kv-handoff dtype mismatch: blob is "
+                f"{'int8' if self.quantized else 'full-precision'}, target "
+                f"pool is {'int8' if quantized else 'full-precision'}"
+            )
+        needed = -(-self.prompt_len // page_size)
+        if self.num_pages != needed:
+            raise KVWireError(
+                f"kv-handoff page count {self.num_pages} does not cover "
+                f"prompt_len {self.prompt_len} (need {needed})"
+            )
+
+
+def _array_entries(state: KVHandoffState) -> list[tuple[str, np.ndarray]]:
+    entries = [
+        ("prompt_ids", np.ascontiguousarray(state.prompt_ids, np.int32)),
+        ("k", np.ascontiguousarray(state.k)),
+        ("v", np.ascontiguousarray(state.v)),
+    ]
+    if state.ks is not None:
+        entries.append(("ks", np.ascontiguousarray(state.ks)))
+        entries.append(("vs", np.ascontiguousarray(state.vs)))
+    return entries
+
+
+def serialize_kv_state(state: KVHandoffState) -> bytes:
+    """Render a KVHandoffState as one wire blob (see module comment)."""
+    entries = _array_entries(state)
+    arrays = []
+    payload_parts = []
+    offset = 0
+    for name, arr in entries:
+        raw = arr.tobytes()
+        arrays.append({
+            "name": name,
+            # jnp.dtype resolves ml_dtypes names (bfloat16) that plain
+            # numpy's dtype constructor does not.
+            "dtype": str(arr.dtype),
+            "shape": list(arr.shape),
+            "offset": offset,
+            "nbytes": len(raw),
+        })
+        payload_parts.append(raw)
+        offset += len(raw)
+    payload = b"".join(payload_parts)
+    header = json.dumps({
+        "model": state.model,
+        "page_size": state.page_size,
+        "prompt_len": state.prompt_len,
+        "first_token": int(state.first_token),
+        "seed": int(state.seed),
+        "quantized": state.quantized,
+        "arrays": arrays,
+        "payload_bytes": len(payload),
+    }).encode()
+    return b"".join([
+        KV_WIRE_MAGIC,
+        _struct.pack("!HI", KV_WIRE_VERSION, len(header)),
+        header,
+        payload,
+        _struct.pack("!I", zlib.crc32(payload) & 0xFFFFFFFF),
+    ])
+
+
+def _parse_header(buf: bytes) -> tuple[dict, int]:
+    """(header dict, payload start offset); raises KVWireError on a blob
+    too short or malformed to even carry a header."""
+    head = len(KV_WIRE_MAGIC) + 6
+    if len(buf) < head:
+        raise KVWireError(
+            f"kv-handoff blob truncated: {len(buf)} bytes is shorter than "
+            "the fixed header"
+        )
+    if buf[:4] != KV_WIRE_MAGIC:
+        raise KVWireError(
+            f"kv-handoff bad magic {buf[:4]!r} (expected {KV_WIRE_MAGIC!r})"
+        )
+    version, header_len = _struct.unpack("!HI", buf[4:head])
+    if version != KV_WIRE_VERSION:
+        raise KVWireError(
+            f"kv-handoff version {version} unsupported (this build speaks "
+            f"{KV_WIRE_VERSION})"
+        )
+    if len(buf) < head + header_len:
+        raise KVWireError("kv-handoff blob truncated inside the header")
+    try:
+        header = json.loads(buf[head:head + header_len])
+    except ValueError as e:
+        raise KVWireError(f"kv-handoff header unparsable: {e}") from e
+    return header, head + header_len
+
+
+def validate_kv_blob(buf: bytes) -> dict:
+    """Light structural validation (header + framing + CRC) WITHOUT
+    materializing arrays — what the coordinator runs on a fetched blob
+    before paying a ship to the decode tier. Returns the header dict;
+    raises KVWireError on any truncation/corruption."""
+    header, start = _parse_header(buf)
+    payload_bytes = int(header.get("payload_bytes", -1))
+    expected = start + payload_bytes + 4
+    if payload_bytes < 0 or len(buf) < expected:
+        raise KVWireError(
+            f"kv-handoff blob truncated: have {len(buf)} bytes, framing "
+            f"declares {expected} (partial write?)"
+        )
+    payload = buf[start:start + payload_bytes]
+    (crc,) = _struct.unpack(
+        "!I", buf[start + payload_bytes:start + payload_bytes + 4]
+    )
+    if crc != (zlib.crc32(payload) & 0xFFFFFFFF):
+        raise KVWireError("kv-handoff payload CRC mismatch (corrupt blob)")
+    return header
+
+
+def deserialize_kv_state(buf: bytes) -> KVHandoffState:
+    """Parse a wire blob back into a KVHandoffState, bit-identically
+    (raw-byte round-trip, no dtype conversion). Raises KVWireError on
+    bad magic/version, truncation, or CRC mismatch — never applies a
+    partial blob."""
+    header = validate_kv_blob(buf)
+    _, start = _parse_header(buf)
+    payload = buf[start:start + int(header["payload_bytes"])]
+    arrays: dict[str, np.ndarray] = {}
+    for entry in header["arrays"]:
+        raw = payload[entry["offset"]:entry["offset"] + entry["nbytes"]]
+        if len(raw) != entry["nbytes"]:
+            raise KVWireError(
+                f"kv-handoff array {entry['name']!r} truncated"
+            )
+        arr = np.frombuffer(
+            raw, dtype=jnp.dtype(entry["dtype"])
+        ).reshape(entry["shape"])
+        arrays[entry["name"]] = arr
+    for required in ("prompt_ids", "k", "v"):
+        if required not in arrays:
+            raise KVWireError(f"kv-handoff blob missing array {required!r}")
+    return KVHandoffState(
+        model=header["model"],
+        page_size=int(header["page_size"]),
+        prompt_len=int(header["prompt_len"]),
+        first_token=int(header["first_token"]),
+        seed=int(header["seed"]),
+        prompt_ids=arrays["prompt_ids"],
+        k=arrays["k"],
+        v=arrays["v"],
+        ks=arrays.get("ks"),
+        vs=arrays.get("vs"),
+    )

@@ -184,6 +184,12 @@ class ReplicaPool:
         self.requests_rerouted = 0
         self.streams_resumed = 0
         self.router_decisions = {reason: 0 for reason in _ROUTE_REASONS}
+        # Recovery-hint inputs (ISSUE 13 satellite): how often the
+        # supervisors poll (create() overwrites with its real interval)
+        # — the no-healthy-replica UNAVAILABLE carries an estimated
+        # retry-after derived from it, so clients back off on the
+        # SERVER's recovery clock instead of hammering a restarting tier.
+        self._supervisor_interval_s = 0.5
         # Pool-assigned seeds for seedless sampled requests: a resumed
         # attempt must replay the SAME stream, so the root is fixed
         # before the first attempt instead of drawn inside one engine.
@@ -225,6 +231,7 @@ class ReplicaPool:
                 "Supervised in-process engine restarts.",
             )
         pool = cls(config, health=health, logger=logger, recorder=recorder)
+        pool._supervisor_interval_s = supervisor_interval_s
         # Phase 1 — construct everything with replicas registered (state
         # NEW) before any watchdog/supervisor thread starts, so a shim
         # callback can never index a replica that isn't there yet.
@@ -398,9 +405,32 @@ class ReplicaPool:
             request.replica = replica.index
             self._count_decision(reason)
             return
+        # No-healthy-replica fall-through: UNAVAILABLE with an
+        # estimated-recovery hint (ISSUE 13 satellite). Previously only
+        # the shed path attached retry-after-ms, so clients re-hit a
+        # recovering tier at full rate exactly when it could least
+        # afford it.
         raise EngineDeadError(
-            self.dead or "no serving replica available"
+            self.dead or "no serving replica available",
+            retry_after_ms=self._recovery_hint_ms(),
         )
+
+    def _recovery_hint_ms(self) -> Optional[int]:
+        """Estimated time until a replica could serve again: while any
+        replica is DRAINING/RESTARTING a supervised restart is in
+        flight — a couple of supervisor poll intervals is the earliest
+        it can complete. All-DEAD means platform recycle: hint a
+        conservative second so retries don't spin. None only when the
+        pool is empty (nothing to estimate)."""
+        with self._lock:
+            if not self.replicas:
+                return None
+            recovering = any(
+                r.state in (DRAINING, RESTARTING, NEW) for r in self.replicas
+            )
+        if recovering:
+            return max(100, int(2000 * self._supervisor_interval_s))
+        return 1000
 
     def stats(self) -> dict:
         per = []

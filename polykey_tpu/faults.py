@@ -14,8 +14,10 @@ Spec grammar (comma- or semicolon-separated entries)::
 
     POLYKEY_FAULTS="step-stall=1.5@1,slow-step=0.01"
     POLYKEY_FAULTS="step-stall=1.0@1:replica=2"     # target one replica
+    POLYKEY_FAULTS="worker-exit=0@1:tier=prefill"   # target one tier
 
-    entry   := name [ "=" value ] [ "@" count ] [ ":replica=" index ]
+    entry   := name [ "=" value ] [ "@" count ] qualifier*
+    qualifier := ":replica=" index | ":tier=" tier
     value   := float    seconds for sleep points; ignored by raise points
                         (default 1.0)
     count   := int      how many times the point fires before going
@@ -25,8 +27,17 @@ Spec grammar (comma- or semicolon-separated entries)::
                         Without the suffix the fault fires on every
                         replica — chaos tests that kill ONE replica
                         while the others serve need the targeting.
+    tier    := prefill | decode
+                        fire only inside a disaggregated worker of that
+                        tier (engine/worker.py; engines pass their
+                        config.disagg_tier). A tier-targeted fault is
+                        NEVER consumed by an untiered caller, so a
+                        single-process engine can't accidentally eat a
+                        fault aimed at one worker tier. Qualifiers
+                        compose: ":replica=1:tier=decode" targets the
+                        second decode-tier worker.
 
-Points (all consumed by engine/engine.py):
+Points (consumed by engine/engine.py unless noted):
 
 - ``step-stall``   — sleep `value` s inside the decode dispatch (a wedged
                      device call; trips the watchdog when it exceeds
@@ -39,6 +50,20 @@ Points (all consumed by engine/engine.py):
                      (device-side compile/execute failure).
 - ``tokenizer-error`` — raise RuntimeError at prompt tokenization
                      (malformed-input handling at admission).
+- ``kv-handoff-drop`` — engine/worker.py: corrupt the serialized KV
+                     handoff payload at ship time (truncate to half),
+                     exercising the coordinator's partial-write →
+                     clean-re-route path.
+- ``handoff-delay``— engine/worker.py: sleep `value` s before shipping a
+                     KV handoff payload (a slow/congested transfer link;
+                     widens the mid-handoff kill window).
+- ``worker-exit``  — engine/worker.py: the worker process dies
+                     (os._exit). The VALUE selects the death site, so a
+                     drill can target one handoff phase exactly:
+                     ``0`` → op intake (queued/mid-prefill death),
+                     ``1`` → payload fetch (mid-handoff death),
+                     ``>= 2`` → after forwarding `value` tokens of a
+                     decode stream (mid-decode death).
 
 The injector is intentionally module-shared: a supervised restart builds
 a *fresh* engine, and a one-shot fault (``@1``) must stay spent across
@@ -55,8 +80,11 @@ from typing import Optional
 
 POINTS = frozenset(
     {"step-stall", "slow-step", "alloc-fail", "prefill-error",
-     "tokenizer-error"}
+     "tokenizer-error", "kv-handoff-drop", "handoff-delay", "worker-exit"}
 )
+
+# Valid ":tier=" targets (the disaggregated worker tiers, engine/worker.py).
+TIERS = ("prefill", "decode")
 
 ENV_VAR = "POLYKEY_FAULTS"
 
@@ -67,6 +95,7 @@ class _Fault:
     remaining: Optional[int] = None  # None → unlimited
     fired: int = 0
     replica: Optional[int] = None    # None → fires on every replica
+    tier: Optional[str] = None       # None → fires on every tier
 
 
 class FaultInjector:
@@ -86,17 +115,29 @@ class FaultInjector:
             if not entry:
                 continue
             replica: Optional[int] = None
-            if ":" in entry:
-                # Replica targeting rides a trailing ":replica=N" so chaos
-                # tests can kill one pool replica while the others serve.
+            tier: Optional[str] = None
+            while ":" in entry:
+                # Trailing qualifiers, rightmost first: ":replica=N"
+                # targets one pool replica, ":tier=prefill|decode" one
+                # disaggregated worker tier; they compose in any order.
                 entry, target = entry.rsplit(":", 1)
-                key, _, index_s = target.partition("=")
-                if key.strip() != "replica":
+                key, _, value_s = target.partition("=")
+                key = key.strip()
+                if key == "replica":
+                    replica = int(value_s)
+                elif key == "tier":
+                    tier = value_s.strip()
+                    if tier not in TIERS:
+                        raise ValueError(
+                            f"unknown fault tier {tier!r}; valid tiers: "
+                            f"{', '.join(TIERS)}"
+                        )
+                else:
                     raise ValueError(
                         f"unknown fault qualifier {target!r}; only "
-                        "':replica=N' is supported"
+                        "':replica=N' and ':tier=prefill|decode' are "
+                        "supported"
                     )
-                replica = int(index_s)
             count: Optional[int] = None
             if "@" in entry:
                 entry, count_s = entry.rsplit("@", 1)
@@ -112,20 +153,23 @@ class FaultInjector:
                     f"{', '.join(sorted(POINTS))}"
                 )
             self._faults.setdefault(name, []).append(_Fault(
-                value=value, remaining=count, replica=replica
+                value=value, remaining=count, replica=replica, tier=tier
             ))
 
-    def _take(self, point: str, replica: Optional[int] = None) -> Optional[float]:
+    def _take(self, point: str, replica: Optional[int] = None,
+              tier: Optional[str] = None) -> Optional[float]:
         """Consume one firing of `point` — the first armed entry whose
-        replica target matches; returns its value, or None when the
-        point is unarmed, exhausted, or targeted elsewhere (`replica`
-        is the caller's replica index; callers that pass None only
-        consume untargeted faults)."""
+        replica AND tier targets match; returns its value, or None when
+        the point is unarmed, exhausted, or targeted elsewhere (`replica`
+        / `tier` are the caller's identity; callers that pass None only
+        consume faults untargeted on that axis)."""
         with self._lock:
             for fault in self._faults.get(point, ()):
                 if fault.remaining == 0:
                     continue
                 if fault.replica is not None and replica != fault.replica:
+                    continue
+                if fault.tier is not None and tier != fault.tier:
                     continue
                 if fault.remaining is not None:
                     fault.remaining -= 1
@@ -133,17 +177,40 @@ class FaultInjector:
                 return fault.value
             return None
 
-    def maybe_sleep(self, point: str, replica: Optional[int] = None) -> None:
+    def take_if(self, point: str, pred, replica: Optional[int] = None,
+                tier: Optional[str] = None) -> Optional[float]:
+        """Like `_take`, but only consumes an armed entry whose VALUE
+        satisfies `pred` — the worker-exit site selector (a fetch-site
+        kill must not be eaten by the intake site it passes first)."""
+        with self._lock:
+            for fault in self._faults.get(point, ()):
+                if fault.remaining == 0:
+                    continue
+                if fault.replica is not None and replica != fault.replica:
+                    continue
+                if fault.tier is not None and tier != fault.tier:
+                    continue
+                if not pred(fault.value):
+                    continue
+                if fault.remaining is not None:
+                    fault.remaining -= 1
+                fault.fired += 1
+                return fault.value
+            return None
+
+    def maybe_sleep(self, point: str, replica: Optional[int] = None,
+                    tier: Optional[str] = None) -> None:
         """Sleep the point's value (seconds) if it fires. Sleeping stands
         in for a wedged/slow device call, so it deliberately blocks the
         calling thread exactly where the real stall would."""
-        value = self._take(point, replica)
+        value = self._take(point, replica, tier)
         if value is not None and value > 0:
             time.sleep(value)
 
     def maybe_raise(self, point: str, exc_type: type = RuntimeError,
-                    replica: Optional[int] = None) -> None:
-        if self._take(point, replica) is not None:
+                    replica: Optional[int] = None,
+                    tier: Optional[str] = None) -> None:
+        if self._take(point, replica, tier) is not None:
             raise exc_type(f"injected fault: {point}")
 
     def fired(self, point: str) -> int:

@@ -41,6 +41,11 @@ RETRY_AFTER_MS_KEY = "retry-after-ms"
 # `received_tokens` and get only the missing suffix (client.py).
 REPLICA_KEY = "replica"
 RESTARTED_KEY = "restarted"
+# Disaggregated-tier trailer (ISSUE 13): which prefill/decode worker
+# pair served the request ("prefill=P,decode=D"), stamped only when the
+# backend is a DisaggPool — the per-request routing breadcrumb the
+# worker-death runbook starts from.
+TIER_KEY = "tier"
 RESUME_SUPPORTED_KEY = "resume-supported"
 RESUME_TOKENS_KEY = "resume-tokens"
 # Device-time attribution (ISSUE 10): successful LLM RPCs carry the

@@ -28,6 +28,7 @@ Tools:
 from __future__ import annotations
 
 import math
+import os
 import queue
 import time
 from typing import Iterator, Optional
@@ -142,9 +143,14 @@ class TpuService(Service):
         A `ReplicaPool` passes through as-is: the pool already owns a
         watchdog and supervisor PER REPLICA (plus the aggregate-health
         wiring), so the single-engine supervision built here would be
-        redundant and wrong (one watchdog cannot watch N engines)."""
+        redundant and wrong (one watchdog cannot watch N engines). A
+        `DisaggPool` (ISSUE 13) passes through for the same reason —
+        its supervision lives inside each worker process, its liveness
+        in the coordinator's heartbeat."""
+        from ..engine.disagg_pool import DisaggPool
+
         service = cls(engine, None, secrets=secrets, logger=logger, obs=obs)
-        if isinstance(engine, ReplicaPool):
+        if isinstance(engine, (ReplicaPool, DisaggPool)):
             return service
         recorder = obs.recorder if obs is not None else None
         watchdog = Watchdog(
@@ -196,7 +202,19 @@ class TpuService(Service):
         # jax config mutated under them). Restarts skip the 20-40 s/step
         # TPU recompiles; POLYKEY_COMPILE_CACHE=0 opts out.
         enable_persistent_compile_cache()
-        if config.replicas > 1:
+        if config.disagg:
+            # Disaggregated tiers (ISSUE 13): POLYKEY_DISAGG="PxD"
+            # spawns prefill/decode worker PROCESSES behind the
+            # coordinator. Unset (default) never takes this branch — no
+            # processes, no pool, single-process paths byte-identical.
+            from ..engine.disagg_pool import DisaggPool
+
+            engine = DisaggPool.create(
+                config, health=health, logger=logger, obs=obs,
+                state_dir=os.environ.get("POLYKEY_DISAGG_STATE_DIR")
+                or None,
+            )
+        elif config.replicas > 1:
             # Replica tier (ISSUE 9): POLYKEY_REPLICAS engines behind
             # the routing pool. POLYKEY_REPLICAS=1 (default) never takes
             # this branch — the single-engine wiring below is unchanged.
@@ -306,7 +324,17 @@ class TpuService(Service):
                 str(e), retry_after_ms=e.retry_after_ms
             ) from e
         except EngineDeadError as e:
-            raise errors.UnavailableError(str(e)) from e
+            # The no-healthy-replica path (replica/disagg pools) carries
+            # an estimated-recovery hint: without the trailer, every
+            # shed-free client hammers a recovering tier at its own
+            # backoff schedule instead of the server's (ISSUE 13 fix).
+            trailers: tuple = ()
+            retry_after = getattr(e, "retry_after_ms", None)
+            if retry_after is not None:
+                trailers = (
+                    (errors.RETRY_AFTER_MS_KEY, str(int(retry_after))),
+                )
+            raise errors.UnavailableError(str(e), trailers=trailers) from e
 
     @staticmethod
     def _engine_error(message: str, delivered: Optional[int] = None) -> Exception:
@@ -364,6 +392,11 @@ class TpuService(Service):
             trailers.append((errors.REPLICA_KEY, str(replica)))
             if getattr(request, "restarted", False):
                 trailers.append((errors.RESTARTED_KEY, "1"))
+        tier = getattr(request, "tier", None)
+        if tier is not None:
+            # Disagg tier breadcrumb (ISSUE 13): which prefill/decode
+            # worker pair served this request.
+            trailers.append((errors.TIER_KEY, str(tier)))
         if trailers:
             errors.add_rpc_trailers(*trailers)
 

@@ -259,6 +259,143 @@ def _slo_lines(members: list) -> list[str]:
     return lines
 
 
+class _WireHist:
+    """Histogram stand-in over bucket counts shipped from a worker
+    process (DisaggPool stats `_hists` entries): render-compatible with
+    `render_histogram_samples` without a live Histogram object in this
+    process. Exemplars don't cross the control plane (None)."""
+
+    def __init__(self, spec: dict):
+        self._bounds = list(spec.get("bounds", ()))
+        self._counts = list(spec.get("counts", ()))
+        self._sum = float(spec.get("sum", 0.0))
+
+    def snapshot(self) -> dict:
+        cumulative = []
+        running = 0
+        for bound, count in zip(self._bounds, self._counts[:-1]):
+            running += count
+            cumulative.append((bound, running))
+        total = running + (self._counts[-1] if self._counts else 0)
+        return {"buckets": cumulative, "inf": total, "sum": self._sum,
+                "count": total}
+
+    def exemplars(self):
+        return None
+
+
+# Worker-histogram keys shipped over the control plane → the engine
+# family they render as.
+_DISAGG_HISTS = {"polykey_ttft_ms": "ttft_ms", "polykey_itl_ms": "itl_ms"}
+
+
+def _disagg_lines(pool) -> list[str]:
+    """Exposition for a DisaggPool (ISSUE 13): every engine family
+    rendered once per WORKER with {tier, replica} labels (the per-tier
+    labels on the PR 7 replica families), the replica-state machine
+    keyed by tier, and the coordinator-owned handoff families. Worker
+    snapshots come from the pool's cached control-plane stats — a dead
+    worker's last snapshot keeps rendering (counters are monotonic),
+    its state gauge tells the truth."""
+    from ..engine.replica_pool import STATES  # lazy: obs must not import engine at module load
+
+    stats = pool.stats()
+    members = [
+        ({"tier": snap.get("tier", "?"),
+          "replica": str(snap.get("replica", i))}, snap)
+        for i, snap in enumerate(stats.get("per_worker", ()))
+    ]
+    lines: list[str] = []
+    for kind, name, help_text, key in _ENGINE_FAMILIES:
+        if kind == "phases":
+            lines += render_header(name, help_text, "counter")
+            for labels, snap in members:
+                for phase in ("queued", "prefill", "decode"):
+                    lines.append(render_sample(
+                        name, {**labels, "phase": phase},
+                        snap.get(f"deadline_expired_{phase}", 0),
+                    ))
+        elif kind == "hist":
+            if name not in _DISAGG_HISTS:
+                continue    # bucket counts for these don't cross the wire
+            lines += render_header(name, help_text, "histogram")
+            for labels, snap in members:
+                spec = (snap.get("_hists") or {}).get(_DISAGG_HISTS[name])
+                if spec:
+                    lines += _histogram_samples(name, labels,
+                                                _WireHist(spec))
+        else:
+            lines += render_header(name, help_text, kind)
+            for labels, snap in members:
+                lines.append(render_sample(name, labels,
+                                           snap.get(key, 0) or 0))
+    # Worker lifecycle, tier-labeled (the state machine is shared with
+    # the in-process pool — COMPONENTS.md §12/§16).
+    lines += render_header(
+        "polykey_replica_state",
+        "Worker lifecycle (1 for the worker's current state; states: "
+        + ", ".join(STATES) + ").",
+        "gauge",
+    )
+    for name_key, state in sorted(stats.get("tier_states", {}).items()):
+        tier, _, index = name_key.partition("/")
+        for candidate in STATES:
+            lines.append(render_sample(
+                "polykey_replica_state",
+                {"tier": tier, "replica": index, "state": candidate},
+                1 if state == candidate else 0,
+            ))
+    lines += render_header(
+        "polykey_replicas_serving",
+        "Workers currently in SERVING state, per tier.",
+        "gauge",
+    )
+    for tier, counts in sorted(stats.get("tiers", {}).items()):
+        lines.append(render_sample(
+            "polykey_replicas_serving", {"tier": tier},
+            counts.get("serving", 0),
+        ))
+    lines += render_counter(
+        "polykey_requests_rerouted_total",
+        "Requests re-routed to other workers after a worker failure "
+        "(any handoff phase; the re-run replays with delivered tokens "
+        "suppressed).",
+        stats.get("requests_rerouted", 0),
+    )
+    lines += render_counter(
+        "polykey_streams_resumed_total",
+        "Mid-stream requests resumed on another worker with "
+        "already-delivered tokens suppressed.",
+        stats.get("streams_resumed", 0),
+    )
+    # Handoff families (ISSUE 13 satellites) — coordinator-owned.
+    lines += render_header(
+        "polykey_handoffs_total",
+        "KV handoffs by outcome: ok (decode completed), retried (one "
+        "attempt re-routed), aborted (re-route budget exhausted).",
+        "counter",
+    )
+    for outcome, count in sorted(stats.get("handoffs", {}).items()):
+        lines.append(render_sample(
+            "polykey_handoffs_total", {"outcome": outcome}, count,
+        ))
+    lines += render_counter(
+        "polykey_handoff_bytes_total",
+        "Serialized KV bytes fetched from the prefill tier (wire-format "
+        "blobs; each decode ship re-counts nothing — this is the fetch "
+        "side).",
+        stats.get("handoff_bytes", 0),
+    )
+    lines += render_header(
+        "polykey_handoff_ms",
+        "End-to-end handoff latency, ms: prefill-side fetch start to "
+        "decode-side accept.",
+        "histogram",
+    )
+    lines += _histogram_samples("polykey_handoff_ms", {}, pool.handoff_ms)
+    return lines
+
+
 def engine_collector(engine_or_provider):
     """Scrape-time collector over a live InferenceEngine OR a
     ReplicaPool: counters and gauges come from `stats()` snapshots (the
@@ -279,6 +416,10 @@ def engine_collector(engine_or_provider):
             engine_or_provider()
             if callable(engine_or_provider) else engine_or_provider
         )
+        if hasattr(target, "workers"):
+            # Disaggregated pool (ISSUE 13): per-worker snapshots ride
+            # the control plane; families render {tier, replica}-labeled.
+            return _disagg_lines(target)
         pool = target if hasattr(target, "replicas") else None
         if pool is not None:
             members = [

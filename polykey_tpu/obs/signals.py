@@ -715,6 +715,12 @@ class SignalPlane:
 
 
 def _engines_of(engine_or_pool) -> list[tuple[int, object]]:
+    if hasattr(engine_or_pool, "workers"):
+        # Disaggregated pool (ISSUE 13): the engines live in other
+        # processes — no in-process planes to read or bind. The snapshot
+        # degrades to its gateway section; per-worker windowed stats
+        # ride the pool's control-plane stats instead.
+        return []
     if hasattr(engine_or_pool, "replicas"):
         return [(rep.index, rep.engine) for rep in engine_or_pool.replicas]
     return [(getattr(engine_or_pool, "replica_id", 0), engine_or_pool)]

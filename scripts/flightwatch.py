@@ -145,22 +145,26 @@ def render(families: dict, slo: dict, now: str, target: str) -> str:
             )
         lines.append("")
 
-    if replicas:
-        lines.append("REPLICAS       state        q-delay    load")
-        for index in sorted(replicas, key=int):
-            now_sig = replicas[index].get("now") or {}
-            state = metric(families, "polykey_replica_state",
-                           replica=index, state="SERVING")
-            state_name = "SERVING" if state == 1 else (
-                next((s for s in ("DRAINING", "RESTARTING", "DEAD", "NEW")
-                      if metric(families, "polykey_replica_state",
-                                replica=index, state=s) == 1), "?")
-                if metric(families, "polykey_replica_state",
-                          replica=index, state="SERVING") is not None
-                else "-")
+    # Worker/replica rows come from the replica_state gauge itself so a
+    # DISAGGREGATED pool (tier-labeled, no /debug/slo planes in the
+    # coordinator) renders alongside the in-process pool; the slo
+    # "now" signals merge in per replica index when present.
+    rows: dict[tuple, str] = {}
+    for sample_labels, value in families.get("polykey_replica_state", ()):
+        if value != 1:
+            continue
+        key = (sample_labels.get("tier", "-"),
+               sample_labels.get("replica", "?"))
+        rows[key] = sample_labels.get("state", "?")
+    if not rows and replicas:
+        rows = {("-", index): "?" for index in replicas}
+    if rows:
+        lines.append("REPLICAS       tier      state        q-delay    load")
+        for (tier, index), state_name in sorted(rows.items()):
+            now_sig = (replicas.get(index) or {}).get("now") or {}
             lines.append(
-                "  {:<12} {:<12} {:>7} {:>7}".format(
-                    f"replica {index}", state_name,
+                "  {:<12} {:<9} {:<12} {:>7} {:>7}".format(
+                    f"replica {index}", tier, state_name,
                     _fmt(now_sig.get("queue_delay_s"), "{:.3f}"),
                     _fmt(now_sig.get("load_fraction"), "{:.2f}"),
                 )

@@ -121,6 +121,22 @@ POOL_FAMILIES = (
     'polykey_deadline_expired_total{phase="queued",replica="1"}',
 )
 
+# Disaggregated-tier families (ISSUE 13): engine families carry
+# {tier, replica} labels per worker, the handoff counters/histogram are
+# coordinator-owned, and the worker state machine renders per tier.
+DISAGG_FAMILIES = (
+    'polykey_requests_completed_total{replica="0",tier="prefill"}',
+    'polykey_requests_completed_total{replica="0",tier="decode"}',
+    'polykey_ttft_ms_bucket{le="+Inf",replica="0",tier="decode"}',
+    'polykey_replica_state{replica="0",state="SERVING",tier="prefill"} 1',
+    'polykey_replica_state{replica="0",state="SERVING",tier="decode"} 1',
+    'polykey_replicas_serving{tier="prefill"} 1',
+    'polykey_replicas_serving{tier="decode"} 1',
+    'polykey_handoffs_total{outcome="ok"} 1',
+    "polykey_handoff_bytes_total",
+    'polykey_handoff_ms_bucket{le="+Inf"} 1',
+)
+
 
 def scrape(port: int) -> str:
     with urllib.request.urlopen(
@@ -479,6 +495,61 @@ def pool_smoke() -> list:
     return failures
 
 
+def disagg_smoke() -> list:
+    """Disaggregated-tier exposition (ISSUE 13): one prefill + one
+    decode worker (in-process servers over real localhost sockets)
+    behind the coordinator, one generation through the service, then
+    assert the tier-labeled engine families, the handoff families, and
+    the pool timeline's handoff lifecycle notes."""
+    from polykey_tpu.engine.disagg_pool import DisaggPool
+    from polykey_tpu.engine.worker import WorkerServer
+    from polykey_tpu.obs.timeline import engine_timelines, to_perfetto
+
+    print("booting 1x1 disagg pool on CPU ...", flush=True)
+    logger = Logger(stream=open(os.devnull, "w"))
+    obs = Observability()
+    workers = [
+        WorkerServer(CONFIG, tier=tier, replica=0, seed=5,
+                     exit_mode="simulate").start()
+        for tier in ("prefill", "decode")
+    ]
+    pool = DisaggPool.create(
+        CONFIG,
+        workers=[(w.tier, ("127.0.0.1", w.port)) for w in workers],
+        logger=logger, obs=obs,
+    )
+    service = TpuService.create(pool, logger=logger, obs=obs)
+    failures: list[str] = []
+    try:
+        from google.protobuf import struct_pb2
+
+        params = struct_pb2.Struct()
+        params.update({"prompt": "disagg obs smoke", "max_tokens": 8})
+        response = service.execute_tool("llm_generate", params, None, None)
+        if response.status.code != 200:
+            failures.append("disagg llm_generate failed")
+        page = obs.registry.render()
+        for family in DISAGG_FAMILIES:
+            if family not in page:
+                failures.append(f"disagg page missing: {family}")
+        # Handoff lifecycle on the pool timeline → Perfetto export.
+        notes = [e.get("note_kind") for e in pool.timeline.events()
+                 if e["kind"] == "note"]
+        for kind in ("handoff_start", "handoff_ack"):
+            if kind not in notes:
+                failures.append(f"pool timeline missing {kind} note")
+        names = {e.get("name")
+                 for e in to_perfetto(
+                     engine_timelines(pool))["traceEvents"]}
+        if "handoff_ack" not in names:
+            failures.append("perfetto export missing handoff_ack")
+    finally:
+        service.close()
+        for worker in workers:
+            worker.stop()
+    return failures
+
+
 def main() -> int:
     logger = Logger(stream=open(os.devnull, "w"))
     obs = Observability()
@@ -573,6 +644,7 @@ def main() -> int:
         os.environ.pop("POLYKEY_DEBUG_ENDPOINTS", None)
 
     failures += pool_smoke()
+    failures += disagg_smoke()
 
     if failures:
         print("obs-smoke FAILED:")
@@ -584,7 +656,9 @@ def main() -> int:
           "serving, profiler single-flight round-trip, "
           "SLO fault→breach→recovery cycle closed, "
           f"{len(POOL_FAMILIES)} replica-pool families present, "
-          "engine_stats aggregates across replicas")
+          "engine_stats aggregates across replicas, "
+          f"{len(DISAGG_FAMILIES)} disagg-tier families present with "
+          "handoff lifecycle on the pool timeline")
     return 0
 
 

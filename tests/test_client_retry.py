@@ -308,3 +308,97 @@ def test_retry_after_parse_helpers():
     assert client_mod.retry_after_ms_from(_Err((("other", "1"),))) is None
     assert client_mod.retry_after_ms_from(_Err((("retry-after-ms", "nan!"),))) is None
     assert client_mod.retry_after_ms_from(_Err(None)) is None
+
+
+def test_unavailable_carries_no_healthy_replica_retry_hint(flaky_stack):
+    """ISSUE 13 satellite: the no-healthy-replica UNAVAILABLE (replica
+    pool submit fall-through) must carry retry-after-ms and the client
+    must wait on the SERVER's recovery estimate, exactly like a shed —
+    previously only the shed path attached the hint, so clients hammered
+    a recovering tier at their own (faster) backoff schedule."""
+    from polykey_tpu.engine.engine import EngineDeadError
+
+    class _DeadPoolService(_ScriptedService):
+        def execute_tool(self, tool_name, parameters, secret_id, metadata):
+            self.calls += 1
+            if self.script:
+                self.script.pop(0)
+                # The exact mapping tpu_service._submit applies to a
+                # pool's no-healthy-replica EngineDeadError.
+                try:
+                    raise EngineDeadError(
+                        "no serving replica available", retry_after_ms=120
+                    )
+                except EngineDeadError as e:
+                    trailers = ((errors.RETRY_AFTER_MS_KEY, "120"),)
+                    raise errors.UnavailableError(str(e), trailers=trailers)
+            return pk.ExecuteToolResponse(
+                status=cmn.Status(code=200, message="ok"),
+                string_output="recovered",
+            )
+
+    cli, service, sleeps = flaky_stack([object()])
+    # Swap the scripted service's behavior for the dead-pool shape.
+    service.__class__ = _DeadPoolService
+    resp = cli.execute_tool(_request(), timeout=5)
+    assert resp.string_output == "recovered"
+    assert len(sleeps) == 1
+    # The 120ms hint (not the 10ms computed backoff) drives the wait,
+    # scaled by at most +25% jitter — proof the trailer was honored.
+    assert 0.12 <= sleeps[0] <= 0.12 * 1.25 + 1e-9
+
+
+def test_replica_pool_dead_error_maps_to_hinted_unavailable():
+    """The service-layer mapping itself: an EngineDeadError carrying
+    retry_after_ms becomes UNAVAILABLE with the retry-after-ms trailer
+    (and one without the hint stays trailer-free)."""
+    from polykey_tpu.engine.engine import EngineDeadError
+    from polykey_tpu.gateway.tpu_service import TpuService
+
+    class _DeadEngine:
+        def submit(self, request):
+            raise EngineDeadError("no serving replica available",
+                                  retry_after_ms=250)
+
+    service = TpuService.__new__(TpuService)
+    service.engine = _DeadEngine()
+    with pytest.raises(errors.UnavailableError) as err:
+        service._submit(object())
+    assert dict(err.value.trailing_metadata()) == {
+        errors.RETRY_AFTER_MS_KEY: "250"
+    }
+
+    class _DeadEngineNoHint:
+        def submit(self, request):
+            raise EngineDeadError("engine is shut down")
+
+    service.engine = _DeadEngineNoHint()
+    with pytest.raises(errors.UnavailableError) as err:
+        service._submit(object())
+    assert err.value.trailing_metadata() == ()
+
+
+def test_pool_recovery_hint_estimates_from_supervisor_interval():
+    """ReplicaPool._recovery_hint_ms: a DRAINING/RESTARTING replica
+    means a supervised restart is in flight — the hint derives from the
+    supervisor poll interval; all-DEAD hints the conservative second."""
+    from polykey_tpu.engine.replica_pool import (
+        DEAD, DRAINING, ReplicaPool, _Replica,
+    )
+    from polykey_tpu.engine.config import EngineConfig
+
+    pool = ReplicaPool.__new__(ReplicaPool)
+    pool.config = EngineConfig()
+    pool._lock = __import__("threading").Lock()
+    pool._supervisor_interval_s = 0.25
+    pool.replicas = [
+        _Replica(index=0, engine=None, watchdog=None, supervisor=None,
+                 state=DRAINING),
+        _Replica(index=1, engine=None, watchdog=None, supervisor=None,
+                 state=DEAD),
+    ]
+    assert pool._recovery_hint_ms() == 500       # 2 x 250ms poll
+    pool.replicas[0].state = DEAD
+    assert pool._recovery_hint_ms() == 1000      # platform recycle
+    pool.replicas = []
+    assert pool._recovery_hint_ms() is None

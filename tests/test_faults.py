@@ -118,3 +118,53 @@ def test_clear_rearms_env_read(monkeypatch):
     faults.clear()
     inj = faults.get_injector()
     assert inj is not None and inj._take("slow-step") == 2.0
+
+
+# -- tier-scoped targeting (ISSUE 13) -----------------------------------------
+
+
+def test_tier_qualifier_scopes_consumption():
+    inj = faults.install("worker-exit=0@1:tier=prefill")
+    # Untiered callers (in-process engines) never consume a tiered fault.
+    assert inj._take("worker-exit") is None
+    assert inj._take("worker-exit", tier="decode") is None
+    assert inj._take("worker-exit", tier="prefill") == 0.0
+    assert inj._take("worker-exit", tier="prefill") is None   # spent
+    assert inj.fired("worker-exit") == 1
+
+
+def test_tier_and_replica_qualifiers_compose():
+    inj = faults.install("kv-handoff-drop=1@1:replica=1:tier=decode")
+    assert inj._take("kv-handoff-drop", replica=1, tier="prefill") is None
+    assert inj._take("kv-handoff-drop", replica=0, tier="decode") is None
+    assert inj._take("kv-handoff-drop", replica=1, tier="decode") == 1.0
+    # Order of qualifiers must not matter.
+    inj2 = faults.install("handoff-delay=0.2@1:tier=decode:replica=2")
+    assert inj2._take("handoff-delay", replica=2, tier="decode") == 0.2
+
+
+def test_tier_grammar_rejects_unknown_tier():
+    with pytest.raises(ValueError, match="unknown fault tier"):
+        faults.install("worker-exit:tier=frontend")
+    with pytest.raises(ValueError, match="unknown fault qualifier"):
+        faults.install("worker-exit:shard=2")
+
+
+def test_tier_budget_persists_across_get_injector(monkeypatch):
+    # The module-shared injector keeps tier budgets across engine
+    # restarts exactly like replica budgets (the @N-spent-stays-spent
+    # contract the chaos suite relies on).
+    monkeypatch.setenv(faults.ENV_VAR, "handoff-delay=0.1@1:tier=prefill")
+    faults.clear()
+    inj = faults.get_injector()
+    assert inj._take("handoff-delay", tier="prefill") == 0.1
+    again = faults.get_injector()
+    assert again is inj
+    assert again._take("handoff-delay", tier="prefill") is None
+
+
+def test_untargeted_fault_fires_on_any_tier():
+    inj = faults.install("worker-exit=3@2")
+    assert inj._take("worker-exit", tier="prefill") == 3.0
+    assert inj._take("worker-exit", tier="decode") == 3.0
+    assert inj._take("worker-exit") is None

@@ -1,0 +1,159 @@
+"""KV handoff wire format (ISSUE 13; engine/kv_cache.py).
+
+The contracts under test: serialize → deserialize is BIT-identical for
+fp32 and int8 pair-form pools (raw-byte round-trip, no dtype
+conversion anywhere); version/magic/geometry mismatches reject with the
+typed KVWireError BEFORE any target-pool write; a truncated payload
+(partial write) is detected by framing/CRC and rejects cleanly — the
+disagg coordinator turns that into a re-route, never a corrupted pool.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from polykey_tpu.engine.kv_cache import (
+    KV_WIRE_MAGIC,
+    KV_WIRE_VERSION,
+    KVHandoffState,
+    KVWireError,
+    deserialize_kv_state,
+    serialize_kv_state,
+    validate_kv_blob,
+)
+from polykey_tpu.models.config import get_config
+
+
+def _state(quantized: bool = False, dtype=np.float32,
+           prompt_len: int = 19, page_size: int = 8) -> KVHandoffState:
+    cfg = get_config("tiny-llama")
+    rng = np.random.default_rng(11)
+    n_pages = -(-prompt_len // page_size)
+    shape = (cfg.num_layers, n_pages, page_size, cfg.num_kv_heads,
+             cfg.head_dim)
+    if quantized:
+        k = rng.integers(-127, 128, shape, dtype=np.int8)
+        v = rng.integers(-127, 128, shape, dtype=np.int8)
+        ks = rng.random(shape[:-1]).astype(np.float32)
+        vs = rng.random(shape[:-1]).astype(np.float32)
+    else:
+        k = rng.random(shape).astype(dtype)
+        v = rng.random(shape).astype(dtype)
+        ks = vs = None
+    return KVHandoffState(
+        model=cfg.name, page_size=page_size, prompt_len=prompt_len,
+        first_token=360, seed=0xDEADBEEFCAFE,
+        prompt_ids=rng.integers(0, 500, prompt_len).astype(np.int32),
+        k=k, v=v, ks=ks, vs=vs,
+    )
+
+
+def test_roundtrip_fp32_bit_identical():
+    state = _state()
+    blob = serialize_kv_state(state)
+    back = deserialize_kv_state(blob)
+    assert back.model == state.model
+    assert back.prompt_len == state.prompt_len
+    assert back.first_token == state.first_token
+    assert back.seed == state.seed
+    assert back.k.dtype == state.k.dtype
+    assert back.k.tobytes() == state.k.tobytes()
+    assert back.v.tobytes() == state.v.tobytes()
+    assert back.ks is None and back.vs is None
+    assert np.array_equal(back.prompt_ids, state.prompt_ids)
+
+
+def test_roundtrip_int8_pair_form_bit_identical():
+    state = _state(quantized=True)
+    blob = serialize_kv_state(state)
+    back = deserialize_kv_state(blob)
+    assert back.quantized
+    assert back.k.dtype == np.int8
+    assert back.k.tobytes() == state.k.tobytes()
+    assert back.ks.tobytes() == state.ks.tobytes()
+    assert back.vs.tobytes() == state.vs.tobytes()
+
+
+def test_version_mismatch_rejects():
+    blob = bytearray(serialize_kv_state(_state()))
+    head = len(KV_WIRE_MAGIC)
+    blob[head:head + 2] = struct.pack("!H", KV_WIRE_VERSION + 1)
+    with pytest.raises(KVWireError, match="version"):
+        deserialize_kv_state(bytes(blob))
+
+
+def test_bad_magic_rejects():
+    blob = b"XXXX" + serialize_kv_state(_state())[4:]
+    with pytest.raises(KVWireError, match="magic"):
+        deserialize_kv_state(blob)
+
+
+def test_truncated_payload_rejects_cleanly():
+    blob = serialize_kv_state(_state())
+    # Partial write at any cut point: framing (or CRC) must catch it.
+    for cut in (8, len(blob) // 3, len(blob) // 2, len(blob) - 1):
+        with pytest.raises(KVWireError):
+            deserialize_kv_state(blob[:cut])
+        with pytest.raises(KVWireError):
+            validate_kv_blob(blob[:cut])
+
+
+def test_corrupt_payload_fails_crc():
+    blob = bytearray(serialize_kv_state(_state()))
+    blob[-40] ^= 0xFF        # flip a payload byte, keep the length
+    with pytest.raises(KVWireError, match="CRC"):
+        validate_kv_blob(bytes(blob))
+
+
+def test_geometry_mismatch_is_typed_not_corrupting():
+    cfg = get_config("tiny-llama")
+    state = _state()
+    # Wrong model name.
+    state.model = "tiny-gemma"
+    with pytest.raises(KVWireError, match="model mismatch"):
+        state.validate_for(cfg, page_size=8, quantized=False)
+    # Wrong page size.
+    state = _state()
+    with pytest.raises(KVWireError, match="page_size"):
+        state.validate_for(cfg, page_size=16, quantized=False)
+    # Quantization mismatch (int8 blob into an fp pool and vice versa).
+    with pytest.raises(KVWireError, match="dtype mismatch"):
+        _state(quantized=True).validate_for(cfg, page_size=8,
+                                            quantized=False)
+    with pytest.raises(KVWireError, match="dtype mismatch"):
+        _state().validate_for(cfg, page_size=8, quantized=True)
+    # Page count must exactly cover prompt_len.
+    state = _state()
+    state.prompt_len = 40    # needs 5 pages, blob carries 3
+    with pytest.raises(KVWireError, match="page count"):
+        state.validate_for(cfg, page_size=8, quantized=False)
+    # A matching state passes.
+    _state().validate_for(cfg, page_size=8, quantized=False)
+
+
+def test_engine_rejects_mismatched_handoff_without_pool_write():
+    """End-to-end teeth: a decode engine receiving a geometry-mismatched
+    blob fails the REQUEST with the typed kv-handoff marker and leaves
+    its own pool/allocator untouched (no partial state)."""
+    from polykey_tpu.engine.config import EngineConfig
+    from polykey_tpu.engine.engine import GenRequest, InferenceEngine
+
+    cfg = EngineConfig(
+        model="tiny-llama", dtype="float32", max_decode_slots=2,
+        page_size=16, num_pages=64, max_seq_len=64,
+        prefill_buckets=(16,), supervise=False,
+    )
+    engine = InferenceEngine(cfg, seed=3)
+    try:
+        free_before = engine.allocator.num_free
+        state = _state(page_size=8)           # pool runs page_size=16
+        request = GenRequest(prompt="", max_new_tokens=4,
+                             resume_state=state)
+        engine.submit(request)
+        kind, value = request.out.get(timeout=60)
+        assert kind == "error"
+        assert "kv-handoff" in value
+        assert engine.allocator.num_free == free_before
+    finally:
+        engine.shutdown()
