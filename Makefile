@@ -145,15 +145,32 @@ failover-soak: ## The 3-replica / 30 s acceptance drill (writes perf/)
 # and greedy streams bit-identical to a single-process reference run.
 # Smoke scale (2 prefill + 1 decode) for CI; the acceptance artifact
 # comes from disagg-soak (2x2, both kills, longer window).
-disagg-smoke: ## Kill-workers-mid-handoff drill at CI scale (2p+1d, 10 s)
-	JAX_PLATFORMS=cpu $(PYTHON) scripts/failover_soak.py --disagg \
+# ISSUE 14 rides along twice: the drill itself runs the CL005
+# protocol-conformance check before spawning, and the whole run executes
+# under the runtime lock witness (POLYKEY_LOCK_WITNESS=1) — the observed
+# acquisition-order edges from the coordinator + every worker process
+# then merge into racelint's static lock graph, which must stay
+# cycle-free (the zero-deadlock gate with real evidence).
+disagg-smoke: ## Kill-workers drill at CI scale + lock-witness zero-cycle gate
+	rm -rf /tmp/polykey-lock-witness
+	JAX_PLATFORMS=cpu POLYKEY_LOCK_WITNESS=1 \
+	  POLYKEY_LOCK_WITNESS_OUT=/tmp/polykey-lock-witness \
+	  $(PYTHON) scripts/failover_soak.py --disagg \
 	  --prefill 2 --decode 1 --duration 10 \
 	  --out /tmp/disagg_smoke.json
+	$(PYTHON) -m polykey_tpu.analysis race --only CL001 \
+	  --witness /tmp/polykey-lock-witness
 
 disagg-soak: ## The 2x2-worker / 30 s acceptance drill (writes perf/)
-	JAX_PLATFORMS=cpu $(PYTHON) scripts/failover_soak.py --disagg \
+	rm -rf /tmp/polykey-lock-witness
+	JAX_PLATFORMS=cpu POLYKEY_LOCK_WITNESS=1 \
+	  POLYKEY_LOCK_WITNESS_OUT=/tmp/polykey-lock-witness \
+	  $(PYTHON) scripts/failover_soak.py --disagg \
 	  --prefill 2 --decode 2 --duration 30 \
 	  --out perf/disagg_soak_$$(date -u +%Y%m%d_%H%M%S).json
+	$(PYTHON) -m polykey_tpu.analysis race --only CL001 \
+	  --witness /tmp/polykey-lock-witness \
+	  --dump-graph perf/lock_witness_$$(date -u +%Y-%m-%d).json
 
 print-chaos: ## Print the chaos test file list (CI's single source of truth)
 	@echo $(CHAOS_TESTS)
@@ -183,7 +200,7 @@ multiproc-demo: ## 2-process jax.distributed train+serve on localhost CPU
 	bash scripts/run_multiproc_demo.sh
 
 # -- local CI reproduction (reference Makefile:217-308 scan/ci-check family) --
-.PHONY: lint polylint graphlint native-asan scan ci-check
+.PHONY: lint polylint graphlint racelint native-asan scan ci-check
 
 lint: ## Lint: ruff (pinned ruff.toml, same config as CI) + polylint
 	@if command -v ruff >/dev/null 2>&1; then \
@@ -196,6 +213,15 @@ lint: ## Lint: ruff (pinned ruff.toml, same config as CI) + polylint
 
 polylint: ## Project-invariant static analysis (stdlib-only, always runs)
 	$(PYTHON) -m polykey_tpu.analysis
+
+# The third analysis tier (ISSUE 14): concurrency & cross-process
+# protocol contracts — interprocedural lock-order cycles (CL001),
+# unguarded shared state (CL002), lock-scope escapes (CL003),
+# blocking-under-lock across call boundaries (CL004), and the disagg
+# coordinator/worker + KV-wire protocol conformance (CL005). Stdlib-only
+# AST like polylint; the runtime lock witness rides disagg-smoke.
+racelint: ## Concurrency & protocol contract analysis (stdlib-only)
+	$(PYTHON) -m polykey_tpu.analysis race
 
 # The second analysis tier (ISSUE 5): traces the real engine/model step
 # functions on a CPU backend and verifies compiled-graph contracts —
@@ -238,8 +264,9 @@ scan: ## Security scan (Trivy fs over the tree + lockfile, CRITICAL/HIGH gate)
 	  --scanners vuln,secret \
 	  --severity CRITICAL,HIGH
 
-ci-check: ## Run the CI pipeline locally: lint+polylint+graphlint, chaos, failover, disagg, occupancy, ragged, obs, perf-gate, tests, native(+asan), scan
+ci-check: ## Run the CI pipeline locally: lint+polylint+racelint+graphlint, chaos, failover, disagg(+lock-witness gate), occupancy, ragged, obs, perf-gate, tests, native(+asan), scan
 	@$(MAKE) lint
+	@$(MAKE) racelint
 	@$(MAKE) graphlint
 	@$(MAKE) chaos-smoke
 	@$(MAKE) failover-smoke

@@ -19,3 +19,18 @@ Pallas serving engine instead of a mock/proxy backend:
 """
 
 __version__ = "0.1.0"
+
+# Runtime lock-order witness (racelint's dynamic half, ISSUE 14): with
+# POLYKEY_LOCK_WITNESS=1, every threading.Lock/RLock created by code in
+# this repo is wrapped to record the observed acquisition-order graph,
+# dumped as JSON at exit for `python -m polykey_tpu.analysis race
+# --witness`. The hook lives here so locks created at class/module
+# import time are covered. The env check below only gates the IMPORT
+# cost (the analysis package must not load on every polykey import);
+# witness.maybe_install() owns the authoritative gating.
+import os as _os
+
+if _os.environ.get("POLYKEY_LOCK_WITNESS", "") == "1":
+    from .analysis import witness as _witness
+
+    _witness.maybe_install()

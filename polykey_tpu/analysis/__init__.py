@@ -17,18 +17,35 @@ Usage::
     python -m polykey_tpu.analysis --write-baseline   # grandfather
     python -m polykey_tpu.analysis --prune            # drop stale baseline
     python -m polykey_tpu.analysis graph              # graphlint (2nd tier)
+    python -m polykey_tpu.analysis race               # racelint (3rd tier)
+    python -m polykey_tpu.analysis all                # every tier, one exit
 
-The second tier ("graphlint", ``analysis/graph.py``) verifies what the
-COMPILED graphs actually do — recompile stability, donation aliasing,
-dtype policy, host-transfer discipline, kernel/sharding layout — by
-tracing the real engine on a CPU backend. It needs jax and is imported
-lazily by the ``graph`` subcommand only; everything below stays
-stdlib-only.
+Three tiers, one discipline (per-tier baselines that trend toward
+empty, mandatory-reason suppressions, content-hashed fingerprints):
+
+- **polylint** (``rules.py``, PL***) — what the *source* promises:
+  per-file AST invariants on syncs, clocks, excepts, locks, threads,
+  jit purity, metric naming. Stdlib-only.
+- **graphlint** (``graph.py``, GL***) — what the *compiled graphs*
+  actually do: recompile stability, donation aliasing, dtype policy,
+  host-transfer discipline, kernel/sharding layout, by tracing the real
+  engine on a CPU backend. Needs jax; imported lazily by the ``graph``
+  subcommand only.
+- **racelint** (``concurrency.py``, CL***) — what the *threads and
+  processes* do to each other: the interprocedural lock-acquisition
+  graph (cycles = deadlocks), unguarded shared state, lock-scope
+  escapes, blocking-under-lock across call boundaries, and the disagg
+  coordinator/worker + KV-wire protocol conformance. Stdlib-only, with
+  an opt-in runtime witness (``witness.py``, POLYKEY_LOCK_WITNESS=1)
+  that merges *observed* acquisition-order edges — with stacks — into
+  the static graph (``race --witness``).
 
 Per-line suppression (reason required; reasonless or unused suppressions
-are themselves findings)::
+are themselves findings; the rule id's prefix names the tier that
+validates it, so PL and CL entries never cross-fire)::
 
     packed = np.asarray(data)  # polylint: disable=PL001(resolve point)
+    self._closing = True  # polylint: disable=CL002(one-way latch)
 
 The package is stdlib-only by design: the CI lint job installs ruff and
 nothing else, and ``python -m polykey_tpu.analysis`` must run there.

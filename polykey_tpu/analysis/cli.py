@@ -9,6 +9,15 @@ analysis tier (graphlint, analysis/graph.py): compiled-graph contract
 checks that need jax, traced on a CPU backend. The AST tier here stays
 stdlib-only — the dispatch imports graph lazily so the dependency-free
 CI lint job is unaffected.
+
+``python -m polykey_tpu.analysis race`` dispatches to the third tier
+(racelint, analysis/concurrency.py): concurrency and cross-process
+protocol contracts — lock-order cycles, unguarded shared state,
+lock-scope escapes, interprocedural blocking-under-lock, and
+coordinator/worker protocol conformance. Stdlib-only like this tier.
+
+``python -m polykey_tpu.analysis all`` runs all three tiers with one
+aggregate exit code (and one merged JSON object under ``--json``).
 """
 
 from __future__ import annotations
@@ -70,6 +79,71 @@ def build_parser() -> argparse.ArgumentParser:
     return parser
 
 
+def run_all(argv: list[str]) -> int:
+    """``python -m polykey_tpu.analysis all [--json]``: polylint +
+    racelint + graphlint as one gate. Each tier runs its full default
+    sweep against its own committed baseline; the exit code is clean
+    only when every tier is. Tier-specific flags (--only, --prune,
+    --write-baseline, targets) are refused — partial aggregate runs
+    would report 'all clean' while skipping debt (the graphlint --only
+    precedent, applied across tiers)."""
+    parser = argparse.ArgumentParser(
+        prog="python -m polykey_tpu.analysis all",
+        description="run every analysis tier (polylint + racelint + "
+                    "graphlint) with one aggregate exit code",
+    )
+    parser.add_argument("--root", default=".",
+                        help="repo root for every tier (default: cwd)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="one merged JSON object over all tiers")
+    args = parser.parse_args(argv)
+
+    import contextlib
+    import io
+
+    from . import concurrency, graph
+
+    tiers = (
+        ("polylint", main),
+        ("racelint", concurrency.main),
+        ("graphlint", graph.main),
+    )
+    results: dict[str, dict] = {}
+    codes: dict[str, int] = {}
+    for name, tier_main in tiers:
+        tier_argv = ["--root", args.root]
+        if args.as_json:
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                codes[name] = tier_main(tier_argv + ["--json"])
+            try:
+                results[name] = json.loads(buf.getvalue())
+            except ValueError:
+                results[name] = {"error": buf.getvalue()[-2000:]}
+        else:
+            print(f"== {name} ==")
+            codes[name] = tier_main(tier_argv)
+    aggregate = max(codes.values(), default=0)
+    if args.as_json:
+        print(json.dumps({
+            "tiers": results,
+            "summary": {
+                "exit_codes": codes,
+                "blocking": sum(
+                    r.get("summary", {}).get("blocking", 0)
+                    for r in results.values()
+                ),
+                "all_clean": aggregate == 0,
+            },
+        }, indent=2))
+    else:
+        status = ", ".join(f"{name}={code}"
+                           for name, code in codes.items())
+        print(f"analysis all: {status} -> "
+              f"{'CLEAN' if aggregate == 0 else 'FAILING'}")
+    return aggregate
+
+
 def main(argv: list[str] | None = None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -79,6 +153,12 @@ def main(argv: list[str] | None = None) -> int:
         from . import graph
 
         return graph.main(argv[1:])
+    if argv and argv[0] == "race":
+        from . import concurrency
+
+        return concurrency.main(argv[1:])
+    if argv and argv[0] == "all":
+        return run_all(argv[1:])
     args = build_parser().parse_args(argv)
 
     if args.list_rules:

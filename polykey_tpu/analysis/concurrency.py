@@ -1,0 +1,1867 @@
+"""racelint — concurrency & cross-process protocol contracts (3rd tier).
+
+PR 7 and PR 13 turned a single-threaded engine into a concurrent
+system: thread spawn sites across engine/obs/gateway, ~20 locks, and a
+hand-rolled length-framed socket protocol between the disagg
+coordinator and its workers. polylint's PL004 only sees a blocking call
+*lexically* inside a ``with lock:`` body and graphlint only audits
+compiled graphs — neither can see a deadlock forming across call
+boundaries or a coordinator/worker protocol drift. This tier can:
+
+| Rule  | Contract                                                         |
+|-------|------------------------------------------------------------------|
+| CL001 | the interprocedural lock-acquisition graph is acyclic            |
+| CL002 | state shared between a thread entry's call tree and public       |
+|       | methods is written under the owning class's lock                 |
+| CL003 | lock-guarded mutable containers never escape by reference        |
+| CL004 | no blocking call is *reachable* while a lock is held (the        |
+|       | interprocedural generalization of PL004)                         |
+| CL005 | the disagg control-plane protocol and the KV wire format agree   |
+|       | on both sides (ops ↔ handlers, fields, header symmetry)          |
+
+Everything is stdlib-only AST like polylint, shares the PR 2
+baseline/fingerprint machinery (``racelint-baseline.json``, committed
+empty) and the ``# polylint: disable=CL00x(reason)`` suppression
+comment (the CL namespace is validated by THIS tier only — a plain
+polylint run ignores it).
+
+**The model.** One pass parses every scanned file and indexes classes,
+functions, lock constructions (``self._x = threading.Lock()`` /
+``RLock`` / dataclass ``field(default_factory=threading.Lock)`` /
+module-level locks) and a light type environment: ``self``/``cls``,
+parameter annotations naming project classes, locals assigned from a
+project-class constructor, and ``self.attr`` types assigned in
+``__init__``. Call edges resolve through that environment — same-class
+methods, same-module functions, ``from``-imports, and
+attribute calls on typed receivers. The lock graph's nodes are lock
+*creation sites* (``Class.attr`` anchored at ``path:line``), which is
+also the identity the runtime witness records, so
+``race --witness <file-or-dir>`` merges observed edges (with stacks)
+into the static graph before cycle detection.
+
+**Approximations** (each documented on its rule): the call graph is
+name-and-annotation resolved — unresolvable calls contribute no edges
+(missed deadlocks possible, the witness exists for exactly this), and
+``getattr``/callback indirection is invisible. Lock acquisition is the
+``with`` statement only; bare ``.acquire()`` discipline is not modeled.
+``threading.Condition`` is deliberately not a lock here (waiting under
+a condition is its sanctioned use).
+
+Run::
+
+    python -m polykey_tpu.analysis race              # repo gate
+    python -m polykey_tpu.analysis race --json       # machine-readable
+    python -m polykey_tpu.analysis race --witness perf/lock-witness/
+    python -m polykey_tpu.analysis race --dump-graph graph.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import re
+import sys
+from pathlib import Path
+from typing import Iterator, Optional
+
+from .baseline import (
+    apply_baseline,
+    load_baseline,
+    prune_baseline,
+    write_baseline,
+)
+from .core import (
+    DEFAULT_TARGETS,
+    _EXCLUDE_PREFIXES,
+    FileContext,
+    Finding,
+    Rule,
+    iter_py_files,
+)
+from .rules import call_name, dotted, walk_no_nested_functions
+
+RACE_BASELINE = "racelint-baseline.json"
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "Lock", "RLock"}
+_RLOCK_CTORS = {"threading.RLock", "RLock"}
+
+# Mutable-container constructors/displays for CL003's escape analysis.
+_CONTAINER_CTORS = {
+    "dict", "list", "set", "collections.OrderedDict", "OrderedDict",
+    "collections.defaultdict", "defaultdict", "collections.deque", "deque",
+}
+_MUTATING_METHODS = {
+    "append", "add", "update", "pop", "popitem", "remove", "discard",
+    "clear", "setdefault", "extend", "insert", "appendleft",
+    "move_to_end",
+}
+
+# Lexically-blocking calls CL004 hunts through the call graph. get/put
+# additionally fire on queue-looking receivers (PL004's heuristic plus
+# the request-out-queue convention).
+_BLOCKING_NAMES = {
+    "time.sleep", "socket.create_connection", "subprocess.run",
+    "subprocess.check_output", "subprocess.check_call", "select.select",
+}
+_BLOCKING_ATTRS = {
+    "sleep", "accept", "recv", "recvfrom", "recv_into", "sendall",
+    "connect", "communicate", "wait", "join", "result",
+}
+_QUEUE_HINT_RE = re.compile(r"(queue|_q$|submit|(^|\.)out$)",
+                            re.IGNORECASE)
+
+
+# -- rule registry (ids/docs only; the analyzer below drives) -----------------
+
+
+class RaceRule(Rule):
+    """CL rules are cross-file: they run from the project index, not
+    per-FileContext — check() is unused. The class still subclasses
+    core.Rule so suppression validation shares one shape."""
+
+    def check(self, ctx):  # pragma: no cover - not used by this tier
+        return iter(())
+
+
+class LockOrderCycles(RaceRule):
+    id = "CL001"
+    name = "lock-order-cycle"
+    description = ("the interprocedural lock-acquisition graph has a "
+                   "cycle — a potential deadlock")
+
+
+class UnguardedSharedState(RaceRule):
+    id = "CL002"
+    name = "unguarded-shared-state"
+    description = ("attribute written from a thread's call tree and "
+                   "from public methods without the owning lock")
+
+
+class LockScopeEscape(RaceRule):
+    id = "CL003"
+    name = "lock-scope-escape"
+    description = ("lock-guarded mutable container returned/yielded by "
+                   "reference instead of a copy")
+
+
+class BlockingReachableUnderLock(RaceRule):
+    id = "CL004"
+    name = "blocking-reachable-under-lock"
+    description = ("blocking call reachable through the call graph "
+                   "while a lock is held")
+
+
+class ProtocolConformance(RaceRule):
+    id = "CL005"
+    name = "protocol-conformance"
+    description = ("disagg coordinator/worker ops, event fields, and "
+                   "the KV wire header agree on both sides")
+
+
+RACE_RULES: list[Rule] = [
+    LockOrderCycles(), UnguardedSharedState(), LockScopeEscape(),
+    BlockingReachableUnderLock(), ProtocolConformance(),
+]
+RACE_RULE_IDS = {r.id for r in RACE_RULES}
+
+
+def _finding(rule: str, path: str, line: int, message: str,
+             snippet: str = "") -> Finding:
+    return Finding(rule=rule, path=path, line=line, message=message,
+                   snippet=snippet)
+
+
+# -- project model ------------------------------------------------------------
+
+
+class FuncInfo:
+    __slots__ = ("key", "rel", "cls_key", "cls_name", "name", "node",
+                 "label")
+
+    def __init__(self, key: str, rel: str, cls_key: Optional[str],
+                 cls_name: Optional[str], name: str, node: ast.AST):
+        self.key = key
+        self.rel = rel
+        self.cls_key = cls_key
+        self.cls_name = cls_name
+        self.name = name
+        self.node = node
+        self.label = f"{cls_name}.{name}" if cls_name else name
+
+
+class ClassInfo:
+    __slots__ = ("key", "name", "rel", "node", "locks", "rlocks",
+                 "field_locks", "attr_types", "container_attrs",
+                 "methods")
+
+    def __init__(self, key: str, name: str, rel: str, node: ast.ClassDef):
+        self.key = key
+        self.name = name
+        self.rel = rel
+        self.node = node
+        self.locks: dict[str, int] = {}       # attr -> creation line
+        self.rlocks: set[str] = set()
+        # Locks declared as dataclass field(default_factory=...): their
+        # RUNTIME creation site is the ClassName(...) construction line
+        # (the generated __init__ has no witnessable frame), so the
+        # witness merge must key them by construction sites too.
+        self.field_locks: set[str] = set()
+        self.attr_types: dict[str, str] = {}  # self.attr -> class key
+        self.container_attrs: dict[str, int] = {}
+        self.methods: dict[str, FuncInfo] = {}
+
+
+class ModuleInfo:
+    __slots__ = ("rel", "ctx", "classes", "functions", "imports",
+                 "module_locks")
+
+    def __init__(self, rel: str, ctx: FileContext):
+        self.rel = rel
+        self.ctx = ctx
+        self.classes: dict[str, ClassInfo] = {}
+        self.functions: dict[str, FuncInfo] = {}
+        # local name -> (module rel, remote name) for from-imports
+        self.imports: dict[str, tuple[str, str]] = {}
+        self.module_locks: dict[str, int] = {}
+
+
+def _is_lock_ctor(node: ast.AST) -> Optional[bool]:
+    """None = not a lock; False = Lock; True = RLock. Handles direct
+    constructor calls, dataclass field(default_factory=...), and the
+    shared-lock idiom ``x if x is not None else threading.Lock()``."""
+    if isinstance(node, ast.IfExp):
+        body = _is_lock_ctor(node.body)
+        orelse = _is_lock_ctor(node.orelse)
+        if body is None and orelse is None:
+            return None
+        return bool(body) or bool(orelse)
+    if not isinstance(node, ast.Call):
+        return None
+    name = call_name(node)
+    if name in _LOCK_CTORS:
+        return name in _RLOCK_CTORS
+    if name.rsplit(".", 1)[-1] == "field":
+        for kw in node.keywords:
+            if kw.arg == "default_factory":
+                factory = dotted(kw.value)
+                if factory in _LOCK_CTORS:
+                    return factory in _RLOCK_CTORS
+    return None
+
+
+def _is_field_call(node: Optional[ast.AST]) -> bool:
+    return isinstance(node, ast.Call) \
+        and call_name(node).rsplit(".", 1)[-1] == "field"
+
+
+def _is_container_ctor(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                         ast.ListComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        name = call_name(node)
+        if name in _CONTAINER_CTORS:
+            return True
+        if name.rsplit(".", 1)[-1] == "field":
+            for kw in node.keywords:
+                if kw.arg == "default_factory" \
+                        and dotted(kw.value) in _CONTAINER_CTORS:
+                    return True
+    return False
+
+
+def _annotation_name(node: Optional[ast.AST]) -> Optional[str]:
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value.strip()
+    name = dotted(node)
+    return name or None
+
+
+class Project:
+    """The cross-file index every CL rule reads."""
+
+    def __init__(self) -> None:
+        self.modules: dict[str, ModuleInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}        # by key
+        self.class_names: dict[str, list[str]] = {}    # name -> keys
+        self.functions: dict[str, FuncInfo] = {}       # by key
+        self.syntax_errors: list[Finding] = []
+
+    # -- construction --------------------------------------------------------
+
+    def add_file(self, path: Path, root: Path) -> None:
+        """Parse one file. Cross-module resolution happens in
+        finalize() — imports may point at files not yet added."""
+        rel = path.resolve().relative_to(root.resolve()).as_posix()
+        if rel.startswith(_EXCLUDE_PREFIXES):
+            return
+        source = path.read_text(encoding="utf-8")
+        try:
+            ctx = FileContext(path, rel, source)
+        except SyntaxError as e:
+            self.syntax_errors.append(_finding(
+                "CL000", rel, e.lineno or 1, f"syntax error: {e.msg}",
+            ))
+            return
+        module = ModuleInfo(rel, ctx)
+        self.modules[rel] = module
+        self._index_imports(module)
+
+    def finalize(self) -> None:
+        """Index classes/functions/locks (pass A), then resolve typed
+        attributes — which needs the full class-name index (pass B)."""
+        for module in self.modules.values():
+            for node in module.ctx.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    self._index_class(module, node)
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    self._index_function(module, None, node)
+                elif isinstance(node, ast.Assign):
+                    if _is_lock_ctor(node.value) is not None:
+                        for target in node.targets:
+                            if isinstance(target, ast.Name):
+                                module.module_locks[target.id] = \
+                                    node.lineno
+            # Nested functions (thread targets like create()'s _boot):
+            # indexed by bare name when nothing top-level claims it.
+            method_nodes = {
+                id(m.node) for cls in module.classes.values()
+                for m in cls.methods.values()
+            }
+            for node in ast.walk(module.ctx.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and node.name not in module.functions \
+                        and id(node) not in method_nodes:
+                    self._index_function(module, None, node)
+        for module in self.modules.values():
+            for cls in module.classes.values():
+                self._resolve_attr_types(module, cls)
+
+    def _index_imports(self, module: ModuleInfo) -> None:
+        parts = module.rel[:-3].split("/")      # drop .py
+        for node in ast.walk(module.ctx.tree):
+            if not isinstance(node, ast.ImportFrom):
+                continue
+            if node.level > 0:
+                base = parts[:-(node.level)]
+                if node.module:
+                    base = base + node.module.split(".")
+            elif node.module and node.module.startswith("polykey_tpu"):
+                base = node.module.split(".")
+            else:
+                continue
+            target_rel = "/".join(base) + ".py"
+            pkg_rel = "/".join(base) + "/__init__.py"
+            for alias in node.names:
+                module.imports[alias.asname or alias.name] = (
+                    target_rel if not alias.name == "*" else pkg_rel,
+                    alias.name,
+                )
+
+    def _index_class(self, module: ModuleInfo, node: ast.ClassDef) -> None:
+        key = f"{module.rel}::{node.name}"
+        cls = ClassInfo(key, node.name, module.rel, node)
+        module.classes[node.name] = cls
+        self.classes[key] = cls
+        self.class_names.setdefault(node.name, []).append(key)
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._index_function(module, cls, stmt)
+            elif isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name):
+                kind = _is_lock_ctor(stmt.value) \
+                    if stmt.value is not None else None
+                if kind is not None:
+                    cls.locks[stmt.target.id] = stmt.lineno
+                    if kind:
+                        cls.rlocks.add(stmt.target.id)
+                    if _is_field_call(stmt.value):
+                        cls.field_locks.add(stmt.target.id)
+                elif stmt.value is not None \
+                        and _is_container_ctor(stmt.value):
+                    cls.container_attrs[stmt.target.id] = stmt.lineno
+            elif isinstance(stmt, ast.Assign):
+                kind = _is_lock_ctor(stmt.value)
+                for target in stmt.targets:
+                    if not isinstance(target, ast.Name):
+                        continue
+                    if kind is not None:
+                        cls.locks[target.id] = stmt.lineno
+                        if kind:
+                            cls.rlocks.add(target.id)
+                        if _is_field_call(stmt.value):
+                            cls.field_locks.add(target.id)
+                    elif _is_container_ctor(stmt.value):
+                        cls.container_attrs[target.id] = stmt.lineno
+        # Method-body attribute facts: locks and containers assigned to
+        # self (typed attrs wait for pass B — see _resolve_attr_types).
+        for method in cls.methods.values():
+            for stmt in ast.walk(method.node):
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                for target in stmt.targets:
+                    if not (isinstance(target, ast.Attribute)
+                            and isinstance(target.value, ast.Name)
+                            and target.value.id == "self"):
+                        continue
+                    attr = target.attr
+                    kind = _is_lock_ctor(stmt.value)
+                    if kind is not None:
+                        cls.locks.setdefault(attr, stmt.lineno)
+                        if kind:
+                            cls.rlocks.add(attr)
+                    elif _is_container_ctor(stmt.value):
+                        cls.container_attrs.setdefault(attr, stmt.lineno)
+
+    def _resolve_attr_types(self, module: ModuleInfo,
+                            cls: ClassInfo) -> None:
+        for method in cls.methods.values():
+            for stmt in ast.walk(method.node):
+                if not isinstance(stmt, ast.Assign) \
+                        or not isinstance(stmt.value, ast.Call):
+                    continue
+                ctor = self.resolve_class_name(
+                    module, call_name(stmt.value))
+                if ctor is None:
+                    continue
+                for target in stmt.targets:
+                    if isinstance(target, ast.Attribute) \
+                            and isinstance(target.value, ast.Name) \
+                            and target.value.id == "self":
+                        cls.attr_types.setdefault(target.attr, ctor)
+
+    def _index_function(self, module: ModuleInfo, cls: Optional[ClassInfo],
+                        node: ast.AST) -> None:
+        if cls is not None:
+            key = f"{module.rel}::{cls.name}.{node.name}"
+            info = FuncInfo(key, module.rel, cls.key, cls.name,
+                            node.name, node)
+            cls.methods[node.name] = info
+        else:
+            key = f"{module.rel}::{node.name}"
+            info = FuncInfo(key, module.rel, None, None, node.name, node)
+            module.functions.setdefault(node.name, info)
+        self.functions.setdefault(key, info)
+
+    # -- resolution ----------------------------------------------------------
+
+    def resolve_class_name(self, module: ModuleInfo,
+                           name: Optional[str]) -> Optional[str]:
+        """Class key for a (possibly dotted) name seen in `module`."""
+        if not name:
+            return None
+        tail = name.rsplit(".", 1)[-1]
+        if tail in module.classes:
+            return module.classes[tail].key
+        imported = module.imports.get(tail)
+        if imported is not None:
+            target = self.modules.get(imported[0])
+            if target is not None and imported[1] in target.classes:
+                return target.classes[imported[1]].key
+        keys = self.class_names.get(tail, [])
+        if len(keys) == 1:
+            return keys[0]
+        return None
+
+    def local_types(self, fn: FuncInfo) -> dict[str, str]:
+        """name -> class key for self/cls, annotated params, and locals
+        assigned from a project-class constructor."""
+        module = self.modules[fn.rel]
+        out: dict[str, str] = {}
+        if fn.cls_key is not None:
+            out["self"] = fn.cls_key
+            out["cls"] = fn.cls_key
+        args = fn.node.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs):
+            ann = _annotation_name(a.annotation)
+            resolved = self.resolve_class_name(module, ann)
+            if resolved is not None:
+                out[a.arg] = resolved
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                resolved = self.resolve_class_name(
+                    module, call_name(node.value))
+                if resolved is not None:
+                    for target in node.targets:
+                        if isinstance(target, ast.Name):
+                            out.setdefault(target.id, resolved)
+            elif isinstance(node, ast.For):
+                # for worker in self.workers / list(self.workers): the
+                # element type is invisible; annotated loops are rare —
+                # accept the miss (documented).
+                pass
+        return out
+
+    def expr_type(self, expr: ast.AST, types: dict[str, str],
+                  ) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            return types.get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            base = self.expr_type(expr.value, types)
+            if base is not None:
+                cls = self.classes.get(base)
+                if cls is not None:
+                    return cls.attr_types.get(expr.attr)
+        return None
+
+    def resolve_lock(self, expr: ast.AST, fn: FuncInfo,
+                     types: dict[str, str]) -> Optional[str]:
+        """Lock key ('path::Class.attr' / 'path::name') for a with-item
+        context expression, or None."""
+        module = self.modules[fn.rel]
+        if isinstance(expr, ast.Name):
+            if expr.id in module.module_locks:
+                return f"{fn.rel}::{expr.id}"
+            return None
+        if isinstance(expr, ast.Attribute):
+            base = self.expr_type(expr.value, types)
+            if base is not None:
+                cls = self.classes.get(base)
+                if cls is not None and expr.attr in cls.locks:
+                    return f"{base}.{expr.attr}"
+        return None
+
+    def lock_site(self, lock_key: str) -> tuple[str, int, str]:
+        """(path, line, display) of a lock key's creation site."""
+        path, _, tail = lock_key.partition("::")
+        if "." in tail:
+            cls_name, attr = tail.rsplit(".", 1)
+            cls = self.classes.get(f"{path}::{cls_name}")
+            if cls is not None and attr in cls.locks:
+                return path, cls.locks[attr], f"{cls_name}.{attr}"
+        module = self.modules.get(path)
+        if module is not None and tail in module.module_locks:
+            return path, module.module_locks[tail], \
+                f"{path.rsplit('/', 1)[-1]}:{tail}"
+        return path, 0, tail
+
+    def is_rlock(self, lock_key: str) -> bool:
+        path, _, tail = lock_key.partition("::")
+        if "." in tail:
+            cls_name, attr = tail.rsplit(".", 1)
+            cls = self.classes.get(f"{path}::{cls_name}")
+            return cls is not None and attr in cls.rlocks
+        return False
+
+    def resolve_call(self, call: ast.Call, fn: FuncInfo,
+                     types: dict[str, str]) -> Optional[FuncInfo]:
+        module = self.modules[fn.rel]
+        func = call.func
+        if isinstance(func, ast.Name):
+            name = func.id
+            if name in types:       # cls(...) / a constructor-typed local
+                cls = self.classes.get(types[name])
+                if cls is not None:
+                    return cls.methods.get("__init__")
+            if name in module.classes:
+                return module.classes[name].methods.get("__init__")
+            if name in module.functions:
+                return module.functions[name]
+            imported = module.imports.get(name)
+            if imported is not None:
+                target = self.modules.get(imported[0])
+                if target is not None:
+                    if imported[1] in target.functions:
+                        return target.functions[imported[1]]
+                    if imported[1] in target.classes:
+                        return target.classes[imported[1]] \
+                            .methods.get("__init__")
+            return None
+        if isinstance(func, ast.Attribute):
+            base = self.expr_type(func.value, types)
+            if base is not None:
+                cls = self.classes.get(base)
+                if cls is not None:
+                    return cls.methods.get(func.attr)
+        return None
+
+    def resolve_func_ref(self, expr: ast.AST, fn: FuncInfo,
+                         types: dict[str, str]) -> Optional[FuncInfo]:
+        """A function REFERENCE (Thread target=...), not a call."""
+        module = self.modules[fn.rel]
+        if isinstance(expr, ast.Name):
+            if expr.id in module.functions:
+                return module.functions[expr.id]
+            imported = module.imports.get(expr.id)
+            if imported is not None:
+                target = self.modules.get(imported[0])
+                if target is not None:
+                    return target.functions.get(imported[1])
+            return None
+        if isinstance(expr, ast.Attribute):
+            base = self.expr_type(expr.value, types)
+            if base is not None:
+                cls = self.classes.get(base)
+                if cls is not None:
+                    return cls.methods.get(expr.attr)
+        return None
+
+
+# -- the analyzer -------------------------------------------------------------
+
+
+class RaceAnalyzer:
+    """Runs the CL rules over a built Project. Traversals memoize per
+    function; the whole pass is one repo walk plus linear graph work."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self._types: dict[str, dict[str, str]] = {}
+        self._summaries: Optional[dict[str, dict]] = None
+        # Static lock-order edges: (src, dst) -> edge info dict.
+        self.edges: dict[tuple[str, str], dict] = {}
+        self.witness_edges: dict[tuple[str, str], dict] = {}
+        self.witness_unmapped: dict[str, dict] = {}
+        self.cycles: list[list[str]] = []
+        # Non-reentrant lock reacquired while held (a self-deadlock,
+        # not an ordering problem): (lock, path, line, chain).
+        self.self_deadlocks: list[tuple[str, str, int, str]] = []
+
+    def types_for(self, fn: FuncInfo) -> dict[str, str]:
+        cached = self._types.get(fn.key)
+        if cached is None:
+            cached = self._types[fn.key] = self.project.local_types(fn)
+        return cached
+
+    # -- shared walks ---------------------------------------------------------
+
+    def _with_acquisitions(self, fn: FuncInfo) -> list[tuple[str, ast.With]]:
+        out = []
+        types = self.types_for(fn)
+        for node in walk_no_nested_functions(
+                getattr(fn.node, "body", [])):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    lock = self.project.resolve_lock(
+                        item.context_expr, fn, types)
+                    if lock is not None:
+                        out.append((lock, node))
+        return out
+
+    def _calls_in(self, body_nodes) -> Iterator[ast.Call]:
+        for node in body_nodes:
+            if isinstance(node, ast.Call):
+                yield node
+
+    def _ensure_summaries(self) -> dict[str, dict]:
+        """Per-function summaries with TRANSITIVE acquire/blocking sets
+        computed by fixpoint propagation over the call graph — not by
+        recursive memoization, whose in-progress placeholder would
+        poison results in call cycles (a caller memoized against a
+        half-computed callee silently loses that callee's locks
+        forever, and whether it happens depends on iteration order)."""
+        if self._summaries is not None:
+            return self._summaries
+        summaries: dict[str, dict] = {}
+        for fn in self.project.functions.values():
+            types = self.types_for(fn)
+            acquires: dict[str, tuple] = {}
+            for lock, _node in self._with_acquisitions(fn):
+                acquires.setdefault(lock, (fn.label,))
+            blocking: dict[str, dict] = {}
+            for node, desc in self._lexical_blocking(fn):
+                key = f"{fn.rel}:{node.lineno}:{desc}"
+                blocking.setdefault(key, {
+                    "desc": desc, "path": fn.rel, "line": node.lineno,
+                    "chain": (fn.label,),
+                })
+            callees: list[str] = []
+            for node in walk_no_nested_functions(
+                    getattr(fn.node, "body", [])):
+                if isinstance(node, ast.Call):
+                    callee = self.project.resolve_call(node, fn, types)
+                    if callee is not None and callee.key != fn.key:
+                        callees.append(callee.key)
+            summaries[fn.key] = {
+                "label": fn.label, "acquires": acquires,
+                "blocking": blocking, "callees": callees,
+            }
+        # Propagate until stable: only new KEYS are ever added (each
+        # key's chain is fixed at first insertion), so the loop
+        # terminates in at most |locks|+|blocking sites| rounds.
+        changed = True
+        while changed:
+            changed = False
+            for s in summaries.values():
+                for callee_key in s["callees"]:
+                    callee = summaries.get(callee_key)
+                    if callee is None:
+                        continue
+                    for lock, chain in callee["acquires"].items():
+                        if lock not in s["acquires"]:
+                            s["acquires"][lock] = (s["label"],) + chain
+                            changed = True
+                    for key, info in callee["blocking"].items():
+                        if key not in s["blocking"]:
+                            s["blocking"][key] = {
+                                **info,
+                                "chain": (s["label"],) + info["chain"],
+                            }
+                            changed = True
+        self._summaries = summaries
+        return summaries
+
+    def reachable_acquires(self, fn: FuncInfo) -> dict[str, tuple]:
+        """lock key -> call chain (labels) by which `fn` can acquire it,
+        including transitively through resolvable calls."""
+        return self._ensure_summaries().get(
+            fn.key, {"acquires": {}})["acquires"]
+
+    def _lexical_blocking(self, fn: FuncInfo) -> list[tuple[ast.Call, str]]:
+        out = []
+        for node in walk_no_nested_functions(getattr(fn.node, "body", [])):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            func = node.func
+            attr = func.attr if isinstance(func, ast.Attribute) else ""
+            if attr == "join" and isinstance(
+                    func.value, (ast.Constant, ast.JoinedStr, ast.BinOp)):
+                continue    # ", ".join(...) — a string, not a thread
+            blocking = name in _BLOCKING_NAMES or attr in _BLOCKING_ATTRS
+            if not blocking and attr in ("get", "put"):
+                receiver = dotted(func.value) \
+                    if isinstance(func, ast.Attribute) else ""
+                has_kw = any(kw.arg in ("timeout", "block")
+                             for kw in node.keywords)
+                blocking = bool(_QUEUE_HINT_RE.search(receiver)) or has_kw
+            if blocking:
+                out.append((node, name or f".{attr}()"))
+        return out
+
+    def reachable_blocking(self, fn: FuncInfo) -> dict[str, dict]:
+        """blocking-site key -> {desc, path, line, chain}."""
+        return self._ensure_summaries().get(
+            fn.key, {"blocking": {}})["blocking"]
+
+    # -- CL001 ----------------------------------------------------------------
+
+    def collect_lock_edges(self) -> None:
+        """Populate self.edges: (src, dst) lock-order edges with the
+        lexically-anchored site each edge was proven at."""
+        for fn in self.project.functions.values():
+            types = self.types_for(fn)
+            for lock, with_node in self._with_acquisitions(fn):
+                for node in walk_no_nested_functions(with_node.body):
+                    if isinstance(node, (ast.With, ast.AsyncWith)):
+                        for item in node.items:
+                            inner = self.project.resolve_lock(
+                                item.context_expr, fn, types)
+                            if inner is None:
+                                continue
+                            if inner != lock:
+                                self._add_edge(
+                                    lock, inner, fn.rel, node.lineno,
+                                    (fn.label,),
+                                )
+                            elif not self.project.is_rlock(lock):
+                                self.self_deadlocks.append((
+                                    lock, fn.rel, node.lineno, fn.label,
+                                ))
+                    elif isinstance(node, ast.Call):
+                        callee = self.project.resolve_call(node, fn, types)
+                        if callee is None or callee.key == fn.key:
+                            continue
+                        for inner, chain in \
+                                self.reachable_acquires(callee).items():
+                            if inner != lock:
+                                self._add_edge(
+                                    lock, inner, fn.rel, node.lineno,
+                                    (fn.label,) + chain,
+                                )
+                            elif not self.project.is_rlock(lock):
+                                self.self_deadlocks.append((
+                                    lock, fn.rel, node.lineno,
+                                    " -> ".join((fn.label,) + chain),
+                                ))
+
+    def _add_edge(self, src: str, dst: str, path: str, line: int,
+                  chain: tuple) -> None:
+        key = (src, dst)
+        existing = self.edges.get(key)
+        if existing is None or (path, line) < (existing["path"],
+                                               existing["line"]):
+            self.edges[key] = {
+                "path": path, "line": line,
+                "via": " -> ".join(chain),
+                "witnessed": False, "count": 0,
+            }
+
+    def merge_witness(self, witness_data: dict) -> None:
+        """Fold observed (runtime) edges into the graph. Witness sites
+        are creation sites (path:line); locks the static pass knows are
+        mapped onto their static node, the rest become their own
+        witness-only nodes."""
+        site_to_lock: dict[str, str] = {}
+        for module in self.project.modules.values():
+            for cls in module.classes.values():
+                for attr, line in cls.locks.items():
+                    site_to_lock[f"{cls.rel}:{line}"] = f"{cls.key}.{attr}"
+            for name, line in module.module_locks.items():
+                site_to_lock[f"{module.rel}:{line}"] = \
+                    f"{module.rel}::{name}"
+        # Dataclass field(default_factory=threading.Lock) locks are
+        # created inside the GENERATED __init__, which has no
+        # witnessable frame — the runtime attributes them to the
+        # ClassName(...) construction line. Register every resolvable
+        # construction site as an alias of the field lock (only when
+        # the class has exactly one field lock: two would be
+        # indistinguishable at one call line).
+        for module in self.project.modules.values():
+            for node in ast.walk(module.ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                resolved = self.project.resolve_class_name(
+                    module, call_name(node))
+                if resolved is None:
+                    continue
+                cls = self.project.classes.get(resolved)
+                if cls is None or len(cls.field_locks) != 1:
+                    continue
+                (attr,) = cls.field_locks
+                site_to_lock.setdefault(
+                    f"{module.rel}:{node.lineno}", f"{cls.key}.{attr}")
+
+        def node_for(site: str) -> str:
+            mapped = site_to_lock.get(site)
+            if mapped is not None:
+                return mapped
+            self.witness_unmapped.setdefault(
+                site, witness_data.get("sites", {}).get(site, {}))
+            return f"witness::{site}"
+
+        for edge in witness_data.get("edges", []):
+            src = node_for(edge["src"])
+            dst = node_for(edge["dst"])
+            if src == dst:
+                continue
+            key = (src, dst)
+            info = {
+                "count": edge.get("count", 0),
+                "stack": edge.get("stack") or [],
+            }
+            self.witness_edges[key] = info
+            static = self.edges.get(key)
+            if static is not None:
+                static["witnessed"] = True
+                static["count"] = info["count"]
+
+    def _adjacency(self) -> dict[str, set[str]]:
+        adj: dict[str, set[str]] = {}
+        for src, dst in self.edges:
+            adj.setdefault(src, set()).add(dst)
+            adj.setdefault(dst, set())
+        for src, dst in self.witness_edges:
+            adj.setdefault(src, set()).add(dst)
+            adj.setdefault(dst, set())
+        return adj
+
+    def find_cycles(self) -> list[list[str]]:
+        """Cycles in the merged graph, one representative per SCC
+        (Tarjan); deterministic order."""
+        adj = self._adjacency()
+        index: dict[str, int] = {}
+        low: dict[str, int] = {}
+        on_stack: set[str] = set()
+        stack: list[str] = []
+        sccs: list[list[str]] = []
+        counter = [0]
+
+        def strongconnect(v: str) -> None:
+            work = [(v, iter(sorted(adj.get(v, ()))))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on_stack.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on_stack.add(w)
+                        work.append((w, iter(sorted(adj.get(w, ())))))
+                        advanced = True
+                        break
+                    elif w in on_stack:
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    component = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        component.append(w)
+                        if w == node:
+                            break
+                    if len(component) > 1:
+                        sccs.append(sorted(component))
+
+        for v in sorted(adj):
+            if v not in index:
+                strongconnect(v)
+        self.cycles = sorted(sccs)
+        return self.cycles
+
+    def _display(self, lock_key: str) -> str:
+        if lock_key.startswith("witness::"):
+            return lock_key[len("witness::"):]
+        _, _, display = self.project.lock_site(lock_key)
+        return display
+
+    def cl001_findings(self) -> list[Finding]:
+        findings: list[Finding] = []
+        for lock, path, line, chain in sorted(set(self.self_deadlocks)):
+            findings.append(_finding(
+                "CL001", path, line,
+                f"non-reentrant lock {self._display(lock)} is "
+                f"re-acquired while already held (via {chain}) — a "
+                "guaranteed self-deadlock; use the unlocked inner "
+                "helper or an RLock",
+            ))
+        for cycle in self.find_cycles():
+            members = set(cycle)
+            edge_bits = []
+            anchor: Optional[tuple[str, int]] = None
+            witnessed_any = False
+            for (src, dst), info in sorted(self.edges.items()):
+                if src in members and dst in members:
+                    tag = " [witnessed]" if info["witnessed"] else ""
+                    edge_bits.append(
+                        f"{self._display(src)} -> {self._display(dst)} "
+                        f"at {info['path']}:{info['line']} "
+                        f"(via {info['via']}){tag}"
+                    )
+                    witnessed_any |= bool(info["witnessed"])
+                    site = (info["path"], info["line"])
+                    if anchor is None or site < anchor:
+                        anchor = site
+            for (src, dst), info in sorted(self.witness_edges.items()):
+                if src in members and dst in members \
+                        and (src, dst) not in self.edges:
+                    head = (info.get("stack") or ["?"])[-1]
+                    edge_bits.append(
+                        f"{self._display(src)} -> {self._display(dst)} "
+                        f"witnessed only ({info['count']}x, at {head})"
+                    )
+                    witnessed_any = True
+            if anchor is None:
+                # Pure-witness cycle: anchor at a member lock's creation
+                # site so the finding still lands on a suppressible line.
+                path, line, _ = self.project.lock_site(cycle[0])
+                anchor = (path, max(1, line))
+            evidence = ("confirmed by the runtime witness"
+                        if witnessed_any else "static approximation — "
+                        "run the witness to confirm or refute")
+            names = " -> ".join(self._display(c) for c in cycle)
+            findings.append(_finding(
+                "CL001", anchor[0], anchor[1],
+                f"lock-order cycle ({names}): potential deadlock, "
+                f"{evidence}; edges: " + "; ".join(edge_bits),
+            ))
+        return findings
+
+    # -- CL002 ----------------------------------------------------------------
+
+    def _thread_entries(self) -> list[FuncInfo]:
+        entries: list[FuncInfo] = []
+        for fn in self.project.functions.values():
+            types = self.types_for(fn)
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                if not (name.endswith(".Thread") or name == "Thread"):
+                    continue
+                for kw in node.keywords:
+                    if kw.arg != "target":
+                        continue
+                    target = self.project.resolve_func_ref(
+                        kw.value, fn, types)
+                    if target is not None:
+                        entries.append(target)
+        return entries
+
+    def _reachable_set(self, roots: list[FuncInfo]) -> set[str]:
+        seen: set[str] = set()
+        frontier = list(roots)
+        while frontier:
+            fn = frontier.pop()
+            if fn.key in seen:
+                continue
+            seen.add(fn.key)
+            types = self.types_for(fn)
+            for node in walk_no_nested_functions(
+                    getattr(fn.node, "body", [])):
+                if isinstance(node, ast.Call):
+                    callee = self.project.resolve_call(node, fn, types)
+                    if callee is not None and callee.key not in seen:
+                        frontier.append(callee)
+        return seen
+
+    def _attr_writes(self) -> dict[tuple[str, str], list[dict]]:
+        """(class key, attr) -> write sites, for classes that own a
+        lock. A write is an attribute (re)bind or augmented assign on a
+        typed receiver; container mutation is CL003's domain."""
+        writes: dict[tuple[str, str], list[dict]] = {}
+        for fn in self.project.functions.values():
+            if fn.name in ("__init__", "__post_init__"):
+                continue        # construction happens-before publication
+            types = self.types_for(fn)
+            held_spans: list[tuple[str, int, int]] = []
+            for lock, node in self._with_acquisitions(fn):
+                held_spans.append(
+                    (lock, node.lineno, node.end_lineno or node.lineno))
+            for node in walk_no_nested_functions(
+                    getattr(fn.node, "body", [])):
+                targets: list[ast.AST] = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                for target in targets:
+                    if not isinstance(target, ast.Attribute):
+                        continue
+                    owner = self.project.expr_type(target.value, types)
+                    if owner is None:
+                        continue
+                    cls = self.project.classes.get(owner)
+                    if cls is None or not cls.locks:
+                        continue
+                    if target.attr in cls.locks:
+                        continue        # rebinding the lock itself
+                    held = any(
+                        lock.startswith(owner + ".")
+                        and start <= node.lineno <= end
+                        for lock, start, end in held_spans
+                    )
+                    writes.setdefault((owner, target.attr), []).append({
+                        "fn": fn, "line": node.lineno, "held": held,
+                    })
+        return writes
+
+    def cl002_findings(self) -> list[Finding]:
+        thread_tree = self._reachable_set(self._thread_entries())
+        public_roots = [
+            fn for fn in self.project.functions.values()
+            if not fn.name.startswith("_")
+        ]
+        public_tree = self._reachable_set(public_roots)
+        findings: list[Finding] = []
+        for (owner, attr), sites in sorted(self._attr_writes().items()):
+            thread_sites = [s for s in sites
+                            if s["fn"].key in thread_tree]
+            public_sites = [s for s in sites
+                            if s["fn"].key in public_tree]
+            if not thread_sites or not public_sites:
+                continue
+            unguarded = [s for s in thread_sites + public_sites
+                         if not s["held"]]
+            if not unguarded:
+                continue
+            site = min(unguarded, key=lambda s: (s["fn"].rel, s["line"]))
+            cls = self.project.classes[owner]
+            lock_names = ", ".join(sorted(cls.locks))
+            findings.append(_finding(
+                "CL002", site["fn"].rel, site["line"],
+                f"{cls.name}.{attr} is written from a thread entry's "
+                f"call tree ({thread_sites[0]['fn'].label}) AND from "
+                f"public-path code ({public_sites[0]['fn'].label}) "
+                f"without holding {cls.name}'s lock ({lock_names}) — "
+                "guard the write or annotate why the race is benign",
+            ))
+        return findings
+
+    # -- CL003 ----------------------------------------------------------------
+
+    def cl003_findings(self) -> list[Finding]:
+        findings: list[Finding] = []
+        for cls in self.project.classes.values():
+            if not cls.locks or not cls.container_attrs:
+                continue
+            guarded: set[str] = set()
+            for fn in cls.methods.values():
+                types = self.types_for(fn)
+                for lock, with_node in self._with_acquisitions(fn):
+                    if not lock.startswith(cls.key + "."):
+                        continue
+                    for node in walk_no_nested_functions(with_node.body):
+                        guarded.update(self._mutated_attrs(node, types,
+                                                           cls))
+            if not guarded:
+                continue
+            for fn in cls.methods.values():
+                for node in walk_no_nested_functions(
+                        getattr(fn.node, "body", [])):
+                    if isinstance(node, (ast.Return, ast.Yield)):
+                        value = node.value
+                    else:
+                        continue
+                    if not (isinstance(value, ast.Attribute)
+                            and isinstance(value.value, ast.Name)
+                            and value.value.id == "self"):
+                        continue
+                    if value.attr in guarded:
+                        kind = "returns" if isinstance(node, ast.Return) \
+                            else "yields"
+                        findings.append(_finding(
+                            "CL003", fn.rel, node.lineno,
+                            f"{cls.name}.{fn.name} {kind} a reference "
+                            f"to lock-guarded container "
+                            f"self.{value.attr} — the caller reads it "
+                            "unsynchronized while writers mutate it "
+                            "under the lock; return a copy "
+                            f"(dict/list(self.{value.attr}))",
+                        ))
+        return findings
+
+    def _mutated_attrs(self, node: ast.AST, types: dict[str, str],
+                       cls: ClassInfo) -> set[str]:
+        out: set[str] = set()
+
+        def self_attr(expr: ast.AST) -> Optional[str]:
+            if isinstance(expr, ast.Attribute) \
+                    and isinstance(expr.value, ast.Name) \
+                    and expr.value.id == "self" \
+                    and expr.attr in cls.container_attrs:
+                return expr.attr
+            return None
+
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if isinstance(target, ast.Subscript):
+                    attr = self_attr(target.value)
+                    if attr:
+                        out.add(attr)
+                else:
+                    attr = self_attr(target)
+                    if attr:
+                        out.add(attr)
+        elif isinstance(node, ast.AugAssign):
+            base = node.target.value if isinstance(
+                node.target, ast.Subscript) else node.target
+            attr = self_attr(base)
+            if attr:
+                out.add(attr)
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATING_METHODS:
+            attr = self_attr(node.func.value)
+            if attr:
+                out.add(attr)
+        elif isinstance(node, ast.Delete):
+            for target in node.targets:
+                if isinstance(target, ast.Subscript):
+                    attr = self_attr(target.value)
+                    if attr:
+                        out.add(attr)
+        return out
+
+    # -- CL004 ----------------------------------------------------------------
+
+    def cl004_findings(self) -> list[Finding]:
+        findings: list[Finding] = []
+        seen: set[tuple] = set()
+        for fn in self.project.functions.values():
+            types = self.types_for(fn)
+            for lock, with_node in self._with_acquisitions(fn):
+                display = self._display(lock)
+                for node in walk_no_nested_functions(with_node.body):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    callee = self.project.resolve_call(node, fn, types)
+                    if callee is None or callee.key == fn.key:
+                        continue
+                    for info in self.reachable_blocking(callee).values():
+                        key = (fn.rel, node.lineno, lock, info["desc"],
+                               info["path"], info["line"])
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        chain = " -> ".join(info["chain"])
+                        findings.append(_finding(
+                            "CL004", fn.rel, node.lineno,
+                            f"holding {display}, this call reaches "
+                            f"blocking {info['desc']} at "
+                            f"{info['path']}:{info['line']} "
+                            f"(via {chain}) — move the wait outside "
+                            "the critical section or annotate",
+                        ))
+        return findings
+
+    # -- CL005 ----------------------------------------------------------------
+
+    def cl005_findings(self) -> list[Finding]:
+        coordinator = self._module_endswith("engine/disagg_pool.py")
+        worker = self._module_endswith("engine/worker.py")
+        findings: list[Finding] = []
+        if coordinator is not None and worker is not None:
+            findings.extend(self._protocol_findings(coordinator, worker))
+        kv = self._module_endswith("engine/kv_cache.py")
+        if kv is not None:
+            findings.extend(self._kv_wire_findings(kv))
+        return findings
+
+    def _module_endswith(self, suffix: str) -> Optional[ModuleInfo]:
+        for rel, module in sorted(self.project.modules.items()):
+            if rel.endswith(suffix):
+                return module
+        return None
+
+    def _sent_ops(self) -> dict[str, list[tuple[str, int]]]:
+        """op -> send sites, scanned repo-wide: the coordinator owns the
+        protocol but scripts/tests also drive worker ops (arm_faults)."""
+        ops: dict[str, list[tuple[str, int]]] = {}
+        for module in self.project.modules.values():
+            for node in ast.walk(module.ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                attr = func.attr if isinstance(func, ast.Attribute) else ""
+                if attr not in ("request", "send") or not node.args:
+                    continue
+                value = _dict_const(node.args[0], "op")
+                if value is not None:
+                    ops.setdefault(value, []).append(
+                        (module.rel, node.lineno))
+        return ops
+
+    def _handled_ops(self, worker: ModuleInfo,
+                     ) -> dict[str, tuple[str, int]]:
+        """op -> dispatch-branch site: string constants compared against
+        a name assigned from header.get("op")."""
+        handled: dict[str, tuple[str, int]] = {}
+        op_names = _get_assignees(worker.ctx.tree, "op")
+        for node in ast.walk(worker.ctx.tree):
+            for const in _compared_constants(node, op_names, "op"):
+                handled.setdefault(const, (worker.rel, node.lineno))
+        return handled
+
+    def _protocol_findings(self, coordinator: ModuleInfo,
+                           worker: ModuleInfo) -> list[Finding]:
+        findings: list[Finding] = []
+        sent = self._sent_ops()
+        handled = self._handled_ops(worker)
+        coord_sent = {
+            op: sites for op, sites in sent.items()
+            if any(rel == coordinator.rel for rel, _ in sites)
+        }
+        for op, sites in sorted(coord_sent.items()):
+            if op not in handled:
+                rel, line = next(
+                    s for s in sites if s[0] == coordinator.rel)
+                findings.append(_finding(
+                    "CL005", rel, line,
+                    f"coordinator sends op {op!r} but the worker "
+                    "dispatch has no handler branch for it — the "
+                    "request would die with 'unknown op'",
+                ))
+        for op, (rel, line) in sorted(handled.items()):
+            if op not in sent:
+                findings.append(_finding(
+                    "CL005", rel, line,
+                    f"worker handles op {op!r} but nothing in the repo "
+                    "ever sends it — dead protocol surface or a "
+                    "renamed sender",
+                ))
+        # Worker-emitted events vs coordinator expectations.
+        worker_events = self._emitted_events(worker)
+        expected = self._expected_events(coordinator)
+        for kind, (rel, line) in sorted(expected.items()):
+            if kind not in worker_events:
+                findings.append(_finding(
+                    "CL005", rel, line,
+                    f"coordinator expects stream event {kind!r} that "
+                    "the worker never emits",
+                ))
+        for kind, info in sorted(worker_events.items()):
+            if kind not in expected:
+                findings.append(_finding(
+                    "CL005", info["site"][0], info["site"][1],
+                    f"worker emits stream event {kind!r} that the "
+                    "coordinator never matches — it would hit the "
+                    "unexpected-event re-route path",
+                ))
+        # Field sets: every event/reply field the coordinator reads must
+        # be producible by some worker send; every req field the worker
+        # reads must appear in the coordinator's request payloads.
+        event_fields = set()
+        for info in worker_events.values():
+            event_fields.update(info["fields"])
+        # Reply payloads often route through a builder
+        # (send_msg(conn, self._ping_reply())), so the reply universe is
+        # every string dict key in the worker module — coarser than the
+        # event check, still catches a field that exists nowhere.
+        reply_fields = self._emitted_reply_fields(worker) \
+            | _all_dict_keys(worker.ctx.tree)
+        for var_prefix, universe, side in (
+            ("event", event_fields | {"event"}, "worker event"),
+            ("reply", reply_fields | {"ok"}, "worker reply"),
+        ):
+            for field, (rel, line) in sorted(
+                    self._read_fields(coordinator, var_prefix).items()):
+                if field not in universe:
+                    findings.append(_finding(
+                        "CL005", rel, line,
+                        f"coordinator reads field {field!r} from a "
+                        f"{side} but no worker send includes it — "
+                        "the read always sees None",
+                    ))
+        coord_keys = _all_dict_keys(coordinator.ctx.tree) \
+            | _subscript_store_keys(coordinator.ctx.tree)
+        for field, (rel, line) in sorted(
+                self._read_fields(worker, "req").items()):
+            if field not in coord_keys:
+                findings.append(_finding(
+                    "CL005", rel, line,
+                    f"worker reads request field {field!r} that the "
+                    "coordinator request payload never carries",
+                ))
+        return findings
+
+    def _emitted_events(self, worker: ModuleInfo) -> dict[str, dict]:
+        events: dict[str, dict] = {}
+        for node in ast.walk(worker.ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            attr = node.func.attr \
+                if isinstance(node.func, ast.Attribute) else ""
+            if not (name == "send_msg" or name.endswith(".send_msg")
+                    or attr in ("send", "send_msg")):
+                continue
+            for arg in node.args:
+                kind = _dict_const(arg, "event")
+                if kind is None:
+                    continue
+                entry = events.setdefault(
+                    kind, {"site": (worker.rel, node.lineno),
+                           "fields": set()})
+                entry["fields"].update(_dict_keys(arg))
+        return events
+
+    def _expected_events(self, coordinator: ModuleInfo,
+                         ) -> dict[str, tuple[str, int]]:
+        expected: dict[str, tuple[str, int]] = {}
+        kind_names = _get_assignees(coordinator.ctx.tree, "event")
+        for node in ast.walk(coordinator.ctx.tree):
+            for const in _compared_constants(node, kind_names, "event"):
+                expected.setdefault(const, (coordinator.rel, node.lineno))
+        return expected
+
+    def _emitted_reply_fields(self, worker: ModuleInfo) -> set[str]:
+        fields: set[str] = set()
+        for node in ast.walk(worker.ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            attr = node.func.attr \
+                if isinstance(node.func, ast.Attribute) else ""
+            name = call_name(node)
+            if not (name == "send_msg" or name.endswith(".send_msg")
+                    or attr in ("send", "send_msg")):
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Dict) \
+                        and _dict_const(arg, "event") is None:
+                    fields.update(_dict_keys(arg))
+        return fields
+
+    def _read_fields(self, module: ModuleInfo, var_prefix: str,
+                     ) -> dict[str, tuple[str, int]]:
+        """Fields read (`x.get("f")` / `x["f"]` loads) off variables
+        whose NAME starts with `var_prefix` ('event', 'reply', 'req') —
+        the repo's (and the fixtures') naming convention for protocol
+        payload dicts."""
+        reads: dict[str, tuple[str, int]] = {}
+
+        def is_target(expr: ast.AST) -> bool:
+            return isinstance(expr, ast.Name) \
+                and expr.id.startswith(var_prefix)
+
+        for node in ast.walk(module.ctx.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "get" \
+                    and is_target(node.func.value) and node.args:
+                const = node.args[0]
+                if isinstance(const, ast.Constant) \
+                        and isinstance(const.value, str):
+                    reads.setdefault(const.value,
+                                     (module.rel, node.lineno))
+            elif isinstance(node, ast.Subscript) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and is_target(node.value) \
+                    and isinstance(node.slice, ast.Constant) \
+                    and isinstance(node.slice.value, str):
+                reads.setdefault(node.slice.value,
+                                 (module.rel, node.lineno))
+        return reads
+
+    def _kv_wire_findings(self, kv: ModuleInfo) -> list[Finding]:
+        """The wire header must serialize/deserialize symmetrically:
+        every key the reader touches is written, every written key is
+        read back (a write-only field is drift waiting to happen), and
+        both directions reference the MAGIC/VERSION constants."""
+        findings: list[Finding] = []
+        serialize = kv.functions.get("serialize_kv_state")
+        readers = [kv.functions.get(name) for name in
+                   ("_parse_header", "validate_kv_blob",
+                    "deserialize_kv_state")]
+        readers = [r for r in readers if r is not None]
+        if serialize is None or not readers:
+            return findings
+        written = _all_dict_keys(serialize.node)
+        read: dict[str, tuple[str, int]] = {}
+        for reader in readers:
+            for node in ast.walk(reader.node):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "get" \
+                        and dotted(node.func.value) in ("header", "entry") \
+                        and node.args \
+                        and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    read.setdefault(node.args[0].value,
+                                    (kv.rel, node.lineno))
+                elif isinstance(node, ast.Subscript) \
+                        and isinstance(node.ctx, ast.Load) \
+                        and dotted(node.value) in ("header", "entry") \
+                        and isinstance(node.slice, ast.Constant) \
+                        and isinstance(node.slice.value, str):
+                    read.setdefault(node.slice.value,
+                                    (kv.rel, node.lineno))
+        for field, (rel, line) in sorted(read.items()):
+            if field not in written:
+                findings.append(_finding(
+                    "CL005", rel, line,
+                    f"KV wire reader touches header field {field!r} "
+                    "that serialize_kv_state never writes",
+                ))
+        for field in sorted(written):
+            if field not in read:
+                findings.append(_finding(
+                    "CL005", kv.rel, serialize.node.lineno,
+                    f"KV wire header field {field!r} is serialized but "
+                    "no reader ever consumes it — write-only fields "
+                    "drift silently; read it back (or drop it)",
+                ))
+        for const in ("KV_WIRE_MAGIC", "KV_WIRE_VERSION"):
+            write_side = any(
+                isinstance(n, ast.Name) and n.id == const
+                for n in ast.walk(serialize.node)
+            )
+            read_side = any(
+                isinstance(n, ast.Name) and n.id == const
+                for reader in readers for n in ast.walk(reader.node)
+            )
+            if write_side != read_side:
+                where = "serializer" if write_side else "reader"
+                findings.append(_finding(
+                    "CL005", kv.rel, serialize.node.lineno,
+                    f"{const} is referenced only on the {where} side — "
+                    "the framing constants must gate both directions",
+                ))
+        return findings
+
+    # -- graph export ---------------------------------------------------------
+
+    def graph_dict(self) -> dict:
+        locks: dict[str, dict] = {}
+        for module in self.project.modules.values():
+            for cls in module.classes.values():
+                for attr, line in cls.locks.items():
+                    locks[f"{cls.key}.{attr}"] = {
+                        "path": cls.rel, "line": line,
+                        "display": f"{cls.name}.{attr}",
+                        "kind": "rlock" if attr in cls.rlocks else "lock",
+                    }
+            for name, line in module.module_locks.items():
+                locks[f"{module.rel}::{name}"] = {
+                    "path": module.rel, "line": line,
+                    "display": f"{module.rel.rsplit('/', 1)[-1]}:{name}",
+                    "kind": "lock",
+                }
+        edges = []
+        for (src, dst), info in sorted(self.edges.items()):
+            edges.append({
+                "src": src, "dst": dst, "site": f"{info['path']}:"
+                f"{info['line']}", "via": info["via"],
+                "witnessed": info["witnessed"],
+                "count": info["count"],
+            })
+        for (src, dst), info in sorted(self.witness_edges.items()):
+            if (src, dst) not in self.edges:
+                edges.append({
+                    "src": src, "dst": dst, "site": None,
+                    "via": None, "witnessed": True,
+                    "count": info["count"],
+                    "stack": info.get("stack") or [],
+                })
+        return {
+            "version": 1,
+            "generated_by": "python -m polykey_tpu.analysis race",
+            "locks": locks,
+            "witness_only_sites": self.witness_unmapped,
+            "edges": edges,
+            "cycles": self.cycles,
+        }
+
+
+# -- small AST helpers for CL005 ----------------------------------------------
+
+
+def _dict_const(node: ast.AST, key: str) -> Optional[str]:
+    """Value of a string-constant `key` in a dict display, or None."""
+    if not isinstance(node, ast.Dict):
+        return None
+    for k, v in zip(node.keys, node.values):
+        if isinstance(k, ast.Constant) and k.value == key \
+                and isinstance(v, ast.Constant) \
+                and isinstance(v.value, str):
+            return v.value
+    return None
+
+
+def _dict_keys(node: ast.AST) -> set[str]:
+    if not isinstance(node, ast.Dict):
+        return set()
+    return {
+        k.value for k in node.keys
+        if isinstance(k, ast.Constant) and isinstance(k.value, str)
+    }
+
+
+def _all_dict_keys(tree: ast.AST) -> set[str]:
+    keys: set[str] = set()
+    for node in ast.walk(tree):
+        keys.update(_dict_keys(node))
+    return keys
+
+
+def _subscript_store_keys(tree: ast.AST) -> set[str]:
+    keys: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Subscript) \
+                and isinstance(node.ctx, ast.Store) \
+                and isinstance(node.slice, ast.Constant) \
+                and isinstance(node.slice.value, str):
+            keys.add(node.slice.value)
+    return keys
+
+
+def _get_assignees(tree: ast.AST, field: str) -> set[str]:
+    """Names assigned from `<x>.get(field)`."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Call) \
+                and isinstance(node.value.func, ast.Attribute) \
+                and node.value.func.attr == "get" \
+                and node.value.args \
+                and isinstance(node.value.args[0], ast.Constant) \
+                and node.value.args[0].value == field:
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    names.add(target.id)
+    return names
+
+
+def _compared_constants(node: ast.AST, names: set[str],
+                        field: str) -> Iterator[str]:
+    """String constants compared (== / in) against one of `names` or
+    directly against `<x>.get(field)`."""
+    if not isinstance(node, ast.Compare):
+        return
+    left = node.left
+
+    def is_probe(expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Name) and expr.id in names:
+            return True
+        return (isinstance(expr, ast.Call)
+                and isinstance(expr.func, ast.Attribute)
+                and expr.func.attr == "get"
+                and bool(expr.args)
+                and isinstance(expr.args[0], ast.Constant)
+                and expr.args[0].value == field)
+
+    if is_probe(left):
+        for op, comp in zip(node.ops, node.comparators):
+            if isinstance(op, (ast.Eq, ast.NotEq)) \
+                    and isinstance(comp, ast.Constant) \
+                    and isinstance(comp.value, str):
+                yield comp.value
+            elif isinstance(op, ast.In) \
+                    and isinstance(comp, (ast.Tuple, ast.List, ast.Set)):
+                for el in comp.elts:
+                    if isinstance(el, ast.Constant) \
+                            and isinstance(el.value, str):
+                        yield el.value
+
+
+# -- runner -------------------------------------------------------------------
+
+
+def run_race(
+    root: Path,
+    targets: Optional[list[str]] = None,
+    only: Optional[set[str]] = None,
+    witness_data: Optional[dict] = None,
+) -> tuple[list[Finding], RaceAnalyzer]:
+    """Build the project over `targets` (polylint's defaults when None),
+    run the selected CL rules, apply per-file suppressions, and return
+    (findings, analyzer) — the analyzer carries the merged lock graph
+    for --dump-graph and the witness gate."""
+    if targets is None:
+        targets = [t for t in DEFAULT_TARGETS if (root / t).exists()]
+        if not targets:
+            raise FileNotFoundError(
+                f"none of the default race targets "
+                f"({', '.join(DEFAULT_TARGETS)}) exist under {root}"
+            )
+    project = Project()
+    for path in iter_py_files(root, targets):
+        project.add_file(path, root)
+    project.finalize()
+    analyzer = RaceAnalyzer(project)
+    findings: list[Finding] = list(project.syntax_errors)
+
+    def want(rule_id: str) -> bool:
+        return only is None or rule_id in only
+
+    # The lock graph (+ witness merge + cycle census) is built
+    # regardless of rule selection: --dump-graph and the JSON summary
+    # must describe the real merged graph even under --only CL005 —
+    # a dump with silently-skipped merging would read as a clean graph
+    # that was never computed. Only the FINDINGS are rule-gated.
+    analyzer.collect_lock_edges()
+    if witness_data is not None:
+        analyzer.merge_witness(witness_data)
+    if want("CL001"):
+        findings.extend(analyzer.cl001_findings())
+    else:
+        analyzer.find_cycles()
+    if want("CL002"):
+        findings.extend(analyzer.cl002_findings())
+    if want("CL003"):
+        findings.extend(analyzer.cl003_findings())
+    if want("CL004"):
+        findings.extend(analyzer.cl004_findings())
+    if want("CL005"):
+        findings.extend(analyzer.cl005_findings())
+
+    by_path: dict[str, list[Finding]] = {}
+    for f in findings:
+        by_path.setdefault(f.path, []).append(f)
+    out: list[Finding] = []
+    for rel, module in sorted(project.modules.items()):
+        tier_findings = module.ctx.apply_suppressions(
+            by_path.pop(rel, []), rules=RACE_RULES)
+        if only is not None:
+            # A partial run can't judge "unused": CL005's suppression
+            # looks dead during an --only CL001 run.
+            tier_findings = [
+                f for f in tier_findings
+                if not (f.rule == "CL000"
+                        and "unused suppression" in f.message)
+            ]
+        out.extend(tier_findings)
+    for rest in by_path.values():
+        out.extend(rest)        # syntax-error files with no context
+    return sorted(out, key=lambda f: (f.path, f.line, f.rule)), analyzer
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m polykey_tpu.analysis race",
+        description="racelint: concurrency & cross-process protocol "
+                    "contract analysis (stdlib-only AST + optional "
+                    "runtime lock witness)",
+    )
+    parser.add_argument(
+        "targets", nargs="*", default=None,
+        help=f"files/directories to scan "
+             f"(default: {' '.join(DEFAULT_TARGETS)})",
+    )
+    parser.add_argument("--root", default=".",
+                        help="repo root paths are reported relative to")
+    parser.add_argument(
+        "--baseline", default=RACE_BASELINE, metavar="FILE",
+        help="grandfathering baseline file (missing file = empty)",
+    )
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline file entirely")
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="grandfather every current blocking finding into --baseline",
+    )
+    parser.add_argument(
+        "--prune", action="store_true",
+        help="drop stale baseline entries, keep the rest, exit",
+    )
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit findings + summary as one JSON object")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule table and exit")
+    parser.add_argument(
+        "--only", default=None, metavar="CL001[,CL004...]",
+        help="run only the named rules",
+    )
+    parser.add_argument(
+        "--witness", default=None, metavar="FILE_OR_DIR",
+        help="merge a runtime lock-witness dump (file, or a directory "
+             "of per-process lock_witness_*.json) into the CL001 graph",
+    )
+    parser.add_argument(
+        "--dump-graph", default=None, metavar="FILE",
+        help="write the merged lock-order graph (+ cycles) as JSON",
+    )
+    return parser
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule in RACE_RULES:
+            print(f"{rule.id}  {rule.name:<28} {rule.description}")
+        return 0
+
+    root = Path(args.root).resolve()
+    if not root.is_dir():
+        print(f"racelint: --root {args.root} is not a directory",
+              file=sys.stderr)
+        return 2
+    targets = args.targets or None
+    only = None
+    if args.only:
+        if args.prune or args.write_baseline:
+            # Same refusal as graphlint: a partial run can't tell
+            # "fixed" from "not checked", and write-baseline would
+            # silently discard every other rule's debt.
+            flag = "--prune" if args.prune else "--write-baseline"
+            print(f"racelint: {flag} requires a full run (drop --only)",
+                  file=sys.stderr)
+            return 2
+        only = {t.strip() for t in args.only.split(",") if t.strip()}
+        unknown = only - RACE_RULE_IDS
+        if unknown:
+            # A typo'd id silently running zero rules would read as a
+            # clean repo — the graphlint precedent.
+            print(
+                f"racelint: unknown rule id(s): "
+                f"{', '.join(sorted(unknown))} "
+                f"(known: {', '.join(sorted(RACE_RULE_IDS))})",
+                file=sys.stderr)
+            return 2
+    if args.prune and targets:
+        print("racelint: --prune requires a full run "
+              "(drop the explicit targets)", file=sys.stderr)
+        return 2
+
+    witness_data = None
+    if args.witness:
+        from . import witness as witness_mod
+
+        try:
+            witness_data = witness_mod.load_witness(args.witness)
+        except (OSError, ValueError) as e:
+            print(f"racelint: cannot load witness {args.witness}: {e}",
+                  file=sys.stderr)
+            return 2
+
+    try:
+        findings, analyzer = run_race(root, targets, only=only,
+                                      witness_data=witness_data)
+    except FileNotFoundError as e:
+        print(f"racelint: {e}", file=sys.stderr)
+        return 2
+
+    if args.dump_graph:
+        graph = analyzer.graph_dict()
+        Path(args.dump_graph).write_text(
+            json.dumps(graph, indent=2, sort_keys=True) + "\n",
+            encoding="utf-8",
+        )
+
+    baseline_path = root / args.baseline
+    if args.prune:
+        infra = [f for f in findings if f.rule == "CL000"]
+        if infra:
+            print(
+                f"racelint: refusing to prune with {len(infra)} CL000 "
+                "finding(s) present — fix the suppression/parse problem "
+                "first", file=sys.stderr)
+            return 1
+        kept, dropped = prune_baseline(baseline_path, findings)
+        print(f"racelint: pruned {dropped} stale baseline entr"
+              f"{'y' if dropped == 1 else 'ies'} from {baseline_path} "
+              f"({kept} kept)")
+        return 0
+    if args.write_baseline:
+        count = write_baseline(baseline_path, findings)
+        print(f"racelint: wrote {count} baseline entr"
+              f"{'y' if count == 1 else 'ies'} to {baseline_path}")
+        return 0
+
+    stale: list[str] = []
+    if not args.no_baseline:
+        findings, stale = apply_baseline(findings,
+                                         load_baseline(baseline_path))
+        if only is not None:
+            stale = []      # partial runs can't call entries stale
+
+    blocking = [f for f in findings if f.blocking]
+    suppressed = sum(1 for f in findings if f.suppressed)
+    baselined = sum(1 for f in findings if f.baselined)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_json() for f in findings],
+            "summary": {
+                "blocking": len(blocking),
+                "suppressed": suppressed,
+                "baselined": baselined,
+                "stale_baseline_entries": stale,
+                "lock_edges": len(analyzer.edges),
+                "witnessed_edges": len(analyzer.witness_edges),
+                "cycles": analyzer.cycles,
+                "race_clean": not blocking,
+            },
+        }, indent=2))
+    else:
+        for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+            if f.blocking:
+                print(f.render())
+        parts = [f"{len(blocking)} blocking"]
+        if suppressed:
+            parts.append(f"{suppressed} suppressed")
+        if baselined:
+            parts.append(f"{baselined} baselined")
+        parts.append(f"{len(analyzer.edges)} lock edges")
+        if witness_data is not None:
+            parts.append(f"{len(analyzer.witness_edges)} witnessed")
+        parts.append(f"{len(analyzer.cycles)} cycles")
+        print(f"racelint: {', '.join(parts)}")
+        if stale:
+            print(
+                f"racelint: {len(stale)} stale baseline entr"
+                f"{'y' if len(stale) == 1 else 'ies'} (fixed findings) "
+                "— re-run with --prune to drop them",
+            )
+    return 1 if blocking else 0

@@ -30,10 +30,21 @@ from typing import Iterable, Iterator, Optional
 SUPPRESS_RE = re.compile(r"polylint:\s*disable=(?P<entries>.+)$")
 # The reason may itself contain one level of balanced parentheses
 # ("async copy (D2H) landed"); deeper nesting is not supported.
+# The rule id's two-letter prefix names the tier that owns it: PL = the
+# AST tier here, CL = racelint (analysis/concurrency.py). One comment
+# syntax serves every line-anchored tier; each tier validates only the
+# suppressions in its own namespace, so a CL004 annotation in engine
+# code is invisible to a plain polylint run instead of an "unknown
+# rule" finding.
 ENTRY_RE = re.compile(
-    r"(?P<rule>PL\d{3})\s*"
+    r"(?P<rule>[A-Z]{2}\d{3})\s*"
     r"(?:\((?P<reason>[^()]*(?:\([^()]*\)[^()]*)*)\))?"
 )
+# Every namespace a line-comment suppression can legally target. An
+# entry outside this set (a typo'd prefix, or GL — the graph tier
+# suppresses via class-level SUPPRESSIONS, not comments) suppresses
+# nothing; the base PL tier reports it so it can't sit dead forever.
+LINE_TIER_PREFIXES = frozenset({"PL", "CL"})
 
 
 @dataclass
@@ -106,7 +117,11 @@ class FileContext:
         except tokenize.TokenError:
             pass  # partial comment map; the AST parse already succeeded
         self.suppressions: list[Suppression] = []
-        self.bad_suppressions: list[Finding] = []
+        # (comment line, rule id or None, detail) — rendered into
+        # meta-findings by apply_suppressions, which knows the running
+        # tier's namespace (a reasonless CL entry is racelint's problem,
+        # not polylint's).
+        self.bad_suppressions: list[tuple[int, Optional[str], str]] = []
         self._parse_suppressions()
 
     # -- helpers rules use ---------------------------------------------------
@@ -145,8 +160,8 @@ class FileContext:
                 matched_spans.append(em.span())
                 rule, reason = em.group("rule"), (em.group("reason") or "").strip()
                 if not reason:
-                    self.bad_suppressions.append(self.finding(
-                        "PL000", line,
+                    self.bad_suppressions.append((
+                        line, rule,
                         f"suppression for {rule} is missing its "
                         f"(reason) — write disable={rule}(why this is safe)",
                     ))
@@ -160,13 +175,25 @@ class FileContext:
                 if not any(a <= i < b for a, b in matched_spans)
             ).strip(" ,")
             if leftover:
-                self.bad_suppressions.append(self.finding(
-                    "PL000", line,
+                self.bad_suppressions.append((
+                    line, None,
                     f"malformed suppression entry {leftover!r} "
                     "(expected PLxxx(reason))",
                 ))
 
-    def apply_suppressions(self, findings: list[Finding]) -> list[Finding]:
+    def apply_suppressions(self, findings: list[Finding],
+                           rules: Optional[list["Rule"]] = None,
+                           ) -> list[Finding]:
+        """Mark suppressed findings and surface suppression hygiene
+        problems — for ONE tier's namespace. `rules` is the rule set the
+        run used (polylint's full registry when None); only suppressions
+        whose id shares a prefix with those rules are validated here, so
+        each tier polices its own comments. Rule-less malformed entries
+        are attributed to the base PL tier (the one that always runs)."""
+        tier_rules = rules if rules is not None else all_rules()
+        known = {r.id for r in tier_rules}
+        prefixes = {rule_id[:2] for rule_id in known} or {"PL"}
+        meta = min(prefixes) + "000"
         out: list[Finding] = []
         for f in findings:
             hit: Optional[Suppression] = None
@@ -179,20 +206,38 @@ class FileContext:
                 out.append(replace(f, suppressed=True, reason=hit.reason))
             else:
                 out.append(f)
-        known = {r.id for r in all_rules()}
         for s in self.suppressions:
+            if s.rule[:2] not in prefixes:
+                # Another LINE tier's namespace validates its own
+                # entries; a prefix no line tier owns would otherwise
+                # be invisible to every run — the always-running base
+                # tier claims it.
+                if "PL" in prefixes and s.rule[:2] not in LINE_TIER_PREFIXES:
+                    out.append(self.finding(
+                        meta, s.comment_line,
+                        f"suppression names rule {s.rule} in a "
+                        "namespace no line tier owns (valid prefixes: "
+                        f"{', '.join(sorted(LINE_TIER_PREFIXES))}) — "
+                        "it suppresses nothing",
+                    ))
+                continue
             if s.rule not in known:
                 out.append(self.finding(
-                    "PL000", s.comment_line,
+                    meta, s.comment_line,
                     f"suppression names unknown rule {s.rule}",
                 ))
             elif not s.used:
                 out.append(self.finding(
-                    "PL000", s.comment_line,
+                    meta, s.comment_line,
                     f"unused suppression for {s.rule} — the rule no longer "
                     "fires here; delete the comment",
                 ))
-        out.extend(self.bad_suppressions)
+        for line, rule, message in self.bad_suppressions:
+            if rule is None:
+                if "PL" in prefixes:
+                    out.append(self.finding(meta, line, message))
+            elif rule[:2] in prefixes:
+                out.append(self.finding(meta, line, message))
         return out
 
 
@@ -271,7 +316,7 @@ def check_file(path: Path, root: Path,
     for rule in (rules if rules is not None else all_rules()):
         if rule.applies(rel):
             findings.extend(rule.check(ctx))
-    findings = ctx.apply_suppressions(findings)
+    findings = ctx.apply_suppressions(findings, rules=rules)
     return sorted(findings, key=lambda f: (f.line, f.rule))
 
 

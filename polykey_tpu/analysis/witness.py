@@ -1,0 +1,344 @@
+"""Runtime lock-order witness: the dynamic half of racelint's CL001.
+
+Static lock-order analysis (analysis/concurrency.py) is necessarily
+approximate — its call graph both misses edges (dynamic dispatch,
+callbacks) and invents them (over-eager name resolution). This module
+records what actually happened: with ``POLYKEY_LOCK_WITNESS=1`` in the
+environment, every ``threading.Lock()`` / ``threading.RLock()`` created
+from code under this repo is wrapped in an instrumented proxy that
+maintains a per-thread held-lock stack and, on each acquisition, records
+an *observed* lock-order edge (held → acquired) with the acquiring
+stack. The graph dumps as JSON at process exit (and on demand), one file
+per process under ``POLYKEY_LOCK_WITNESS_OUT`` (a directory — the
+disagg drill spans several worker processes).
+
+``python -m polykey_tpu.analysis race --witness <file-or-dir>`` merges
+these observed edges into the static acquisition graph: a cycle whose
+edges are all witnessed is a deadlock with evidence (real stacks from a
+real run), and a static-only edge that never appears in any witness run
+is a candidate for an annotation rather than a restructuring.
+
+Identity: a lock is named by its creation site (repo-relative
+``path:line``), which is exactly how the static tier names the
+``self._lock = threading.Lock()`` assignment — the merge key needs no
+runtime registry. Locks created by stdlib/third-party code (queue
+internals, logging) are deliberately NOT wrapped: the witness answers
+questions about THIS repo's locks, and wrapping the world would bury
+those answers in noise.
+
+Approximations (documented, same contract as the static rules):
+
+- Locks created before ``install()`` runs are invisible. The hook lives
+  in ``polykey_tpu/__init__`` (env-gated), so package-level and
+  instance locks are all covered; only a lock created by code imported
+  BEFORE polykey_tpu would be missed.
+- A process killed with ``os._exit`` (the worker-exit fault's real
+  mode) never dumps — the drill's witness comes from the coordinator
+  and the surviving workers, which see the same coordinator-side
+  ordering.
+- ``threading.Condition`` keeps its default (unwrapped) RLock unless
+  handed a wrapped lock explicitly; condition waits are a sanctioned
+  blocking pattern and not part of the order graph.
+"""
+
+from __future__ import annotations
+
+import _thread
+import json
+import os
+import threading
+import traceback
+
+WITNESS_VERSION = 1
+ENV_FLAG = "POLYKEY_LOCK_WITNESS"
+ENV_OUT = "POLYKEY_LOCK_WITNESS_OUT"
+
+# Frames that never name a lock site but sit between the creating code
+# and the factory (the factory itself, dataclasses-generated __init__).
+_SKIP_BASENAMES = ("witness.py", "dataclasses.py", "<string>")
+# An IMMEDIATE creator in these files means the lock belongs to stdlib
+# machinery (Thread._started's Event, Queue internals, Condition's
+# default RLock) even when the outer call site is repo code — those
+# locks stay unwrapped, or every Thread()/Queue() call would mint a
+# phantom graph node at its construction line.
+_STDLIB_CREATORS = ("threading.py", "queue.py", "socketserver.py",
+                    "logging", "concurrent")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+
+
+class _Recorder:
+    """Process-global edge store. Guarded by a RAW _thread lock so the
+    recorder can never recurse into its own instrumentation."""
+
+    def __init__(self) -> None:
+        self._guard = _thread.allocate_lock()
+        self._held = threading.local()          # per-thread site stack
+        # site -> {"path": rel, "line": n, "acquisitions": count}
+        self.sites: dict[str, dict] = {}
+        # (src, dst) -> {"count": n, "stack": [...first observed...]}
+        self.edges: dict[tuple[str, str], dict] = {}
+
+    def register(self, site: str, path: str, line: int) -> None:
+        with self._guard:
+            entry = self.sites.setdefault(
+                site, {"path": path, "line": line, "acquisitions": 0}
+            )
+            entry.setdefault("locks_created", 0)
+            entry["locks_created"] += 1
+
+    def _stack(self) -> list[str]:
+        frames = []
+        for fs in traceback.extract_stack(limit=24)[:-3]:
+            name = os.path.basename(fs.filename)
+            if name in _SKIP_BASENAMES:
+                continue
+            frames.append(f"{_relpath(fs.filename)}:{fs.lineno} "
+                          f"in {fs.name}")
+        return frames[-10:]
+
+    def on_acquired(self, site: str) -> None:
+        held = getattr(self._held, "stack", None)
+        if held is None:
+            held = self._held.stack = []
+        new_edges = [
+            (h, site) for h in held
+            if h != site and (h, site) not in self.edges
+        ]
+        stack = self._stack() if new_edges else None
+        with self._guard:
+            self.sites[site]["acquisitions"] += 1
+            for h in held:
+                if h == site:
+                    continue        # RLock re-entry: not an order edge
+                edge = self.edges.get((h, site))
+                if edge is None:
+                    self.edges[(h, site)] = {"count": 1, "stack": stack}
+                else:
+                    edge["count"] += 1
+        held.append(site)
+
+    def on_released(self, site: str) -> None:
+        held = getattr(self._held, "stack", None)
+        if held and site in held:
+            # Remove the most recent occurrence — out-of-order releases
+            # (hand-over-hand locking) must not corrupt the stack.
+            for i in range(len(held) - 1, -1, -1):
+                if held[i] == site:
+                    del held[i]
+                    break
+
+    def snapshot(self) -> dict:
+        with self._guard:
+            return {
+                "version": WITNESS_VERSION,
+                "pid": os.getpid(),
+                "sites": {k: dict(v) for k, v in self.sites.items()},
+                "edges": [
+                    {"src": src, "dst": dst, **dict(data)}
+                    for (src, dst), data in sorted(self.edges.items())
+                ],
+            }
+
+
+_recorder: _Recorder | None = None
+_real_lock = threading.Lock
+_real_rlock = threading.RLock
+
+
+def _relpath(filename: str) -> str:
+    absolute = os.path.abspath(filename)
+    if absolute.startswith(_REPO_ROOT + os.sep):
+        return absolute[len(_REPO_ROOT) + 1:].replace(os.sep, "/")
+    return absolute.replace(os.sep, "/")
+
+
+def _creation_site() -> str | None:
+    """Repo-relative path:line of the nearest polykey frame creating the
+    lock, or None when the creator is stdlib/third-party code."""
+    for fs in reversed(traceback.extract_stack(limit=16)[:-2]):
+        if fs.filename.startswith("<frozen"):
+            return None     # import machinery — never a repo lock
+        name = os.path.basename(fs.filename)
+        if name in _SKIP_BASENAMES:
+            continue
+        parts = fs.filename.replace(os.sep, "/").split("/")
+        if name in _STDLIB_CREATORS or any(
+            p in _STDLIB_CREATORS for p in parts[-3:]
+        ):
+            return None
+        absolute = os.path.abspath(fs.filename)
+        if absolute.startswith(_REPO_ROOT + os.sep):
+            return f"{_relpath(absolute)}:{fs.lineno}"
+        return None
+    return None
+
+
+class WitnessLock:
+    """Instrumented proxy over a real lock primitive. Only the surface
+    the repo (and threading.Condition's custom-lock fallback) uses:
+    acquire/release/locked and the context-manager protocol."""
+
+    __slots__ = ("_inner", "_site")
+
+    def __init__(self, inner, site: str):
+        self._inner = inner
+        self._site = site
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got and _recorder is not None:
+            _recorder.on_acquired(self._site)
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        if _recorder is not None:
+            _recorder.on_released(self._site)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __getattr__(self, name: str):
+        # threading.Condition probes the lock for _is_owned /
+        # _release_save / _acquire_restore at construction: forward what
+        # the inner primitive has (RLock) and raise AttributeError for
+        # what it lacks (plain Lock), so Condition picks the same
+        # strategy it would for the unwrapped lock. Condition's
+        # wait-time release goes through the inner methods directly —
+        # the held-stack keeps the site across the wait, which is the
+        # conservative reading (the lock IS re-held on wake).
+        return getattr(self._inner, name)
+
+    def __repr__(self) -> str:
+        return f"<WitnessLock {self._site} over {self._inner!r}>"
+
+
+def _make_factory(real):
+    def factory():
+        site = _creation_site()
+        if site is None or _recorder is None:
+            return real()
+        path, _, line = site.rpartition(":")
+        _recorder.register(site, path, int(line))
+        return WitnessLock(real(), site)
+    return factory
+
+
+def install() -> None:
+    """Swap threading.Lock/RLock for witnessing factories and register
+    the exit-time dump. Idempotent."""
+    global _recorder
+    if _recorder is not None:
+        return
+    _recorder = _Recorder()
+    threading.Lock = _make_factory(_real_lock)
+    threading.RLock = _make_factory(_real_rlock)
+    import atexit
+
+    atexit.register(dump)
+
+
+def maybe_install() -> bool:
+    """install() iff POLYKEY_LOCK_WITNESS=1; returns whether installed."""
+    if os.environ.get(ENV_FLAG, "") == "1":
+        install()
+        return True
+    return False
+
+
+def installed() -> bool:
+    return _recorder is not None
+
+
+def snapshot() -> dict:
+    if _recorder is None:
+        return {"version": WITNESS_VERSION, "pid": os.getpid(),
+                "sites": {}, "edges": []}
+    return _recorder.snapshot()
+
+
+def dump(out: str | None = None) -> str | None:
+    """Write this process's witness JSON. `out` (or $POLYKEY_LOCK_WITNESS_OUT,
+    default /tmp/polykey-lock-witness) is a DIRECTORY; the file is
+    lock_witness_<pid>.json so concurrent worker processes never clobber
+    each other. Returns the written path (None when not installed)."""
+    if _recorder is None:
+        return None
+    directory = out or os.environ.get(ENV_OUT, "/tmp/polykey-lock-witness")
+    try:
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory,
+                            f"lock_witness_{os.getpid()}.json")
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(snapshot(), f, indent=2, sort_keys=True)
+            f.write("\n")
+        return path
+    except OSError:
+        return None  # a failed witness dump must never fail the run
+
+
+def load_witness(path: str) -> dict:
+    """Load one witness file, or merge every lock_witness_*.json in a
+    directory (the multi-process drill). Returns the merged snapshot
+    shape; raises ValueError on an unreadable/mismatched file."""
+    files: list[str]
+    if os.path.isdir(path):
+        files = sorted(
+            os.path.join(path, name) for name in os.listdir(path)
+            if name.startswith("lock_witness_") and name.endswith(".json")
+        )
+        if not files:
+            raise ValueError(f"no lock_witness_*.json files under {path}")
+    else:
+        files = [path]
+    sites: dict[str, dict] = {}
+    edges: dict[tuple[str, str], dict] = {}
+    pids: list[int] = []
+    for name in files:
+        with open(name, encoding="utf-8") as f:
+            data = json.load(f)
+        if data.get("version") != WITNESS_VERSION:
+            raise ValueError(
+                f"witness file {name} has version {data.get('version')!r}, "
+                f"expected {WITNESS_VERSION}"
+            )
+        pids.append(int(data.get("pid", 0)))
+        for site, info in data.get("sites", {}).items():
+            existing = sites.get(site)
+            if existing is None:
+                sites[site] = dict(info)
+            else:
+                existing["acquisitions"] = (
+                    existing.get("acquisitions", 0)
+                    + info.get("acquisitions", 0)
+                )
+        for edge in data.get("edges", []):
+            key = (edge["src"], edge["dst"])
+            existing = edges.get(key)
+            if existing is None:
+                edges[key] = {
+                    "count": edge.get("count", 1),
+                    "stack": edge.get("stack"),
+                }
+            else:
+                existing["count"] += edge.get("count", 1)
+                if not existing.get("stack"):
+                    existing["stack"] = edge.get("stack")
+    return {
+        "version": WITNESS_VERSION,
+        "pids": pids,
+        "sites": sites,
+        "edges": [
+            {"src": src, "dst": dst, **data}
+            for (src, dst), data in sorted(edges.items())
+        ],
+    }

@@ -419,6 +419,17 @@ def deserialize_kv_state(buf: bytes) -> KVHandoffState:
     for required in ("prompt_ids", "k", "v"):
         if required not in arrays:
             raise KVWireError(f"kv-handoff blob missing array {required!r}")
+    # The header's `quantized` flag must agree with the arrays actually
+    # shipped — a mismatch means the serializer and this reader disagree
+    # about the pool form, and applying the blob would mix int8 values
+    # with a full-precision target (racelint CL005 pins this field as
+    # read-back on both sides).
+    if bool(header.get("quantized")) != ("ks" in arrays):
+        raise KVWireError(
+            "kv-handoff header/payload mismatch: quantized="
+            f"{bool(header.get('quantized'))} but scale pools are "
+            f"{'present' if 'ks' in arrays else 'absent'}"
+        )
     return KVHandoffState(
         model=header["model"],
         page_size=int(header["page_size"]),

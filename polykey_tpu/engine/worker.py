@@ -256,8 +256,16 @@ class WorkerServer:
         return self
 
     def stop(self) -> None:
+        # polylint: disable=CL002(one-way shutdown latch: a GIL-atomic bool publish; conn threads re-check it every loop and a stale read only costs one extra iteration)
         self._closing = True
         self._sever()
+        # Lock-witness dump rides the clean exit-op path, BEFORE the
+        # slow engine teardown: the coordinator's terminate() follow-up
+        # beats both atexit and a post-shutdown dump (no-op unless
+        # POLYKEY_LOCK_WITNESS armed the witness at import).
+        from ..analysis import witness as lock_witness
+
+        lock_witness.dump()
         if self.supervisor is not None:
             self.supervisor.stop()
         self.watchdog.stop()
@@ -285,6 +293,7 @@ class WorkerServer:
         control plane dies — which is all the coordinator can see."""
         if self.exit_mode == "process":
             os._exit(1)
+        # polylint: disable=CL002(one-way death latch, simulate mode only: GIL-atomic bool publish mirroring the real os._exit which synchronizes nothing either)
         self._died = True
         self._sever()
 
@@ -395,6 +404,12 @@ class WorkerServer:
                     self.engine._faults = injector
                     send_msg(conn, {"ok": True})
                 elif op == "exit":
+                    # Witness dump BEFORE the ack: the coordinator
+                    # terminates this process right after the reply
+                    # lands, and SIGTERM runs no atexit hooks.
+                    from ..analysis import witness as lock_witness
+
+                    lock_witness.dump()
                     send_msg(conn, {"ok": True})
                     threading.Thread(target=self.stop, daemon=True).start()
                     return

@@ -138,6 +138,37 @@ def _arm_worker(pool, tier: str, index: int, spec: str) -> bool:
     return False
 
 
+def _protocol_gate() -> bool:
+    """ISSUE 14: the CL005 protocol-conformance check runs BEFORE the
+    drill spawns anything, so a coordinator/worker protocol drift fails
+    in seconds on the chaos path instead of surfacing as a mysterious
+    re-route storm twenty seconds in. Stdlib-only, so it costs nothing
+    even inside the hermetic tester image."""
+    from polykey_tpu.analysis import concurrency
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rc = concurrency.main(["--root", repo_root, "--only", "CL005"])
+    if rc != 0:
+        log("protocol-conformance check (racelint CL005) FAILED — "
+            "coordinator and worker disagree; fix the drift before "
+            "drilling the protocol")
+    return rc == 0
+
+
+def _dump_lock_witness() -> None:
+    """Write this process's observed lock-order graph (no-op unless
+    POLYKEY_LOCK_WITNESS=1 armed the witness at import). Workers dump
+    their own files on clean exit; killed workers lose theirs — the
+    coordinator side still covers every cross-worker ordering it
+    drove."""
+    from polykey_tpu.analysis import witness as lock_witness
+
+    if lock_witness.installed():
+        path = lock_witness.dump()
+        if path is not None:
+            log(f"lock witness -> {path}")
+
+
 def run_disagg(args) -> int:
     """ISSUE 13 acceptance drill: prefill/decode worker PROCESSES over
     localhost under open-loop Poisson load, a prefill worker killed
@@ -148,6 +179,9 @@ def run_disagg(args) -> int:
     failover-soak artifact schema plus the disagg extras."""
     import dataclasses
     import tempfile
+
+    if not _protocol_gate():
+        return 2
 
     from polykey_tpu.engine.disagg_pool import DisaggPool
     from polykey_tpu.engine.engine import GenRequest, InferenceEngine
@@ -309,6 +343,7 @@ def run_disagg(args) -> int:
 
     stats = pool.stats()
     pool.shutdown()
+    _dump_lock_witness()
 
     with results_lock:
         done = list(results)
@@ -593,6 +628,7 @@ def main() -> int:
     stats = pool.stats()
     faults.clear()
     pool.shutdown()
+    _dump_lock_witness()
 
     with results_lock:
         done = list(results)

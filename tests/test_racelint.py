@@ -1,0 +1,953 @@
+"""racelint (polykey_tpu/analysis/concurrency.py) tests: one firing and
+one non-firing fixture per rule, witness merge + stack attribution,
+suppression/baseline round-trips, CL005 protocol teeth, CLI semantics
+(--only typo rejection, partial-run refusals, the `all` aggregate), and
+the self-run gate asserting the repo itself is clean under the
+committed-empty baseline."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+from polykey_tpu.analysis import concurrency, witness
+from polykey_tpu.analysis.baseline import load_baseline
+from polykey_tpu.analysis.cli import main as cli_main
+from polykey_tpu.analysis.concurrency import RACE_RULE_IDS, run_race
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def race(tmp_path: Path, rel: str, source: str, **kwargs):
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    findings, analyzer = run_race(tmp_path, **kwargs)
+    return findings, analyzer
+
+
+def blocking(findings, rule=None):
+    return [f for f in findings if f.blocking
+            and (rule is None or f.rule == rule)]
+
+
+# -- registry / CLI surface ---------------------------------------------------
+
+
+def test_rule_table_lists_the_five_rules(capsys):
+    assert concurrency.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in ("CL001", "CL002", "CL003", "CL004", "CL005"):
+        assert rule_id in out
+    assert RACE_RULE_IDS == {"CL001", "CL002", "CL003", "CL004", "CL005"}
+
+
+def test_only_typo_is_a_usage_error(capsys):
+    assert concurrency.main(["--only", "CL999"]) == 2
+    assert "unknown rule id" in capsys.readouterr().err
+
+
+def test_only_refuses_prune_and_write_baseline(capsys):
+    assert concurrency.main(["--only", "CL001", "--prune"]) == 2
+    assert "full run" in capsys.readouterr().err
+    assert concurrency.main(["--only", "CL001", "--write-baseline"]) == 2
+    assert "full run" in capsys.readouterr().err
+
+
+def test_prune_refuses_explicit_targets(tmp_path, capsys):
+    (tmp_path / "polykey_tpu").mkdir()
+    (tmp_path / "polykey_tpu" / "clean.py").write_text("x = 1\n")
+    rc = concurrency.main(
+        ["--root", str(tmp_path), "--prune", "polykey_tpu"])
+    assert rc == 2
+    assert "full run" in capsys.readouterr().err
+
+
+# -- CL001 lock-order cycles --------------------------------------------------
+
+
+CYCLE = """\
+import threading
+
+
+class A:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._other_lock = threading.Lock()
+
+    def one(self):
+        with self._lock:
+            self._inner()
+
+    def _inner(self):
+        with self._other_lock:
+            pass
+
+    def two(self):
+        with self._other_lock:
+            with self._lock:
+                pass
+"""
+
+
+def test_cl001_fires_on_interprocedural_cycle(tmp_path):
+    findings, analyzer = race(tmp_path, "polykey_tpu/engine/c.py", CYCLE)
+    hits = blocking(findings, "CL001")
+    assert len(hits) == 1
+    assert "lock-order cycle" in hits[0].message
+    assert "A._lock" in hits[0].message
+    assert len(analyzer.cycles) == 1
+
+
+def test_cl001_consistent_order_is_clean(tmp_path):
+    findings, analyzer = race(tmp_path, "polykey_tpu/engine/c.py", """\
+        import threading
+
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._other_lock = threading.Lock()
+
+            def one(self):
+                with self._lock:
+                    self._inner()
+
+            def _inner(self):
+                with self._other_lock:
+                    pass
+
+            def also_consistent(self):
+                with self._lock:
+                    with self._other_lock:
+                        pass
+    """)
+    assert not blocking(findings, "CL001")
+    assert analyzer.edges     # the edge exists; only cycles block
+
+
+def test_cl001_self_reacquire_is_a_deadlock_but_rlock_is_not(tmp_path):
+    findings, _ = race(tmp_path, "polykey_tpu/engine/c.py", """\
+        import threading
+
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._rlock = threading.RLock()
+
+            def outer(self):
+                with self._lock:
+                    self._helper()
+
+            def _helper(self):
+                with self._lock:
+                    pass
+
+            def reentrant_ok(self):
+                with self._rlock:
+                    self._rhelper()
+
+            def _rhelper(self):
+                with self._rlock:
+                    pass
+    """)
+    hits = blocking(findings, "CL001")
+    assert len(hits) == 1
+    assert "self-deadlock" in hits[0].message
+    assert "_rlock" not in hits[0].message
+
+
+# -- CL002 unguarded shared state ---------------------------------------------
+
+
+def test_cl001_call_cycle_does_not_poison_the_traversal(tmp_path):
+    """Regression: recursive memoization against an in-progress cycle
+    placeholder used to permanently lose a callee's locks depending on
+    iteration order — `probe` forcing `x` to be summarized while `y`
+    was in progress hid the w → x → y self-deadlock on l3."""
+    findings, _ = race(tmp_path, "polykey_tpu/engine/m.py", """\
+        import threading
+
+
+        class M:
+            def __init__(self):
+                self._l2 = threading.Lock()
+                self._l3 = threading.Lock()
+
+            def probe(self):
+                with self._l2:
+                    self.y()
+
+            def y(self):
+                with self._l3:
+                    pass
+                self.x()
+
+            def x(self):
+                self.y()
+
+            def w(self):
+                with self._l3:
+                    self.x()
+    """)
+    hits = blocking(findings, "CL001")
+    assert any("self-deadlock" in f.message and "_l3" in f.message
+               for f in hits), [f.message for f in hits]
+
+
+def test_cl002_fires_on_thread_vs_public_unguarded_write(tmp_path):
+    findings, _ = race(tmp_path, "polykey_tpu/engine/s.py", """\
+        import threading
+
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+
+            def start(self):
+                self._t = threading.Thread(target=self._loop, daemon=True)
+                self._t.start()
+
+            def _loop(self):
+                self.count += 1
+
+            def bump(self):
+                self.count += 1
+    """)
+    hits = blocking(findings, "CL002")
+    assert len(hits) == 1
+    assert "S.count" in hits[0].message
+
+
+def test_cl002_guarded_writes_and_lockless_classes_are_clean(tmp_path):
+    findings, _ = race(tmp_path, "polykey_tpu/engine/s.py", """\
+        import threading
+
+
+        class Guarded:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+
+            def start(self):
+                self._t = threading.Thread(target=self._loop, daemon=True)
+                self._t.start()
+
+            def _loop(self):
+                with self._lock:
+                    self.count += 1
+
+            def bump(self):
+                with self._lock:
+                    self.count += 1
+
+
+        class NoLock:
+            # Queue-discipline classes own no lock; CL002 scopes to
+            # classes that DO (the "owning lock" in the contract).
+            def __init__(self):
+                self.count = 0
+
+            def start(self):
+                self._t = threading.Thread(target=self._loop, daemon=True)
+                self._t.start()
+
+            def _loop(self):
+                self.count += 1
+
+            def bump(self):
+                self.count += 1
+    """)
+    assert not blocking(findings, "CL002")
+
+
+def test_cl002_suppression_comment_suppresses(tmp_path):
+    findings, _ = race(tmp_path, "polykey_tpu/engine/s.py", """\
+        import threading
+
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.flag = False
+
+            def start(self):
+                self._t = threading.Thread(target=self._loop, daemon=True)
+                self._t.start()
+
+            def _loop(self):
+                # polylint: disable=CL002(one-way latch, GIL-atomic)
+                self.flag = True
+
+            def arm(self):
+                self.flag = True
+    """)
+    assert not blocking(findings, "CL002")
+    assert any(f.rule == "CL002" and f.suppressed for f in findings)
+
+
+# -- CL003 lock-scope escape --------------------------------------------------
+
+
+def test_cl003_fires_on_returned_guarded_container(tmp_path):
+    findings, _ = race(tmp_path, "polykey_tpu/engine/e.py", """\
+        import threading
+
+
+        class E:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self.items[k] = v
+
+            def snapshot(self):
+                return self.items
+    """)
+    hits = blocking(findings, "CL003")
+    assert len(hits) == 1
+    assert "self.items" in hits[0].message
+
+
+def test_cl003_copy_and_unguarded_containers_are_clean(tmp_path):
+    findings, _ = race(tmp_path, "polykey_tpu/engine/e.py", """\
+        import threading
+
+
+        class E:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = {}
+                self.free = []
+
+            def put(self, k, v):
+                with self._lock:
+                    self.items[k] = v
+
+            def snapshot(self):
+                return dict(self.items)
+
+            def free_list(self):
+                # `free` is never mutated under the lock: not guarded,
+                # so returning it is the caller's business.
+                return self.free
+    """)
+    assert not blocking(findings, "CL003")
+
+
+# -- CL004 interprocedural blocking-under-lock --------------------------------
+
+
+def test_cl004_fires_through_the_call_graph(tmp_path):
+    findings, _ = race(tmp_path, "polykey_tpu/engine/b.py", """\
+        import threading
+        import time
+
+
+        class B:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def critical(self):
+                with self._lock:
+                    self._innocent()
+
+            def _innocent(self):
+                time.sleep(1)
+    """)
+    hits = blocking(findings, "CL004")
+    assert len(hits) == 1
+    assert "time.sleep" in hits[0].message
+    assert "B._innocent" in hits[0].message
+
+
+def test_cl004_wait_outside_lock_and_string_join_are_clean(tmp_path):
+    findings, _ = race(tmp_path, "polykey_tpu/engine/b.py", """\
+        import threading
+        import time
+
+
+        class B:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def fine(self):
+                with self._lock:
+                    names = self._render()
+                time.sleep(0.1)
+                return names
+
+            def _render(self):
+                return ", ".join(["a", "b"])
+    """)
+    assert not blocking(findings, "CL004")
+
+
+def test_cl004_cross_module_resolution(tmp_path):
+    (tmp_path / "polykey_tpu").mkdir(parents=True, exist_ok=True)
+    (tmp_path / "polykey_tpu" / "helper.py").write_text(textwrap.dedent("""\
+        import socket
+
+
+        def fetch(addr):
+            conn = socket.create_connection(addr)
+            return conn.recv(4)
+    """))
+    findings, _ = race(tmp_path, "polykey_tpu/caller.py", """\
+        import threading
+
+        from .helper import fetch
+
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self, addr):
+                with self._lock:
+                    return fetch(addr)
+    """)
+    hits = blocking(findings, "CL004")
+    assert hits and any("socket.create_connection" in f.message
+                        for f in hits)
+
+
+# -- CL005 protocol conformance -----------------------------------------------
+
+
+COORD_OK = """\
+class Coordinator:
+    def drive(self, conn):
+        reply, _ = conn.request({"op": "ping"})
+        if not reply.get("ok"):
+            return
+        conn.send({"op": "work", "req": {"prompt": "x", "steps": 3}})
+        while True:
+            event, _ = conn.recv()
+            kind = event.get("event")
+            if kind == "token":
+                print(event["id"])
+            elif kind == "done":
+                return
+            elif kind == "error":
+                raise RuntimeError(event.get("message"))
+"""
+
+WORKER_OK = """\
+def send_msg(conn, header, payload=b""):
+    pass
+
+
+class Worker:
+    def serve(self, conn, header):
+        op = header.get("op")
+        if op == "ping":
+            send_msg(conn, {"ok": True})
+        elif op == "work":
+            req = header.get("req") or {}
+            steps = int(req.get("steps", 1))
+            _prompt = req.get("prompt", "")
+            for i in range(steps):
+                send_msg(conn, {"event": "token", "id": i})
+            send_msg(conn, {"event": "done"})
+        else:
+            send_msg(conn, {"event": "error",
+                            "message": f"unknown op {op!r}"})
+"""
+
+
+def write_protocol(tmp_path: Path, coord: str, worker: str) -> None:
+    base = tmp_path / "polykey_tpu" / "engine"
+    base.mkdir(parents=True, exist_ok=True)
+    (base / "disagg_pool.py").write_text(textwrap.dedent(coord))
+    (base / "worker.py").write_text(textwrap.dedent(worker))
+
+
+def test_cl005_conforming_protocol_is_clean(tmp_path):
+    write_protocol(tmp_path, COORD_OK, WORKER_OK)
+    findings, _ = run_race(tmp_path)
+    assert not blocking(findings, "CL005")
+
+
+def test_cl005_teeth_unhandled_op_fails(tmp_path):
+    # The acceptance teeth: a coordinator that grows a new op without a
+    # worker handler branch must fail the gate.
+    coord = COORD_OK + textwrap.dedent("""\
+
+        def extra(conn):
+            conn.request({"op": "compact"})
+    """)
+    write_protocol(tmp_path, coord, WORKER_OK)
+    findings, _ = run_race(tmp_path)
+    hits = blocking(findings, "CL005")
+    assert any("'compact'" in f.message and "no handler" in f.message
+               for f in hits)
+
+
+def test_cl005_handler_without_sender_fails(tmp_path):
+    worker = WORKER_OK.replace(
+        'if op == "ping":',
+        'if op == "vestigial":\n'
+        '            send_msg(conn, {"ok": True})\n'
+        '        elif op == "ping":',
+    )
+    write_protocol(tmp_path, COORD_OK, worker)
+    findings, _ = run_race(tmp_path)
+    hits = blocking(findings, "CL005")
+    assert any("'vestigial'" in f.message and "ever sends" in f.message
+               for f in hits)
+
+
+def test_cl005_missing_event_and_unread_field_fail(tmp_path):
+    # Coordinator expects a "handoff_ready" event the worker never
+    # emits, and reads a field ("bytes") no worker event carries.
+    coord = COORD_OK.replace(
+        'if kind == "token":',
+        'if kind == "handoff_ready":\n'
+        '                print(event.get("bytes"))\n'
+        '            elif kind == "token":',
+    )
+    write_protocol(tmp_path, coord, WORKER_OK)
+    findings, _ = run_race(tmp_path)
+    hits = blocking(findings, "CL005")
+    assert any("'handoff_ready'" in f.message for f in hits)
+    assert any("'bytes'" in f.message for f in hits)
+
+
+def test_cl005_kv_wire_asymmetry_fails(tmp_path):
+    (tmp_path / "polykey_tpu" / "engine").mkdir(parents=True,
+                                                exist_ok=True)
+    (tmp_path / "polykey_tpu" / "engine" / "kv_cache.py").write_text(
+        textwrap.dedent("""\
+            import json
+            import struct
+
+            KV_WIRE_MAGIC = b"PKKV"
+            KV_WIRE_VERSION = 1
+
+
+            def serialize_kv_state(state):
+                header = json.dumps({
+                    "model": state.model,
+                    "extra_unread_field": 1,
+                }).encode()
+                return KV_WIRE_MAGIC + struct.pack(
+                    "!H", KV_WIRE_VERSION) + header
+
+
+            def deserialize_kv_state(buf):
+                header = json.loads(buf[6:])
+                return header["model"], header["missing_field"]
+        """))
+    findings, _ = run_race(tmp_path)
+    hits = blocking(findings, "CL005")
+    assert any("'missing_field'" in f.message and "never writes"
+               in f.message for f in hits)
+    assert any("'extra_unread_field'" in f.message and "write-only"
+               in f.message.lower() or "no reader" in f.message
+               for f in hits)
+    # The reader never checks MAGIC/VERSION — one-sided constants fire.
+    assert any("KV_WIRE_MAGIC" in f.message for f in hits)
+
+
+# -- witness merge ------------------------------------------------------------
+
+
+def witness_payload(edges, sites=None) -> dict:
+    return {
+        "version": 1, "pid": 1234,
+        "sites": sites or {},
+        "edges": [
+            {"src": s, "dst": d, "count": c,
+             "stack": [f"{s} in acquire_site"]}
+            for s, d, c in edges
+        ],
+    }
+
+
+def lock_lines(source: str) -> dict[str, int]:
+    out = {}
+    for i, line in enumerate(source.splitlines(), 1):
+        if "threading.Lock()" in line:
+            name = line.split("=")[0].strip().replace("self.", "")
+            out[name] = i
+    return out
+
+
+def test_witness_edge_closes_a_static_cycle(tmp_path):
+    source = textwrap.dedent("""\
+        import threading
+
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._other_lock = threading.Lock()
+
+            def one(self):
+                with self._lock:
+                    self._inner()
+
+            def _inner(self):
+                with self._other_lock:
+                    pass
+    """)
+    rel = "polykey_tpu/engine/w.py"
+    lines = lock_lines(source)
+    # The runtime observed the REVERSE order the static pass never saw
+    # (a callback path, say): other_lock held while _lock was taken.
+    data = witness_payload([
+        (f"{rel}:{lines['_other_lock']}", f"{rel}:{lines['_lock']}", 3),
+    ])
+    findings, analyzer = race(tmp_path, rel, source, witness_data=data)
+    hits = blocking(findings, "CL001")
+    assert len(hits) == 1
+    assert "witnessed" in hits[0].message
+    assert analyzer.cycles
+    # And without the witness the same tree is clean — the merge is
+    # what closed the cycle.
+    clean, _ = run_race(tmp_path)
+    assert not blocking(clean, "CL001")
+
+
+def test_witness_confirms_static_edge_and_graph_dump(tmp_path):
+    findings, analyzer = race(tmp_path, "polykey_tpu/engine/c.py", CYCLE)
+    rel = "polykey_tpu/engine/c.py"
+    lines = lock_lines(textwrap.dedent(CYCLE))
+    data = witness_payload([
+        (f"{rel}:{lines['_lock']}", f"{rel}:{lines['_other_lock']}", 7),
+    ])
+    findings, analyzer = race(tmp_path, rel, CYCLE, witness_data=data)
+    hits = blocking(findings, "CL001")
+    assert hits and "[witnessed]" in hits[0].message
+    graph = analyzer.graph_dict()
+    witnessed = [e for e in graph["edges"] if e["witnessed"]]
+    assert witnessed and witnessed[0]["count"] == 7
+
+
+def test_witness_runtime_records_order_and_stack(tmp_path):
+    """End-to-end: a subprocess with POLYKEY_LOCK_WITNESS=1 records the
+    observed edge with a stack attributing the acquiring function. The
+    script runs via stdin with cwd=REPO_ROOT because the witness
+    deliberately wraps only locks created by repo code (a tmp-dir file
+    would be skipped as third-party)."""
+    out_dir = tmp_path / "wit"
+    source = textwrap.dedent("""\
+        import threading
+
+        import polykey_tpu  # noqa: F401  (installs the witness hook)
+
+
+        class D:
+            def __init__(self):
+                self.lock_a = threading.Lock()
+                self.lock_b = threading.Lock()
+
+            def nested_acquire(self):
+                with self.lock_a:
+                    with self.lock_b:
+                        pass
+
+
+        d = D()
+        t = threading.Thread(target=d.nested_acquire)
+        t.start()
+        t.join()
+        from polykey_tpu.analysis import witness
+        assert witness.installed()
+        print(witness.dump())
+    """)
+    a_line = source.splitlines().index(
+        "        self.lock_a = threading.Lock()") + 1
+    env = dict(os.environ)
+    env.update({
+        "POLYKEY_LOCK_WITNESS": "1",
+        "POLYKEY_LOCK_WITNESS_OUT": str(out_dir),
+        "PYTHONPATH": str(REPO_ROOT),
+    })
+    proc = subprocess.run(
+        [sys.executable, "-"], input=source, env=env,
+        cwd=str(REPO_ROOT), capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    merged = witness.load_witness(str(out_dir))
+    edges = merged["edges"]
+    assert len(edges) == 1
+    (edge,) = edges
+    assert edge["src"].endswith(f":{a_line}")      # lock_a's creation
+    assert edge["dst"].endswith(f":{a_line + 1}")  # lock_b's
+    assert edge["count"] == 1
+    assert any("nested_acquire" in frame for frame in edge["stack"])
+
+
+def test_witness_dataclass_field_lock_maps_via_construction_site(tmp_path):
+    """Regression: a dataclass field(default_factory=threading.Lock)
+    lock is created inside the GENERATED __init__, so the runtime
+    witness attributes it to the ClassName(...) construction line — the
+    merge must treat that line as an alias of the static field lock, or
+    witnessed edges through it become phantom nodes and a mixed
+    static+witnessed cycle never closes."""
+    source = textwrap.dedent("""\
+        import threading
+        from dataclasses import dataclass, field
+
+
+        @dataclass
+        class Record:
+            lock: threading.Lock = field(default_factory=threading.Lock)
+
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def make(self):
+                return Record()
+
+            def guarded(self, record: "Record"):
+                with self._lock:
+                    with record.lock:
+                        pass
+    """)
+    rel = "polykey_tpu/engine/d.py"
+    lines = source.splitlines()
+    ctor_line = lines.index("        return Record()") + 1
+    pool_lock_line = lines.index(
+        "        self._lock = threading.Lock()") + 1
+    # The runtime observed the reverse order: Record.lock (attributed
+    # to the construction line) held while Pool._lock was taken.
+    data = witness_payload([
+        (f"{rel}:{ctor_line}", f"{rel}:{pool_lock_line}", 2),
+    ])
+    findings, analyzer = race(tmp_path, rel, source, witness_data=data)
+    assert not analyzer.witness_unmapped       # no phantom nodes
+    hits = blocking(findings, "CL001")
+    assert hits and "Record.lock" in hits[0].message
+
+
+def test_witness_and_dump_are_live_under_only_cl005(tmp_path):
+    """Regression: --witness / the graph census used to be silently
+    inert unless CL001 was selected — a --only CL005 run must still
+    merge witness edges and report the real cycle census (just without
+    CL001 findings)."""
+    rel = "polykey_tpu/engine/c.py"
+    lines = lock_lines(textwrap.dedent(CYCLE))
+    data = witness_payload([
+        (f"{rel}:{lines['_lock']}", f"{rel}:{lines['_other_lock']}", 7),
+    ])
+    findings, analyzer = race(tmp_path, rel, CYCLE,
+                              only={"CL005"}, witness_data=data)
+    assert not blocking(findings, "CL001")     # rule not selected
+    assert analyzer.witness_edges              # but the merge ran
+    assert analyzer.cycles                     # and the census is real
+    graph = analyzer.graph_dict()
+    assert any(e["witnessed"] for e in graph["edges"])
+
+
+def test_witness_load_merges_a_directory(tmp_path):
+    out = tmp_path / "w"
+    out.mkdir()
+    for pid, count in ((1, 2), (2, 5)):
+        (out / f"lock_witness_{pid}.json").write_text(json.dumps({
+            "version": 1, "pid": pid,
+            "sites": {"a.py:1": {"path": "a.py", "line": 1,
+                                 "acquisitions": count}},
+            "edges": [{"src": "a.py:1", "dst": "a.py:2",
+                       "count": count, "stack": ["a.py:9 in f"]}],
+        }))
+    merged = witness.load_witness(str(out))
+    assert merged["pids"] == [1, 2]
+    assert merged["edges"][0]["count"] == 7
+    assert merged["sites"]["a.py:1"]["acquisitions"] == 7
+
+
+# -- suppressions & baseline --------------------------------------------------
+
+
+def test_unused_cl_suppression_is_a_cl000_finding(tmp_path):
+    findings, _ = race(tmp_path, "polykey_tpu/engine/u.py", """\
+        def quiet():
+            return 1  # polylint: disable=CL004(nothing blocks here)
+    """)
+    hits = blocking(findings, "CL000")
+    assert hits and "unused suppression" in hits[0].message
+
+
+def test_unowned_namespace_suppression_is_flagged_by_polylint(tmp_path):
+    """A suppression whose prefix no line tier owns (typo, or GL —
+    graphlint suppresses via class-level SUPPRESSIONS, not comments)
+    suppresses nothing; the always-running base tier reports it instead
+    of letting the dead comment sit forever."""
+    from polykey_tpu.analysis import check_file
+
+    path = tmp_path / "polykey_tpu" / "engine" / "z.py"
+    path.parent.mkdir(parents=True)
+    path.write_text(textwrap.dedent("""\
+        def quiet():
+            return 1  # polylint: disable=ZZ123(bogus namespace)
+    """))
+    pl_findings = check_file(path, tmp_path)
+    assert any(f.rule == "PL000" and "no line tier owns" in f.message
+               and f.blocking for f in pl_findings)
+    race_findings, _ = run_race(tmp_path)
+    assert not blocking(race_findings)      # racelint leaves it to PL
+
+
+def test_pl_suppressions_are_invisible_to_racelint(tmp_path):
+    findings, _ = race(tmp_path, "polykey_tpu/engine/u.py", """\
+        import numpy as np
+
+
+        def _process_step(self, data):
+            # polylint: disable=PL001(deliberate resolve point)
+            return np.asarray(data)
+    """)
+    assert not blocking(findings)       # PL namespace: polylint's job
+
+
+def test_baseline_round_trip_via_cli(tmp_path, capsys):
+    base = tmp_path / "polykey_tpu" / "engine"
+    base.mkdir(parents=True)
+    (base / "e.py").write_text(textwrap.dedent("""\
+        import threading
+
+
+        class E:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self.items[k] = v
+
+            def snapshot(self):
+                return self.items
+    """))
+    assert concurrency.main(["--root", str(tmp_path)]) == 1
+    capsys.readouterr()
+    assert concurrency.main(
+        ["--root", str(tmp_path), "--write-baseline"]) == 0
+    capsys.readouterr()
+    assert concurrency.main(["--root", str(tmp_path)]) == 0
+    capsys.readouterr()
+    baseline = load_baseline(tmp_path / "racelint-baseline.json")
+    assert len(baseline["findings"]) == 1
+    # Fix the escape: the entry goes stale; --prune drops it.
+    (base / "e.py").write_text(
+        (base / "e.py").read_text().replace(
+            "return self.items", "return dict(self.items)"))
+    assert concurrency.main(["--root", str(tmp_path), "--prune"]) == 0
+    assert "pruned 1 stale" in capsys.readouterr().out
+    assert not load_baseline(
+        tmp_path / "racelint-baseline.json")["findings"]
+
+
+def test_json_output_shape(tmp_path, capsys):
+    (tmp_path / "polykey_tpu").mkdir()
+    (tmp_path / "polykey_tpu" / "clean.py").write_text("x = 1\n")
+    assert concurrency.main(["--root", str(tmp_path), "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["summary"]["race_clean"] is True
+    assert payload["summary"]["cycles"] == []
+    assert "lock_edges" in payload["summary"]
+
+
+# -- the `all` aggregate ------------------------------------------------------
+
+
+def test_all_aggregates_tiers(tmp_path, capsys, monkeypatch):
+    from polykey_tpu.analysis import graph
+
+    calls = []
+
+    def fake_graph_main(argv):
+        calls.append(argv)
+        if "--json" in argv:
+            print(json.dumps({"findings": [], "summary": {"blocking": 0}}))
+        return 0
+
+    monkeypatch.setattr(graph, "main", fake_graph_main)
+    (tmp_path / "polykey_tpu").mkdir()
+    (tmp_path / "polykey_tpu" / "clean.py").write_text("x = 1\n")
+    rc = cli_main(["all", "--root", str(tmp_path), "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert calls        # graph tier was dispatched
+    assert set(payload["tiers"]) == {"polylint", "racelint", "graphlint"}
+    assert payload["summary"]["all_clean"] is True
+
+    # A blocking finding in ANY tier fails the aggregate.
+    (tmp_path / "polykey_tpu" / "dirty.py").write_text(textwrap.dedent("""\
+        def f(g):
+            try:
+                g()
+            except Exception:
+                pass
+    """))
+    rc = cli_main(["all", "--root", str(tmp_path), "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert payload["summary"]["all_clean"] is False
+    assert payload["summary"]["blocking"] >= 1
+
+
+# -- the repo itself ----------------------------------------------------------
+
+
+def test_self_run_repo_is_clean_under_committed_baseline(capsys):
+    """The acceptance gate: `python -m polykey_tpu.analysis race` exits
+    0 on this repo with the committed-empty baseline — every surfaced
+    finding is fixed or reason-annotated."""
+    rc = concurrency.main(["--root", str(REPO_ROOT)])
+    out = capsys.readouterr().out
+    assert rc == 0, f"racelint found blocking findings:\n{out}"
+
+
+def test_committed_baseline_is_empty():
+    data = load_baseline(REPO_ROOT / "racelint-baseline.json")
+    assert data["findings"] == {}
+
+
+def test_committed_witness_artifact_is_cycle_free():
+    """The merged lock-order graph from the witnessed disagg drill is a
+    committed acceptance artifact: locks present, some edges witnessed
+    at runtime, zero cycles."""
+    path = REPO_ROOT / "perf" / "lock_witness_2026-08-04.json"
+    graph = json.loads(path.read_text())
+    assert graph["cycles"] == []
+    assert len(graph["locks"]) >= 10
+    assert any(e["witnessed"] for e in graph["edges"])
+
+
+def test_removing_a_deliberate_annotation_fails_the_gate(tmp_path):
+    """Teeth: stripping one CL002 reason-annotation from worker.py must
+    make racelint block again."""
+    needle = "polylint: disable=CL002(one-way shutdown latch"
+    source = (REPO_ROOT / "polykey_tpu" / "engine" / "worker.py") \
+        .read_text()
+    assert needle in source
+    stripped = "\n".join(
+        line for line in source.splitlines() if needle not in line
+    )
+    target = tmp_path / "polykey_tpu" / "engine" / "worker.py"
+    target.parent.mkdir(parents=True)
+    target.write_text(stripped)
+    findings, _ = run_race(tmp_path)
+    assert blocking(findings, "CL002")
+
+
+def test_repo_protocol_is_conformant_via_only_cl005(capsys):
+    """The gate failover_soak's --disagg path runs before spawning:
+    coordinator ops all have worker handlers and vice versa."""
+    rc = concurrency.main(["--root", str(REPO_ROOT), "--only", "CL005"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
