@@ -12,7 +12,7 @@ BUILD_DIR := build
 	obs-smoke chaos-smoke print-chaos occupancy-smoke occupancy-soak \
 	failover-smoke failover-soak timeline-capture perf-gate \
 	perf-gate-reference flightwatch ragged-smoke ragged-soak \
-	disagg-smoke disagg-soak
+	disagg-smoke disagg-soak hostkv-smoke hostkv-soak
 
 help: ## Show available targets
 	@grep -E '^[a-zA-Z_-]+:.*?## .*$$' $(MAKEFILE_LIST) | \
@@ -112,6 +112,21 @@ occupancy-smoke: ## Poisson-load occupancy soak at CI scale (gated >= 0.7)
 # acceptance measurement.
 ragged-smoke: ## Ragged kernel interpret parity + engine bit-identity vs bucketed
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/ragged_smoke.py
+
+# Host-memory KV tier (ISSUE 15): sticky multi-turn sessions at 1.5x
+# the device pool — gates zero failed RPCs, greedy streams bit-identical
+# to an all-device run, and a supervised restart mid-soak recovering
+# warm TTFT from the durable prefix store. Smoke scale for CI; the
+# committed acceptance artifact comes from hostkv-soak.
+hostkv-smoke: ## Host-KV tier drill at CI scale (spill/fault/restart, bit-identity gate)
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/occupancy_soak.py --host-kv \
+	  --slots 8 --hk-sessions 6 --hk-turns 3 --hk-base 64 \
+	  --hk-turn-tokens 32 --out /tmp/hostkv_smoke.json
+
+hostkv-soak: ## The 12-session / 4-turn acceptance drill (writes perf/)
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/occupancy_soak.py --host-kv \
+	  --slots 8 \
+	  --out perf/hostkv_soak_$$(date -u +%Y%m%d_%H%M%S).json
 
 ragged-soak: ## 48-slot A/B soak: bucketed vs ragged padding waste (writes perf/)
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/occupancy_soak.py \
@@ -264,7 +279,7 @@ scan: ## Security scan (Trivy fs over the tree + lockfile, CRITICAL/HIGH gate)
 	  --scanners vuln,secret \
 	  --severity CRITICAL,HIGH
 
-ci-check: ## Run the CI pipeline locally: lint+polylint+racelint+graphlint, chaos, failover, disagg(+lock-witness gate), occupancy, ragged, obs, perf-gate, tests, native(+asan), scan
+ci-check: ## Run the CI pipeline locally: lint+polylint+racelint+graphlint, chaos, failover, disagg(+lock-witness gate), occupancy, ragged, hostkv, obs, perf-gate, tests, native(+asan), scan
 	@$(MAKE) lint
 	@$(MAKE) racelint
 	@$(MAKE) graphlint
@@ -273,6 +288,7 @@ ci-check: ## Run the CI pipeline locally: lint+polylint+racelint+graphlint, chao
 	@$(MAKE) disagg-smoke
 	@$(MAKE) occupancy-smoke
 	@$(MAKE) ragged-smoke
+	@$(MAKE) hostkv-smoke
 	@$(MAKE) obs-smoke
 	@$(MAKE) perf-gate
 	@$(MAKE) test

@@ -543,6 +543,7 @@ class GraphEnv:
         self._plain = None
         self._spec = None
         self._ragged = None
+        self._hostkv = None
         self._bf16 = None
         self._train = None
         self._jaxprs = None
@@ -624,11 +625,36 @@ class GraphEnv:
             self._ragged = InferenceEngine(config)
         return self._ragged
 
+    def hostkv_engine(self):
+        """Warmed CPU engine with the host KV tier active (ISSUE 15):
+        a deliberately TIGHT device pool + an aggressive resident
+        floor, so the standard request sweep spills cold prefix pages
+        to host at retire and — because GL001/GL004 drive the same mix
+        twice (depths 1 and 2) — faults them back on the revisit. Both
+        new crossing paths (the eviction gather's packed D2H read, the
+        restore's page-payload upload) then run under the transfer
+        guard, and the gather/scatter pair's recompile stability is
+        probed like any other handle."""
+        if self._hostkv is None:
+            import dataclasses
+
+            from ..engine.engine import InferenceEngine
+
+            self.logs.append("building host-KV CPU engine (warmup)")
+            config = dataclasses.replace(
+                self._base_config(), prefix_cache=True,
+                num_pages=28, host_kv_bytes=64 << 20,
+                host_kv_resident_pages=24,
+            )
+            self._hostkv = InferenceEngine(config)
+        return self._hostkv
+
     def engines(self):
         yield "engine.plain", self.plain_engine()
         if self.profile != "smoke":
             yield "engine.spec", self.spec_engine()
             yield "engine.ragged", self.ragged_engine()
+            yield "engine.hostkv", self.hostkv_engine()
 
     def jit_handles(self, engine) -> dict[str, object]:
         handles = {
@@ -646,6 +672,12 @@ class GraphEnv:
             # cold-handle check would misread an intentional zero).
             del handles["_jit_prefill"]
             handles["_jit_ragged"] = engine._jit_ragged
+        if engine._host_kv is not None:
+            # The host tier's fixed-width gather/scatter pair (ISSUE
+            # 15): warmed at construction, and a spill or page fault
+            # mid-sweep must never mint another executable.
+            handles["_jit_kv_gather"] = engine._jit_kv_gather
+            handles["_jit_kv_restore"] = engine._jit_kv_restore
         return handles
 
     def request_mix(self, sampled: bool) -> list[list]:
@@ -825,6 +857,27 @@ class GraphEnv:
                     ),
                     count_big_leaves((engine.paged, slot_state)),
                 )
+            # KV restore scatter — shared by the ISSUE 13 handoff
+            # resume and the ISSUE 15 host-tier page fault: donates the
+            # pool like every pool-touching dispatch, so its alias map
+            # is audited like one. (The gather half of the pair donates
+            # nothing — it is a read, the pool stays.)
+            P = cfg.pages_per_seq
+            zk = np.zeros(
+                (engine.model_cfg.num_layers, P, cfg.page_size,
+                 engine.model_cfg.num_kv_heads,
+                 engine.model_cfg.head_dim),
+                engine.paged.k.dtype,
+            )
+            yield (
+                f"{engine_label}._jit_kv_restore",
+                partial(
+                    engine._jit_kv_restore.lower,
+                    engine.paged, np.zeros((P,), np.int32), zk,
+                    np.zeros_like(zk),
+                ),
+                count_big_leaves(engine.paged),
+            )
         train_step, state, batch = self.train_fixture()
         yield (
             "train.train_step",
@@ -1178,6 +1231,23 @@ class HostTransferGuard(GraphCheck):
                     f"({engine.dead.splitlines()[0][:200]}) — an unannotated "
                     "transfer sits on the loop path itself",
                 ))
+            if engine._host_kv is not None:
+                # Fixture-rot guard (ISSUE 15): the host-KV engine
+                # exists to run the eviction gather AND the fault
+                # restore under the guard — a sweep that exercised
+                # neither proved nothing about the new crossings.
+                evicted = engine.metrics.kv_pages_evicted
+                restored = engine.metrics.kv_pages_restored
+                if evicted == 0 or restored == 0:
+                    findings.append(graph_finding(
+                        "GL000", f"graph:{label}",
+                        f"{label}:hostkv-not-exercised",
+                        "GL004's host-KV smoke recorded "
+                        f"{evicted} evictions / {restored} restores — "
+                        "the sweep no longer drives both host-tier "
+                        "crossings (tighten the fixture pool or the "
+                        "resident floor)",
+                    ))
         env.logs.append(
             "GL004 guarded smoke: "
             + ("CLEAN" if not findings else f"{len(findings)} finding(s)")

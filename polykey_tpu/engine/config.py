@@ -153,6 +153,42 @@ class EngineConfig:
     prefix_cache: bool = False
     prefix_cache_pages: int = 0
 
+    # -- Host-memory KV tier (ISSUE 15, ROADMAP item 3) ----------------------
+    # POLYKEY_HOST_KV_BYTES > 0 adds a second KV tier in host RAM: cold
+    # pages — prefix-cache entries whose sessions finished (sticky
+    # multi-turn histories, long-context middles) — are evicted from the
+    # device pool into pinned host buffers through a fixed-width jit'd
+    # gather, and paged back on demand through the (equally fixed-width,
+    # pool-donating) `_jit_kv_restore` scatter when a later request's
+    # prefix-cache lookup hits them. Capacity then bounds on host RAM
+    # instead of HBM. 0 (the default) allocates NO host pool and leaves
+    # every existing path byte-identical. Requires prefix_cache (the
+    # spill source); from_env auto-enables it.
+    host_kv_bytes: int = 0
+    # Resident working set: when a retiring request leaves fewer than
+    # this many device pages free, LRU prefix-cache pages spill to the
+    # host tier until the floor is restored (eviction at retire — the
+    # proactive path that keeps admissions from ever paying the spill
+    # synchronously). 0 → num_pages // 8. POLYKEY_KV_RESIDENT_PAGES.
+    host_kv_resident_pages: int = 0
+    # Page-aware restore scheduling: how many faulting slots may issue
+    # their host→device restore dispatch per engine-loop iteration. A
+    # lane whose pages are in flight never joins a prefill/decode
+    # dispatch until its restore has issued, and this budget bounds how
+    # much restore upload work rides any one gap between decode blocks
+    # — the interleaved-prefill discipline applied to page faults.
+    # POLYKEY_KV_RESTORE_SLOTS.
+    host_kv_restore_slots: int = 2
+    # Restart-durable prefix cache: a directory where spilled prefix
+    # pages are ALSO serialized in the PR 13 KV wire format (CRC-framed
+    # `serialize_kv_state` blobs + a JSON sidecar of page keys). A fresh
+    # engine — in particular the supervisor's post-crash restart — scans
+    # the dir at construction and reloads matching pages into the host
+    # tier, so sticky sessions keep their warm TTFT across restarts.
+    # Corrupt/CRC-failing files are skipped (warmth lost, never
+    # liveness). "" disables persistence. POLYKEY_KV_STATE_DIR.
+    kv_state_dir: str = ""
+
     # Pre-compile the prefill group shapes ({1,2,4,8} × buckets) and the
     # decode block (or spec round) at engine construction, before the loop
     # starts — first requests (and benchmark windows) then never pay XLA
@@ -397,9 +433,25 @@ class EngineConfig:
                 "POLYKEY_DEFAULT_MAX_NEW_TOKENS", cls.default_max_new_tokens
             ),
             ragged_dispatch=_env_bool("POLYKEY_RAGGED"),
-            prefix_cache=_env_bool("POLYKEY_PREFIX_CACHE"),
+            # The host tier's spill source is the prefix cache, so
+            # enabling the tier enables the cache (validate() enforces
+            # the pairing for programmatic configs).
+            prefix_cache=(
+                _env_bool("POLYKEY_PREFIX_CACHE")
+                or _env_int("POLYKEY_HOST_KV_BYTES", 0) > 0
+            ),
             prefix_cache_pages=_env_int(
                 "POLYKEY_PREFIX_CACHE_PAGES", cls.prefix_cache_pages
+            ),
+            host_kv_bytes=_env_int("POLYKEY_HOST_KV_BYTES", cls.host_kv_bytes),
+            host_kv_resident_pages=_env_int(
+                "POLYKEY_KV_RESIDENT_PAGES", cls.host_kv_resident_pages
+            ),
+            host_kv_restore_slots=_env_int(
+                "POLYKEY_KV_RESTORE_SLOTS", cls.host_kv_restore_slots
+            ),
+            kv_state_dir=os.environ.get(
+                "POLYKEY_KV_STATE_DIR", cls.kv_state_dir
             ),
             compile_warmup=_env_bool("POLYKEY_COMPILE_WARMUP"),
             decode_block_steps=_env_int(
@@ -534,6 +586,34 @@ class EngineConfig:
             raise ValueError(
                 "prefix_cache_pages must be >= 0 (0 → num_pages // 2); "
                 "negative would silently disable the LRU cap"
+            )
+        if self.host_kv_bytes < 0:
+            raise ValueError(
+                "host_kv_bytes must be >= 0 (0 disables the host KV tier)"
+            )
+        if self.host_kv_bytes > 0 and not self.prefix_cache:
+            raise ValueError(
+                "host_kv_bytes > 0 requires prefix_cache: the host tier's "
+                "only spill source is the prefix cache (from_env pairs "
+                "them automatically)"
+            )
+        if self.host_kv_resident_pages < 0:
+            raise ValueError(
+                "host_kv_resident_pages must be >= 0 (0 → num_pages // 8)"
+            )
+        if self.host_kv_bytes > 0 and \
+                self.host_kv_resident_pages >= self.num_pages - 1:
+            raise ValueError(
+                f"host_kv_resident_pages={self.host_kv_resident_pages} "
+                f"must stay below the usable device pool "
+                f"({self.num_pages - 1} pages): a floor the pool can "
+                "never satisfy turns every retire into a full cache "
+                "spill and every turn into wall-to-wall page faults"
+            )
+        if self.host_kv_restore_slots < 1:
+            raise ValueError(
+                "host_kv_restore_slots must be >= 1 (a restore budget of "
+                "0 would wedge every faulting lane forever)"
             )
         if self.prefill_chunk < 0:
             raise ValueError("prefill_chunk must be >= 0 (0 → max bucket)")

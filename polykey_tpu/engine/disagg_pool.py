@@ -1081,6 +1081,14 @@ def _config_env(config: EngineConfig) -> dict:
         "POLYKEY_RAGGED": flag if config.ragged_dispatch else "0",
         "POLYKEY_PREFIX_CACHE": flag if config.prefix_cache else "0",
         "POLYKEY_PREFIX_CACHE_PAGES": str(config.prefix_cache_pages),
+        # Host-memory KV tier (ISSUE 15): a programmatic pool with the
+        # tier on must not spawn tier-less workers (warm TTFT across
+        # worker death silently off). The state dir ships as-is — the
+        # worker harness scopes its own kv-<tier>-<replica> subdir.
+        "POLYKEY_HOST_KV_BYTES": str(config.host_kv_bytes),
+        "POLYKEY_KV_RESIDENT_PAGES": str(config.host_kv_resident_pages),
+        "POLYKEY_KV_RESTORE_SLOTS": str(config.host_kv_restore_slots),
+        "POLYKEY_KV_STATE_DIR": config.kv_state_dir,
         "POLYKEY_COMPILE_WARMUP": flag if config.compile_warmup else "0",
         "POLYKEY_DECODE_BLOCK": str(config.decode_block_steps),
         "POLYKEY_ADAPTIVE_BLOCK": flag if config.adaptive_block else "0",

@@ -87,6 +87,7 @@ from .kv_cache import (
     init_paged_kv,
 )
 from .metrics import EngineMetrics, RequestTimings
+from .prefix_cache import TIER_DEVICE, TIER_HOST
 from .sampling import sample_tail
 from .tokenizer import load_tokenizer
 
@@ -195,6 +196,15 @@ class _Slot:
     seed_row: Optional[np.ndarray] = None   # [2] int32 RNG root halves
     prompt_len: int = 0
     prompt_ids: Optional[np.ndarray] = None  # for prefix-cache insertion
+    # Host-KV page faults (ISSUE 15): [(key, host_page, chain_index)]
+    # for prefix pages whose contents sit in the host tier. While set,
+    # the slot is FAULTING — it joins no prefill/ragged dispatch — until
+    # the engine loop's restore frontier issues its scatter
+    # (_issue_restores), after which the donation chain orders the page
+    # contents ahead of every dispatch that could read them. The slot
+    # owns the listed host pages (detached from the cache at admission);
+    # _finish re-adopts them if the slot dies before its restore.
+    restore_pages: Optional[list] = None
     # Open "decode" span for traced requests (None otherwise): opened when
     # the first token resolves, closed by _finish; per-block children are
     # appended by _process_step/_process_spec.
@@ -455,6 +465,28 @@ def _kv_restore_quant_fn(paged: PagedKV, idx, k, v, ks, vs):
     )
 
 
+def _kv_gather_fn(paged: PagedKV, idx):
+    """Gather page contents out of the pool for host-tier eviction
+    (ISSUE 15) — the read half of the fixed-width gather/scatter pair
+    whose write half is `_kv_restore_fn`. `idx` is padded to
+    pages_per_seq (pad rows read the reserved garbage page 0 and are
+    discarded host-side), so ONE compiled executable serves every spill
+    batch — the GL001 discipline. Read-only: the pool is NOT donated
+    (the gathered copy leaves, the pool stays), so in-flight decode
+    blocks are unaffected and the copy observes the donation-chain
+    ordering of every dispatch issued before it."""
+    return jnp.take(paged.k, idx, axis=1), jnp.take(paged.v, idx, axis=1)
+
+
+def _kv_gather_quant_fn(paged: PagedKV, idx):
+    """Int8 pair-form variant of `_kv_gather_fn`: values and their bf16
+    scale pools gather together, byte-for-byte."""
+    return (
+        jnp.take(paged.k, idx, axis=1), jnp.take(paged.v, idx, axis=1),
+        jnp.take(paged.ks, idx, axis=1), jnp.take(paged.vs, idx, axis=1),
+    )
+
+
 def ragged_zero_operands(B: int, W: int, P: int) -> tuple:
     """The 14 positional prefill operands of `_ragged_fn`, all-zero /
     all-garbage (no ranges, no sample rows) — the SINGLE builder for
@@ -481,6 +513,12 @@ def ragged_zero_operands(B: int, W: int, P: int) -> tuple:
 
 
 _MAX_PREFILL_GROUP = 8   # burst admissions batched per prefill dispatch
+
+# Router weight of a HOST-resident cached prefix token relative to a
+# device-resident one (prefix_warmth): warm — no recompute — but a
+# restore scatter away from usable, so half credit keeps the router
+# preferring truly resident replicas at equal warmth.
+_HOST_WARMTH_WEIGHT = 0.5
 
 
 class _InflightBlock(NamedTuple):
@@ -554,6 +592,9 @@ class InferenceEngine:
             "seed": seed,
             "draft_params": draft_params if config.supervise else None,
         }
+        # Whether weights came from the caller (vs checkpoint/seed
+        # derivation) — one input to the durable-KV params fingerprint.
+        self._params_explicit = params is not None
         self.model_cfg = get_config(config.model)
         self.tokenizer = load_tokenizer(config.tokenizer)
         self.metrics = EngineMetrics()
@@ -712,6 +753,15 @@ class InferenceEngine:
             donate_argnames=("paged",),
             out_shardings=self._pool_sharding,
         )
+        # Host-tier eviction gather (ISSUE 15): the read half of the
+        # gather/scatter pair (restore above is the write half). Same
+        # fixed width (pages_per_seq), one executable; outputs land
+        # replicated so the host copy is a straight np.asarray.
+        n_gather_out = 4 if self._kv_quantized else 2
+        self._jit_kv_gather = jax.jit(
+            _kv_gather_quant_fn if self._kv_quantized else _kv_gather_fn,
+            out_shardings=(self._repl,) * n_gather_out,
+        )
         # Per-request RNG roots for seedless requests (GenRequest.seed
         # None): drawn once per admission from the engine seed.
         self._seed_rng = np.random.default_rng(seed + 3)
@@ -752,6 +802,43 @@ class InferenceEngine:
             self._pool_sharding,
         )
         self.allocator = BlockAllocator(config.num_pages)
+        # --- Host-memory KV tier (ISSUE 15): a second page pool in host
+        # RAM for COLD pages (prefix-cache entries of finished sticky
+        # sessions, long-context middles). 0 bytes → no pool, no store,
+        # every existing path byte-identical.
+        self._host_kv = None
+        self._kv_state = None
+        self._kv_reloaded_pages = 0
+        if config.host_kv_bytes > 0:
+            from .kv_cache import HostKVPool, host_kv_page_bytes
+
+            page_b = host_kv_page_bytes(
+                self.model_cfg, config.page_size, pool_fp_dtype, kv_q
+            )
+            capacity = config.host_kv_bytes // max(1, page_b)
+            if capacity < 1:
+                raise ValueError(
+                    f"POLYKEY_HOST_KV_BYTES={config.host_kv_bytes} is "
+                    f"smaller than one KV page ({page_b} bytes for "
+                    f"{self.model_cfg.name} at page_size "
+                    f"{config.page_size})"
+                )
+            self._host_kv = HostKVPool(
+                self.model_cfg, capacity, config.page_size,
+                pool_fp_dtype, self._kv_quantized,
+            )
+        # Resident working set: _finish spills cold pages whenever a
+        # retirement leaves fewer free device pages than this floor.
+        self._resident_low = (
+            config.host_kv_resident_pages or config.num_pages // 8
+        )
+        # Restore-frontier round-robin cursor (the _chunk_rr
+        # discipline for page faults).
+        self._restore_rr = 0
+        # Durable-store gc cadence: gc() lists and parses the whole
+        # state dir — amortize it over batches instead of paying a
+        # directory scan per spill on the engine thread.
+        self._kv_gc_countdown = 0
         self._prefix = None
         if config.prefix_cache:
             from .prefix_cache import PrefixCache
@@ -759,6 +846,27 @@ class InferenceEngine:
             self._prefix = PrefixCache(
                 self.allocator, config.page_size,
                 config.prefix_cache_pages or config.num_pages // 2,
+                host_pool=self._host_kv,
+            )
+        if self._host_kv is not None and config.kv_state_dir:
+            # Restart-durable prefix cache: reload spilled pages
+            # persisted by a previous incarnation (same weights — the
+            # params_key gate) into the host tier, so the first sticky
+            # turn after a supervisor restart faults its prefix back in
+            # instead of recomputing it cold.
+            from .prefix_cache import PrefixStateStore
+
+            self._kv_state = PrefixStateStore(
+                config.kv_state_dir, self.model_cfg.name, config.page_size,
+                params_key=self._params_fingerprint(seed),
+                quantized=self._kv_quantized, logger=logger,
+            )
+            self._kv_reloaded_pages = self._kv_state.load_into(
+                self._prefix, self._host_kv,
+                expect_shape=(
+                    self.model_cfg.num_layers, 0, config.page_size,
+                    self.model_cfg.num_kv_heads, self.model_cfg.head_dim,
+                ),
             )
 
         self._chunk = config.prefill_chunk or max(config.prefill_buckets)
@@ -1111,13 +1219,18 @@ class InferenceEngine:
     def prefix_warmth(self, ids) -> float:
         """Fraction [0, 1] of `ids` (token id sequence) whose KV this
         engine could serve from its prefix cache — the NetKV-style
-        warmth signal the replica router scores on. Read-only: no page
-        retains, no LRU refresh, no hit accounting (prefix_cache.probe).
-        0.0 with prefix caching off or an empty prompt."""
+        warmth signal the replica/disagg routers score on. Read-only:
+        no page retains, no LRU refresh, no hit accounting
+        (prefix_cache.probe_tiered). TIER-AWARE (ISSUE 15): host-
+        resident pages count as warm — a spilled-but-warm sticky
+        session must not route as cold — but weighted below device-
+        resident ones (a restore scatter stands between them and a
+        dispatch). 0.0 with prefix caching off or an empty prompt."""
         if self._prefix is None or len(ids) == 0:
             return 0.0
         ids = np.asarray(ids, dtype=np.int32)
-        return self._prefix.probe(ids) / len(ids)
+        dev, host = self._prefix.probe_tiered(ids)
+        return (dev + _HOST_WARMTH_WEIGHT * host) / len(ids)
 
     @staticmethod
     def _deadline_expired(request: GenRequest) -> bool:
@@ -1185,6 +1298,21 @@ class InferenceEngine:
             snap["spec_gamma"] = self._gamma   # live dial value
         if self._prefix is not None:
             snap.update(self._prefix.stats())
+        # Host-KV tier (ISSUE 15): always present — collectors index
+        # these unconditionally, and 0s on a tier-less engine are the
+        # honest reading (no host pool exists).
+        snap["host_kv"] = self._host_kv is not None
+        snap["kv_host_pages"] = (
+            self._host_kv.used if self._host_kv is not None else 0
+        )
+        snap["kv_host_capacity"] = (
+            self._host_kv.capacity if self._host_kv is not None else 0
+        )
+        # Device pages in use by slots/cache (reserved page 0 excluded).
+        snap["kv_device_pages"] = (
+            self.config.num_pages - 1 - self.allocator.num_free
+        )
+        snap["kv_reloaded_pages"] = self._kv_reloaded_pages
         return snap
 
     @property
@@ -1252,6 +1380,17 @@ class InferenceEngine:
                 t0 = _t()
                 worked, spent = self._admit(budget=budget)
                 _acc("admit", t0)
+                if self._host_kv is not None:
+                    # Restore frontier (ISSUE 15): issue host→device
+                    # page scatters for faulting slots BEFORE this
+                    # iteration's prefill/decode dispatches — restores
+                    # ride ahead of need on the donation chain, budgeted
+                    # like interleaved prefill so they cannot stall live
+                    # decode beyond host_kv_restore_slots uploads.
+                    t0 = _t()
+                    if self._issue_restores():
+                        worked = True
+                    _acc("restore", t0)
                 if self._ragged:
                     # Ragged mode: admissions only REGISTER (token-range
                     # appends happen in _dispatch_step's batch builder,
@@ -1510,11 +1649,44 @@ class InferenceEngine:
         ids = np.asarray(prompt_ids, dtype=np.int32)
 
         # Prefix cache: reuse pages covering a cached page-aligned prefix
-        # (lookup retains them for this slot); only the suffix prefills.
+        # (lookup retains device pages for this slot); only the suffix
+        # prefills. With the host tier on (ISSUE 15) the lookup walks
+        # BOTH tiers: host-resident hits are PAGE FAULTS — each gets a
+        # fresh device page here, the host contents scatter in via the
+        # restore frontier (_issue_restores), and the slot joins no
+        # dispatch until that restore has issued.
         matched: list[int] = []
+        chain: list = []
+        fault_idx: list[int] = []
         if self._prefix is not None:
-            matched = self._prefix.lookup(ids)
-        need = -(-(total_len + self._gamma_max) // cfg.page_size) - len(matched)
+            if self._host_kv is not None:
+                chain, fault_idx = self._prefix.lookup_chain(ids)
+                if not fault_idx:
+                    # All-device chain: identical to the classic lookup.
+                    matched = [page for _, _, page in chain]
+                    chain = []
+            else:
+                matched = self._prefix.lookup(ids)
+        restore_items: list = []
+        if chain:
+            # Detach the chain's host pages BEFORE allocating: the
+            # pressure path below may spill into a full host tier,
+            # whose LRU drop (`pop_lru_host`) must never free a page
+            # this admission's pending restore depends on. Ownership
+            # moves to this request now and returns (re-adopt) on the
+            # allocation-failure path.
+            for ci, (key, tier, _page) in enumerate(chain):
+                if tier == TIER_HOST:
+                    restore_items.append(
+                        (key, self._prefix.detach_host(key), ci)
+                    )
+        n_dev_matched = (
+            (len(chain) - len(fault_idx)) if chain else len(matched)
+        )
+        need = (
+            -(-(total_len + self._gamma_max) // cfg.page_size)
+            - n_dev_matched
+        )
         try:
             if self._faults is not None:
                 # Inside the try: the AllocationError path below must
@@ -1528,13 +1700,42 @@ class InferenceEngine:
             except AllocationError:
                 if self._prefix is None:
                     raise
-                # Allocation pressure: shed cold cache entries and retry.
-                self._prefix.evict_for(need)
+                # Allocation pressure: offload cold cache pages to the
+                # host tier when it exists (warmth preserved), drop them
+                # when it doesn't (or it couldn't free enough), retry.
+                if self._host_kv is not None:
+                    self._spill_for(need)
+                if self.allocator.num_free < need:
+                    self._prefix.evict_for(need)
                 fresh = self.allocator.alloc(need)
         except AllocationError:
-            self.allocator.release_all(matched)     # drop lookup's refs
+            if chain:
+                self._prefix.release_chain(chain)   # drop lookup's refs
+                for key, host_page, _ci in restore_items:
+                    # Hand the detached host pages back to the cache
+                    # (warmth survives the requeue); a key re-cached
+                    # meanwhile keeps its copy and ours frees.
+                    if not self._prefix.adopt_host(key, host_page):
+                        self._host_kv.release(host_page)
+            else:
+                self.allocator.release_all(matched)
             raise
-        pages = matched + fresh
+        if chain:
+            # Assemble the table in chain order: device hits keep their
+            # shared pages; fault positions take fresh pages whose
+            # contents arrive via the restore frontier (the host pages
+            # detached to this slot above).
+            pages = []
+            fi = 0
+            for _key, tier, page in chain:
+                if tier == TIER_DEVICE:
+                    pages.append(page)
+                else:
+                    pages.append(fresh[fi])
+                    fi += 1
+            pages += fresh[fi:]
+        else:
+            pages = matched + fresh
         if request.trace is not None:
             # Recorded only after allocation succeeds: an AllocationError
             # requeues the request and re-enters this method, and the
@@ -1566,6 +1767,23 @@ class InferenceEngine:
         slot.table = page_table
         slot.prompt_len = prompt_len
         slot.prompt_ids = ids
+
+        if restore_items:
+            # Faulting admission: the slot registers with its whole
+            # prompt pending from the post-chain offset and WAITS for
+            # the restore frontier — it joins no prefill dispatch until
+            # its pages are in flight on the donation chain, so resident
+            # lanes admitted this same iteration dispatch ahead of it
+            # (the page-aware no-stall property).
+            slot.restore_pages = restore_items
+            kind = (
+                "ctx" if prompt_len > max(cfg.prefill_buckets) else "prefix"
+            )
+            self.metrics.on_kv_fault(kind, len(restore_items))
+            slot.pending = ids
+            slot.filled = len(chain) * cfg.page_size
+            self._slots[slot_idx] = slot
+            return None
 
         if self._ragged:
             # Ragged mode: EVERY prompt registers as a pending token
@@ -1713,6 +1931,8 @@ class InferenceEngine:
             s = self._slots[i]
             if s is None or s.pending is None:
                 continue
+            if s.restore_pages is not None:
+                continue   # faulting: waits for the restore frontier
             if s.request.cancelled.is_set():
                 self._finish(i, error="cancelled")
                 continue
@@ -2058,6 +2278,25 @@ class InferenceEngine:
             dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
             dev["active"], dev["caps"], np.int32(0),
         )
+        if self._host_kv is not None:
+            # Host-tier gather/scatter pair (ISSUE 15): pre-compile both
+            # fixed-width executables against the reserved garbage page
+            # so the first spill or page fault at serving time never
+            # pays XLA compile time (the GL001 discipline: one resident
+            # executable each way, warmed here, never again).
+            P = cfg.pages_per_seq
+            idx0 = np.zeros((P,), np.int32)
+            jax.block_until_ready(self._jit_kv_gather(self.paged, put(idx0)))
+            zk = np.zeros(
+                (self.model_cfg.num_layers, P, cfg.page_size,
+                 self.model_cfg.num_kv_heads, self.model_cfg.head_dim),
+                self.paged.k.dtype,
+            )
+            operands = [put(idx0), put(zk), put(np.zeros_like(zk))]
+            if self._kv_quantized:
+                zs = np.zeros(zk.shape[:-1], jnp.dtype(jnp.bfloat16))
+                operands += [put(zs), put(np.zeros_like(zs))]
+            self.paged = self._jit_kv_restore(self.paged, *operands)
         jax.block_until_ready(self.paged)
         # The dirty flag forces a fresh upload once real slots exist.
         self._dev_dirty = True
@@ -2424,6 +2663,205 @@ class InferenceEngine:
             self._process_step(self._inflight_q.popleft())
         self._resolve_prefills(block=True)
 
+    # -- host-memory KV tier (ISSUE 15) --------------------------------------
+
+    def _params_fingerprint(self, seed: int) -> str:
+        """Fingerprint of everything that determines KV content, gating
+        durable prefix reloads: a state dir written under one set of
+        weights must never warm an engine serving another. Explicit
+        caller-provided params hash as a flag only — the supervisor's
+        restart factory replays the same object, which is the contract
+        that makes the flag sufficient there; callers mixing state dirs
+        across different explicit weights are on their own (DEPLOY.md)."""
+        import hashlib as _hashlib
+
+        basis = (
+            self.config.model, self.config.dtype, self.config.kv_dtype,
+            self.config.quantize, self.config.quantize_bits,
+            self.config.checkpoint_path or "",
+            -1 if (self._params_explicit or self.config.checkpoint_path)
+            else seed,
+            self._params_explicit,
+            self.config.page_size,
+        )
+        return _hashlib.blake2b(
+            repr(basis).encode(), digest_size=8
+        ).hexdigest()
+
+    def _issue_restores(self) -> int:
+        """The restore frontier: issue host→device page scatters for up
+        to `host_kv_restore_slots` FAULTING slots, round-robin ahead of
+        this iteration's prefill/decode dispatches. A faulting lane
+        joins no dispatch until its restore has issued; once it has, the
+        pool donation chain orders the restored contents ahead of every
+        dispatch that could read them — so a resident lane never waits
+        on a faulting one, and a faulting lane never needs a host sync
+        to know its pages landed (page-aware scheduling, PersistentKV
+        shape). Returns the number of slots restored."""
+        if self._host_kv is None:
+            return 0
+        issued = 0
+        B = len(self._slots)
+        for off in range(B):
+            # Round-robin from the cursor (the _chunk_rr discipline):
+            # admissions always fill the lowest free index, so a
+            # 0-based scan would let fresh low-index faults starve a
+            # high-index faulting slot of the per-iteration budget.
+            i = (self._restore_rr + off) % B
+            slot = self._slots[i]
+            if slot is None or slot.restore_pages is None:
+                continue
+            if issued >= self.config.host_kv_restore_slots:
+                self._restore_rr = i        # starved slot goes first next
+                return issued
+            if slot.request.cancelled.is_set():
+                self._finish(i, error="cancelled")
+                continue
+            self._restore_slot_pages(i, slot)
+            issued += 1
+        self._restore_rr = (self._restore_rr + 1) % B
+        return issued
+
+    def _restore_slot_pages(self, slot_idx: int, slot: _Slot) -> None:
+        """One faulting slot's restore: copy its host pages into the
+        fixed-width upload buffers, scatter them into the slot's own
+        device pages (`_jit_kv_restore`, pool donated — ONE executable,
+        shared with the ISSUE 13 handoff restore), then promote the
+        prefix-cache entries so later lookups hit device tier."""
+        items = slot.restore_pages
+        assert items
+        cfg = self.config
+        t0 = time.monotonic()
+        P = cfg.pages_per_seq
+        pool = self._host_kv
+        idx = np.zeros((P,), np.int32)        # pad rows → garbage page 0
+        k = np.zeros((self.model_cfg.num_layers, P, cfg.page_size,
+                      self.model_cfg.num_kv_heads,
+                      self.model_cfg.head_dim), self.paged.k.dtype)
+        v = np.zeros_like(k)
+        ks = vs = None
+        if self._kv_quantized:
+            ks = np.zeros(k.shape[:-1], jnp.dtype(jnp.bfloat16))
+            vs = np.zeros_like(ks)
+        for r, (key, host_page, chain_idx) in enumerate(items):
+            idx[r] = slot.pages[chain_idx]
+            hk, hv, hks, hvs = pool.read(host_page)
+            k[:, r] = hk
+            v[:, r] = hv
+            if self._kv_quantized:
+                ks[:, r] = hks
+                vs[:, r] = hvs
+        try:
+            put = partial(jax.device_put, device=self._repl)
+            operands = [put(idx), put(k), put(v)]
+            if self._kv_quantized:
+                operands += [put(ks), put(vs)]
+            # _host_crossing: the page payload rides up as one
+            # deliberate upload — the page fault's whole point.
+            with _host_crossing():
+                self.paged = self._jit_kv_restore(self.paged, *operands)
+        except Exception as e:
+            # Host copies are untouched on failure; _finish re-adopts
+            # them into the cache so the warmth survives this slot.
+            self._finish(slot_idx, error=f"kv restore failed: {e}")
+            return
+        for key, host_page, chain_idx in items:
+            pool.release(host_page)
+            # Re-register under the slot's device page (detached at
+            # admission); a racing re-insert of the same prefix wins
+            # harmlessly — our copy still serves this slot.
+            self._prefix.reinsert_device(key, slot.pages[chain_idx])
+        slot.restore_pages = None
+        ms = (time.monotonic() - t0) * 1e3
+        self.metrics.on_kv_restore(len(items), ms)
+        if self.timeline is not None:
+            self.timeline.note(
+                "kv_restore", slot=slot_idx, pages=len(items),
+                ms=round(ms, 3),
+            )
+
+    def _spill_for(self, target_free: int) -> int:
+        """Cold-page offload: spill LRU device-tier prefix entries into
+        the host pool until the allocator has `target_free` free pages
+        or no spillable entries remain. A spilled page whose content is
+        also shared by a live slot frees only when that slot retires —
+        the loop re-reads num_free rather than counting. Returns pages
+        spilled."""
+        if self._host_kv is None or self._prefix is None:
+            return 0
+        spilled = 0
+        P = self.config.pages_per_seq
+        while self.allocator.num_free < target_free:
+            cands = self._prefix.spill_candidates(P)
+            if not cands:
+                break
+            spilled += self._spill_batch(cands)
+        return spilled
+
+    def _spill_batch(self, cands: list) -> int:
+        """Gather one batch of cold pages (≤ pages_per_seq — the fixed
+        gather width) to host in ONE dispatch + one packed D2H read,
+        move each into the host pool (LRU-dropping host entries under
+        cap pressure), and write the batch through to the durable state
+        dir when configured."""
+        cfg = self.config
+        P = cfg.pages_per_seq
+        idx = np.zeros((P,), np.int32)
+        idx[:len(cands)] = [page for _, page in cands]
+        outs = self._jit_kv_gather(self.paged, jax.device_put(idx, self._repl))
+        with _host_crossing():
+            # polylint: disable=PL008(eviction gather resolve: one packed D2H read per spill batch; cold path, reached from dispatch only via _finish under the resident-floor check)
+            k = np.asarray(outs[0])
+            # polylint: disable=PL008(spill gather read, same cold path)
+            v = np.asarray(outs[1])
+            ks = vs = None
+            if self._kv_quantized:
+                # polylint: disable=PL008(spill gather read, same cold path)
+                ks = np.asarray(outs[2])
+                # polylint: disable=PL008(spill gather read, same cold path)
+                vs = np.asarray(outs[3])
+        moved: list[tuple[bytes, int]] = []   # (key, gather row)
+        for r, (key, _page) in enumerate(cands):
+            try:
+                host_page = self._host_kv.alloc()
+            except AllocationError:
+                # Host tier full: LRU pressure — drop the coldest host
+                # entry to make room; an empty host LRU means the tier
+                # is smaller than this batch, so the entry is dropped
+                # outright (forgotten, recomputed on next use).
+                if self._prefix.pop_lru_host() is None:
+                    self._prefix.drop(key)
+                    continue
+                host_page = self._host_kv.alloc()
+            self._host_kv.write(
+                host_page, k[:, r], v[:, r],
+                ks[:, r] if ks is not None else None,
+                vs[:, r] if vs is not None else None,
+            )
+            self._prefix.mark_host(key, host_page)
+            moved.append((key, r))
+        if moved:
+            self.metrics.on_kv_evict(len(moved))
+            if self.timeline is not None:
+                self.timeline.note("kv_evict", pages=len(moved))
+            if self._kv_state is not None:
+                rows = [r for _, r in moved]
+                self._kv_state.save_batch(
+                    [key for key, _ in moved],
+                    k[:, rows], v[:, rows],
+                    ks[:, rows] if ks is not None else None,
+                    vs[:, rows] if vs is not None else None,
+                )
+                # Amortized gc: the cap is approximate anyway (oldest
+                # batches beyond ~capacity), so a dir scan every 16
+                # batches bounds the overshoot without paying listdir +
+                # sidecar parses on every retire-pressure spill.
+                self._kv_gc_countdown -= 1
+                if self._kv_gc_countdown <= 0:
+                    self._kv_state.gc(self._host_kv.capacity)
+                    self._kv_gc_countdown = 16
+        return len(moved)
+
     def _advance_chunked_prefills(self, budget: Optional[int]) -> int:
         """Advance slots mid-chunked-prefill, round-robin from the
         `_chunk_rr` cursor, one chunk per slot per call, until the token
@@ -2438,6 +2876,11 @@ class InferenceEngine:
             i = (self._chunk_rr + off) % B
             s = self._slots[i]
             if s is None or s.pending is None:
+                continue
+            if s.restore_pages is not None:
+                # Faulting slot: its prefix pages are not in flight yet
+                # — it joins no dispatch until the restore frontier
+                # issues its scatter (_issue_restores).
                 continue
             if budget is not None and spent > 0 and spent >= budget:
                 # Leave the cursor ON the starved slot so it goes first
@@ -3023,6 +3466,16 @@ class InferenceEngine:
                 request.trace.set(cancelled=True)
             else:
                 request.trace.set(error=error)
+        if slot.restore_pages:
+            # Died faulting (cancel/deadline/failure before its restore
+            # issued): the slot owns these host pages — re-adopt them
+            # into the cache so the warmth survives the slot; a key
+            # re-cached meanwhile keeps its copy and ours frees.
+            for key, host_page, _ci in slot.restore_pages:
+                if self._prefix is None or \
+                        not self._prefix.adopt_host(key, host_page):
+                    self._host_kv.release(host_page)
+            slot.restore_pages = None
         self.allocator.release_all(slot.pages)
         self._slots[slot_idx] = None
         self._active[slot_idx] = False
@@ -3058,6 +3511,16 @@ class InferenceEngine:
                         "mirror re-upload", slot=slot_idx, error=str(e),
                     )
                 self._dev_dirty = True
+        if self._host_kv is not None and self.dead is None \
+                and not self._stop.is_set() \
+                and self.allocator.num_free < self._resident_low:
+            # Eviction at retire (ISSUE 15): the request just released
+            # its pages; if the free list is still below the resident
+            # working-set floor, the pool is crowded with COLD pages —
+            # spill LRU prefix entries to host now, off any admission's
+            # critical path, so the next burst allocates without paying
+            # the gather synchronously.
+            self._spill_for(self._resident_low)
         if error is not None:
             request.out.put(("error", error))
             self.metrics.on_finish(request.timings, failed=True,

@@ -198,6 +198,98 @@ def kv_pool_bytes(
     return 2 * cfg.num_layers * num_pages * page_size * per_slot
 
 
+def host_kv_page_bytes(
+    cfg: ModelConfig, page_size: int, dtype=jnp.bfloat16, kv_dtype=None,
+) -> int:
+    """Bytes ONE page occupies in the host tier (K + V across all layers,
+    plus the bf16 scale rows for int8 pools) — the unit
+    POLYKEY_HOST_KV_BYTES divides into a page capacity."""
+    return kv_pool_bytes(cfg, 1, page_size, dtype, kv_dtype)
+
+
+class HostKVPool:
+    """Second KV tier in host RAM (ISSUE 15): preallocated numpy pools
+    mirroring the device layout per page — k/v [L, capacity, page_size,
+    Hk, D] (+ ks/vs scale pools [L, capacity, page_size, Hk] for int8)
+    — holding COLD pages spilled from the device pool by the prefix
+    cache. Pages here are never computed against: they exist to be
+    scattered back into the device pool (`engine._jit_kv_restore`) when
+    a prefix-cache lookup hits a spilled entry, so max cold capacity
+    bounds on host RAM instead of HBM.
+
+    Preallocation is deliberate: one contiguous buffer per pool at
+    construction (the CPU analog of pinned host memory — on TPU hosts
+    these become the staging buffers DMA engines copy from), no
+    allocation on the spill/restore paths, and the capacity check is
+    one free-list pop. Single-owner: only the engine thread touches it.
+    """
+
+    def __init__(self, cfg: ModelConfig, capacity_pages: int,
+                 page_size: int, dtype, quantized: bool):
+        if capacity_pages < 1:
+            raise ValueError("HostKVPool needs capacity_pages >= 1")
+        self.capacity = capacity_pages
+        shape = (cfg.num_layers, capacity_pages, page_size,
+                 cfg.num_kv_heads, cfg.head_dim)
+        if quantized:
+            self.k = np.zeros(shape, np.int8)
+            self.v = np.zeros(shape, np.int8)
+            self.ks = np.zeros(shape[:-1], jnp.dtype(jnp.bfloat16))
+            self.vs = np.zeros(shape[:-1], jnp.dtype(jnp.bfloat16))
+        else:
+            self.k = np.zeros(shape, jnp.dtype(dtype))
+            self.v = np.zeros(shape, jnp.dtype(dtype))
+            self.ks = None
+            self.vs = None
+        self._free = list(range(capacity_pages - 1, -1, -1))
+
+    @property
+    def quantized(self) -> bool:
+        return self.ks is not None
+
+    @property
+    def used(self) -> int:
+        return self.capacity - len(self._free)
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def alloc(self) -> int:
+        """One host page; AllocationError when the tier is full — the
+        caller's LRU pressure policy decides what to drop."""
+        if not self._free:
+            raise AllocationError(
+                f"host KV tier full ({self.capacity} pages)"
+            )
+        return self._free.pop()
+
+    def release(self, page: int) -> None:
+        if page < 0 or page >= self.capacity:
+            raise ValueError(f"release of invalid host page {page}")
+        self._free.append(page)
+
+    def write(self, page: int, k: np.ndarray, v: np.ndarray,
+              ks: Optional[np.ndarray] = None,
+              vs: Optional[np.ndarray] = None) -> None:
+        """Copy one page's contents ([L, page_size, Hk, D] slices of a
+        gather result) into the host buffers — raw bytes, no dtype
+        conversion, so a later restore is bit-identical."""
+        self.k[:, page] = k
+        self.v[:, page] = v
+        if self.quantized:
+            self.ks[:, page] = ks
+            self.vs[:, page] = vs
+
+    def read(self, page: int) -> tuple:
+        """(k, v, ks, vs) views of one host page (restore operands are
+        built by copying these into the padded upload buffer)."""
+        if self.quantized:
+            return (self.k[:, page], self.v[:, page],
+                    self.ks[:, page], self.vs[:, page])
+        return self.k[:, page], self.v[:, page], None, None
+
+
 # -- KV handoff wire format (ISSUE 13) ----------------------------------------
 # A prefill-tier worker ships a finished prompt's KV state to a
 # decode-tier worker as one self-describing byte blob: gathered page

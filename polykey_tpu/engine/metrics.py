@@ -163,6 +163,17 @@ class EngineMetrics:
         # steady state" dial, from the recorded schedule.
         self.device_busy_ms_total = 0.0
         self.device_ms_hist = Histogram()
+        # Host-memory KV tier (ISSUE 15): page-fault counters by kind
+        # ("prefix" = a sticky short-prompt session resuming off spilled
+        # pages, "ctx" = a long-context prompt's middle pages paging
+        # back for chunked prefill), spill/restore page counters, and
+        # the restore-latency histogram (gather of host contents +
+        # upload + scatter dispatch — the cost a faulting lane pays that
+        # a resident lane must never share).
+        self.kv_page_faults = {"prefix": 0, "ctx": 0}
+        self.kv_pages_evicted = 0
+        self.kv_pages_restored = 0
+        self.kv_restore_hist = Histogram()
         # SLO signal plane (ISSUE 11): attached by the engine when
         # signals are enabled (obs.signals.SignalPlane), None otherwise.
         # It lives HERE — not on the engine — because the supervisor's
@@ -293,6 +304,10 @@ class EngineMetrics:
                 "device_busy_ms_total": self.device_busy_ms_total,
                 "drafts_accepted": self.drafts_accepted,
                 "drafts_proposed": self.drafts_proposed,
+                "kv_page_faults_prefix": self.kv_page_faults["prefix"],
+                "kv_page_faults_ctx": self.kv_page_faults["ctx"],
+                "kv_pages_evicted": self.kv_pages_evicted,
+                "kv_pages_restored": self.kv_pages_restored,
             }
 
     def lanes_snapshot(self) -> dict:
@@ -323,6 +338,21 @@ class EngineMetrics:
                 "tokens_dispatched_total": self.tokens_dispatched_total,
                 "tokens_useful_total": self.tokens_useful_total,
             }
+
+    def on_kv_fault(self, kind: str, pages: int) -> None:
+        """`pages` host-resident pages faulted for one admission
+        (restored before its suffix may prefill)."""
+        with self._lock:
+            self.kv_page_faults[kind] += pages
+
+    def on_kv_evict(self, pages: int) -> None:
+        with self._lock:
+            self.kv_pages_evicted += pages
+
+    def on_kv_restore(self, pages: int, ms: float) -> None:
+        with self._lock:
+            self.kv_pages_restored += pages
+        self.kv_restore_hist.observe(ms)
 
     def on_admit(self) -> None:
         with self._lock:
@@ -418,6 +448,12 @@ class EngineMetrics:
                 self._window_start = time.monotonic()
                 self._window_tokens = 0
             snap = {
+                # Host-KV tier (ISSUE 15): always present (0 with the
+                # tier off) so collectors index them unconditionally.
+                "kv_page_faults_prefix": self.kv_page_faults["prefix"],
+                "kv_page_faults_ctx": self.kv_page_faults["ctx"],
+                "kv_pages_evicted": self.kv_pages_evicted,
+                "kv_pages_restored": self.kv_pages_restored,
                 "requests_admitted": self.requests_admitted,
                 "requests_completed": self.requests_completed,
                 "requests_failed": self.requests_failed,
@@ -496,6 +532,10 @@ class EngineMetrics:
             p50, p95 = self.device_ms_hist.percentiles(50, 95)
             snap["request_device_ms_p50"] = round(p50, 2)
             snap["request_device_ms_p95"] = round(p95, 2)
+        if self.kv_restore_hist.count:
+            p50, p95 = self.kv_restore_hist.percentiles(50, 95)
+            snap["kv_restore_ms_p50"] = round(p50, 2)
+            snap["kv_restore_ms_p95"] = round(p95, 2)
         if drafts_proposed:
             snap["drafts_accepted"] = drafts_accepted
             snap["drafts_proposed"] = drafts_proposed

@@ -53,6 +53,7 @@ The pool quacks like an engine where the gateway needs it to
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -91,6 +92,11 @@ _ADDITIVE_KEYS = frozenset({
     "prefill_tokens_total", "blocks_processed", "host_stall_ms_total",
     "device_busy_ms_total",
     "prefix_cache_pages", "prefix_hit_tokens", "prefix_lookup_tokens",
+    "prefix_host_pages", "prefix_host_hit_tokens",
+    "kv_page_faults_prefix", "kv_page_faults_ctx",
+    "kv_pages_evicted", "kv_pages_restored",
+    "kv_host_pages", "kv_host_capacity", "kv_device_pages",
+    "kv_reloaded_pages",
     "drafts_accepted", "drafts_proposed",
 })
 
@@ -236,7 +242,17 @@ class ReplicaPool:
         # NEW) before any watchdog/supervisor thread starts, so a shim
         # callback can never index a replica that isn't there yet.
         for i in range(n):
-            rep_cfg = dataclasses.replace(config, replica=i)
+            # Per-replica durable-KV state dir (ISSUE 15): a shared dir
+            # would let each replica's store gc() — capped at ONE
+            # engine's host capacity — delete the other replicas'
+            # batches (the same scoping the cross-process worker
+            # harness applies).
+            kv_dir = config.kv_state_dir
+            if kv_dir:
+                kv_dir = os.path.join(kv_dir, f"kv-replica-{i}")
+            rep_cfg = dataclasses.replace(
+                config, replica=i, kv_state_dir=kv_dir,
+            )
             shim = _ReplicaHealth(pool, i)
             engine = InferenceEngine(
                 rep_cfg, params=params, health=shim, logger=logger,

@@ -217,8 +217,13 @@ class EngineSupervisor:
         # WHY the frontier counters reset (ISSUE 10).
         timeline = getattr(fresh, "timeline", None)
         if timeline is not None:
+            # kv_reloaded: pages the fresh engine pulled back from the
+            # durable prefix store (ISSUE 15) — the restart-handoff
+            # evidence that warm TTFT survived the swap.
             timeline.note("engine_restart", reason=reason,
-                          restarts=self.restarts)
+                          restarts=self.restarts,
+                          kv_reloaded=getattr(
+                              fresh, "_kv_reloaded_pages", 0))
         if self.logger is not None:
             self.logger.info(
                 "engine restarted", restarts=self.restarts,

@@ -195,9 +195,23 @@ class WorkerServer:
         self._died = False
         # Worker engines are single-engine by definition: the pool is
         # the cross-process scale-out, and tier identity scopes faults.
+        # With the host KV tier on, the worker's durable prefix pages
+        # land in a per-worker subdir of the state dir (alongside the
+        # warm-session index below), so a respawned worker process
+        # reloads its own spilled pages — warm TTFT across process
+        # death, not just supervised in-process restarts.
+        # ALWAYS per-worker: even an explicit POLYKEY_KV_STATE_DIR gets
+        # a worker-scoped subdir, or every worker's durable-store gc()
+        # (capped at ONE engine's host capacity) would delete the other
+        # workers' batches out of the shared directory.
+        kv_dir = config.kv_state_dir
+        if not kv_dir and state_dir and config.host_kv_bytes > 0:
+            kv_dir = state_dir
+        if kv_dir:
+            kv_dir = os.path.join(kv_dir, f"kv-{tier}-{replica}")
         worker_cfg = dataclasses.replace(
             config, replicas=1, disagg="", disagg_tier=tier,
-            replica=replica,
+            replica=replica, kv_state_dir=kv_dir,
         )
         self.config = worker_cfg
         self.engine = InferenceEngine(
@@ -443,6 +457,15 @@ class WorkerServer:
             "load": engine.load_fraction(),
             "retained_handoffs": len(self._retained),
             "warm_sessions": list(self._warm_keys)[-512:],
+            # Host-KV tier warmth advertisement (ISSUE 15): how much
+            # cold-but-warm state this worker holds (host-resident pages
+            # restore in ~ms; a cold recompute costs a full prefill) —
+            # routing-relevant exactly like warm_sessions above.
+            "kv_host_pages": (
+                engine._host_kv.used
+                if getattr(engine, "_host_kv", None) is not None else 0
+            ),
+            "kv_reloaded_pages": getattr(engine, "_kv_reloaded_pages", 0),
         }
 
     def _stats_reply(self) -> dict:

@@ -135,6 +135,24 @@ _ENGINE_FAMILIES: tuple = (
     ("hist", "polykey_itl_ms",
      "Inter-token gap, ms (per decode block, amortized per token).",
      "itl_hist"),
+    # Host-memory KV tier (ISSUE 15): cold-page offload/restore
+    # accounting. Families render (at 0) on tier-less engines too, so
+    # dashboards exist before the tier is turned on.
+    ("kvfaults", "polykey_kv_page_faults_total",
+     "Prefix-cache hits on HOST-resident pages, by kind: prefix "
+     "(sticky short-prompt session resuming off spilled pages), ctx "
+     "(a long-context prompt's middle pages paging back in).", None),
+    ("counter", "polykey_kv_pages_evicted_total",
+     "Cold pages spilled from the device pool to the host tier.",
+     "kv_pages_evicted"),
+    ("gauge", "polykey_kv_host_pages",
+     "KV pages currently resident in the host tier.", "kv_host_pages"),
+    ("gauge", "polykey_kv_device_pages",
+     "Device pool pages in use by slots/prefix cache (reserved "
+     "garbage page excluded).", "kv_device_pages"),
+    ("hist", "polykey_kv_restore_ms",
+     "Per-fault restore latency, ms: host gather + upload + scatter "
+     "dispatch for one faulting slot's pages.", "kv_restore_hist"),
 )
 
 _SPEC_FAMILIES: tuple = (
@@ -315,6 +333,14 @@ def _disagg_lines(pool) -> list[str]:
                         name, {**labels, "phase": phase},
                         snap.get(f"deadline_expired_{phase}", 0),
                     ))
+        elif kind == "kvfaults":
+            lines += render_header(name, help_text, "counter")
+            for labels, snap in members:
+                for fault_kind in ("prefix", "ctx"):
+                    lines.append(render_sample(
+                        name, {**labels, "kind": fault_kind},
+                        snap.get(f"kv_page_faults_{fault_kind}", 0),
+                    ))
         elif kind == "hist":
             if name not in _DISAGG_HISTS:
                 continue    # bucket counts for these don't cross the wire
@@ -437,6 +463,14 @@ def engine_collector(engine_or_provider):
                         lines.append(render_sample(
                             name, {**labels, "phase": phase},
                             snap[f"deadline_expired_{phase}"],
+                        ))
+            elif kind == "kvfaults":
+                lines += render_header(name, help_text, "counter")
+                for labels, _engine, snap in members:
+                    for fault_kind in ("prefix", "ctx"):
+                        lines.append(render_sample(
+                            name, {**labels, "kind": fault_kind},
+                            snap.get(f"kv_page_faults_{fault_kind}", 0),
                         ))
             elif kind == "hist":
                 lines += render_header(name, help_text, "histogram")

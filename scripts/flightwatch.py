@@ -98,6 +98,29 @@ def render(families: dict, slo: dict, now: str, target: str) -> str:
         "",
     ]
 
+    # Host-memory KV tier (ISSUE 15): rendered whenever the families
+    # exist (they render at 0 on tier-less engines — the row then reads
+    # all zeros, which is the honest "tier off" frame).
+    host_pages = metric(families, "polykey_kv_host_pages")
+    if host_pages is not None:
+        faults_prefix = metric(families, "polykey_kv_page_faults_total",
+                               kind="prefix")
+        faults_ctx = metric(families, "polykey_kv_page_faults_total",
+                            kind="ctx")
+        lines += [
+            "HOST-KV",
+            "  host pages {:>6}   device pages {:>6}   evicted {:>7}"
+            "   faults p/c {:>5}/{:<5}".format(
+                _fmt(host_pages, "{:.0f}"),
+                _fmt(metric(families, "polykey_kv_device_pages"), "{:.0f}"),
+                _fmt(metric(families, "polykey_kv_pages_evicted_total"),
+                     "{:.0f}"),
+                _fmt(faults_prefix, "{:.0f}"),
+                _fmt(faults_ctx, "{:.0f}"),
+            ),
+            "",
+        ]
+
     aggregate = (slo or {}).get("aggregate") or {}
     if aggregate:
         lines.append("WINDOWS        ttft_p50  ttft_p95   itl_p95"

@@ -89,6 +89,14 @@ REQUIRED_FAMILIES = (
     "polykey_slo_budget_remaining_ratio",
     "polykey_slo_burn_rate",
     "polykey_slo_breaches_total",
+    # Host-memory KV tier (ISSUE 15): families render (at 0) with the
+    # tier off too, so offload dashboards can exist before turn-on.
+    'polykey_kv_page_faults_total{kind="prefix"}',
+    'polykey_kv_page_faults_total{kind="ctx"}',
+    "polykey_kv_pages_evicted_total",
+    "polykey_kv_host_pages",
+    "polykey_kv_device_pages",
+    "polykey_kv_restore_ms_bucket",
 )
 
 # One exemplar line on the TTFT histogram, OpenMetrics syntax:

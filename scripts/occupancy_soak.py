@@ -129,11 +129,31 @@ def main() -> int:
                          "as Perfetto JSON to this path (ISSUE 10: the "
                          "committed perf/timeline_*.json artifacts — "
                          "open at https://ui.perfetto.dev)")
+    ap.add_argument("--host-kv", action="store_true",
+                    help="host-memory KV tier soak (ISSUE 15): sticky "
+                         "multi-turn sessions whose aggregate KV exceeds "
+                         "the device pool, greedy streams gated "
+                         "bit-identical to an all-device run, and a "
+                         "supervised restart mid-soak that must recover "
+                         "warm TTFT from the persisted prefix cache")
+    ap.add_argument("--hk-sessions", type=int, default=12,
+                    help="sticky sessions in --host-kv mode")
+    ap.add_argument("--hk-turns", type=int, default=4,
+                    help="turns per sticky session in --host-kv mode")
+    ap.add_argument("--hk-base", type=int, default=96,
+                    help="base history tokens per session (--host-kv)")
+    ap.add_argument("--hk-turn-tokens", type=int, default=48,
+                    help="history growth per turn (--host-kv)")
+    ap.add_argument("--min-footprint", type=float, default=1.5,
+                    help="gate: aggregate session KV / device pool must "
+                         "reach this ratio in --host-kv mode")
     args = ap.parse_args()
     return run_main(args)
 
 
 def run_main(args) -> int:
+    if getattr(args, "host_kv", False):
+        return run_hostkv_main(args)
     if args.ab_ragged:
         if args.timeline:
             # One flag, two engines — ambiguous target. Refuse loudly
@@ -428,6 +448,342 @@ def run_soak(args, ragged: bool) -> dict:
         return result
     finally:
         engine.shutdown()
+
+
+# -- host-memory KV tier soak (ISSUE 15) --------------------------------------
+#
+# Shape: S sticky multi-turn sessions whose histories grow every turn,
+# sized so the aggregate KV footprint exceeds the device pool by
+# >= --min-footprint (1.5x by default). Cold histories spill to the
+# host tier between turns (resident-floor eviction at retire) and fault
+# back in on the next turn — the soak gates that EVERY greedy stream is
+# bit-identical to an all-device reference run (huge pool, host tier
+# off), that zero requests fail, and that a real EngineSupervisor
+# restart mid-soak recovers warm TTFT from the durable prefix store
+# (measured warm-vs-cold delta in the artifact).
+
+
+def _hk_collect(request) -> tuple[list, object]:
+    tokens = []
+    while True:
+        kind, value = request.out.get(timeout=300)
+        if kind == "token":
+            tokens.append(value)
+        elif kind == "done":
+            return tokens, value
+        else:
+            raise RuntimeError(f"request failed: {value}")
+
+
+def _hk_prompt(session: int, turn: int, args) -> str:
+    """Deterministic sticky-session history: a session-specific base
+    plus one filler block per completed turn — turn t's prompt extends
+    turn t-1's, which is exactly what keeps the prefix cache (and the
+    host tier behind it) warm across turns."""
+    rng = np.random.default_rng(1000 + session)
+    base = "".join(chr(c) for c in rng.integers(97, 123, args.hk_base))
+    blocks = []
+    for t in range(turn):
+        rng_t = np.random.default_rng(7000 + session * 131 + t)
+        blocks.append("".join(
+            chr(c) for c in rng_t.integers(97, 123, args.hk_turn_tokens)
+        ))
+    return base + "".join(blocks)
+
+
+def _hk_run_turns(engine, jobs, max_new, concurrency=3):
+    """Run (session, turn) jobs in bounded-concurrency waves; returns
+    {job: tokens}. Greedy streams are batch-independent, so the wave
+    shape cannot change any stream's content — only the schedule."""
+    from polykey_tpu.engine.engine import GenRequest
+
+    out = {}
+    jobs = list(jobs)
+    for lo in range(0, len(jobs), concurrency):
+        wave = jobs[lo:lo + concurrency]
+        requests = []
+        for (s, t, prompt) in wave:
+            r = GenRequest(prompt=prompt, max_new_tokens=max_new)
+            engine.submit(r)
+            requests.append(((s, t), r))
+        for key, r in requests:
+            tokens, _ = _hk_collect(r)
+            out[key] = tokens
+    return out
+
+
+def run_hostkv_main(args) -> int:
+    import dataclasses
+    import shutil
+    import tempfile
+
+    from polykey_tpu.engine.config import EngineConfig
+    from polykey_tpu.engine.engine import GenRequest, InferenceEngine
+    from polykey_tpu.engine.roofline import CHIP_SPECS, grade
+    from polykey_tpu.engine.supervisor import EngineSupervisor
+
+    page_size = 16
+    max_new = 16
+    S, T = args.hk_sessions, args.hk_turns
+    final_len = args.hk_base + T * args.hk_turn_tokens
+    pages_per_session = -(-(final_len + max_new) // page_size)
+    aggregate_pages = S * pages_per_session
+    # Device pool sized so the sticky working set OVERSUBSCRIBES it by
+    # ~1.6x while a 3-wide turn wave still fits with slack.
+    num_pages = max(
+        int(aggregate_pages / 1.6) + 1, 3 * pages_per_session + 12,
+    )
+    footprint_ratio = aggregate_pages / (num_pages - 1)
+    max_seq = -(-(final_len + max_new + page_size) // page_size) * page_size
+
+    state_dir = tempfile.mkdtemp(prefix="polykey-hostkv-soak-")
+    cfg = EngineConfig(
+        model=args.model, dtype="float32", kv_dtype=args.kv_dtype,
+        max_decode_slots=args.slots, page_size=page_size,
+        num_pages=num_pages, max_seq_len=max_seq,
+        prefill_buckets=(32, 64), prefill_chunk=64,
+        max_new_tokens_cap=max_new, decode_block_steps=args.block,
+        lookahead_blocks=2, compile_warmup=False, max_queue_depth=0,
+        supervise=False,
+        prefix_cache=True, prefix_cache_pages=8192,
+        host_kv_bytes=256 << 20,
+        host_kv_resident_pages=num_pages // 2,
+        kv_state_dir=state_dir,
+    )
+    log(f"host-kv soak: {S} sessions x {T} turns, final history "
+        f"{final_len} tok, aggregate {aggregate_pages} pages vs device "
+        f"pool {num_pages - 1} (ratio {footprint_ratio:.2f}), state dir "
+        f"{state_dir}")
+
+    jobs_by_round = [
+        [(s, t, _hk_prompt(s, t, args)) for s in range(S)]
+        for t in range(1, T + 1)
+    ]
+    # Restart after this round; needs a round before AND after it —
+    # with a single turn there is no "next turn" to measure warm TTFT
+    # on, so the restart leg (and its gates) is skipped, loudly.
+    restart_round = T // 2 if T >= 2 else None
+    if restart_round is None:
+        log("WARNING: --hk-turns < 2 — restart/warm-TTFT leg skipped "
+            "(no post-restart turn exists to measure)")
+
+    failures = 0
+    t_start = time.monotonic()
+    factory = lambda: InferenceEngine(cfg, seed=args.seed)  # noqa: E731
+    engine = factory()
+    sup = EngineSupervisor(
+        engine, factory, max_restarts=3, check_interval_s=0.1,
+    ).start()
+    streams = {}
+    warm_ttfts, cold_ttfts = [], []
+    restart_recovery_s = None
+    kv_reloaded = 0
+    try:
+        measured_round = None
+        for round_idx, jobs in enumerate(jobs_by_round, start=1):
+            if round_idx == measured_round:
+                continue   # consumed by the post-restart measurement
+            streams.update(_hk_run_turns(sup.engine, jobs, max_new))
+            if round_idx == restart_round:
+                # --- supervised restart mid-soak: quiesced crash (the
+                # bare supervisor's recovery unit is the engine; the
+                # PR 7 pool owns mid-stream resume) → fresh engine via
+                # the factory → durable prefix reload → warm turns.
+                log(f"injecting engine crash after round {round_idx} ...")
+                old = sup.engine
+                t_kill = time.monotonic()
+                old.dead = "host-kv soak: injected crash"
+                deadline = time.monotonic() + 120
+                while sup.engine is old:
+                    if time.monotonic() > deadline:
+                        raise RuntimeError("supervisor never restarted")
+                    time.sleep(0.05)
+                restart_recovery_s = time.monotonic() - t_kill
+                engine = sup.engine
+                kv_reloaded = engine._kv_reloaded_pages
+                log(f"restarted in {restart_recovery_s:.1f}s, reloaded "
+                    f"{kv_reloaded} durable pages")
+                # Throwaway pair absorbs post-restart compiles so the
+                # measured warm/cold medians compare page-fault restore
+                # vs cold recompute, not XLA compile time.
+                for prompt in (_hk_prompt(S + 7, restart_round, args),
+                               _hk_prompt(0, restart_round, args)):
+                    r = GenRequest(prompt=prompt, max_new_tokens=max_new)
+                    engine.submit(r)
+                    _hk_collect(r)
+                # Warm TTFT: the NEXT turn of each sticky session —
+                # history pages fault back from the reloaded host tier
+                # instead of recomputing. Sequential, so ttft ≈ prefill.
+                measured_round = restart_round + 1
+                next_jobs = jobs_by_round[restart_round]
+                for (s, t, prompt) in next_jobs:
+                    r = GenRequest(prompt=prompt, max_new_tokens=max_new)
+                    engine.submit(r)
+                    tokens, timings = _hk_collect(r)
+                    streams[(s, t)] = tokens
+                    warm_ttfts.append(timings.ttft_ms)
+                # Cold TTFT: brand-new sessions of the same length.
+                for c in range(len(next_jobs)):
+                    r = GenRequest(
+                        prompt=_hk_prompt(S + 100 + c, restart_round + 1,
+                                          args),
+                        max_new_tokens=max_new,
+                    )
+                    engine.submit(r)
+                    _, timings = _hk_collect(r)
+                    cold_ttfts.append(timings.ttft_ms)
+        stats = sup.engine.stats()
+        hist = sup.engine.metrics.kv_restore_hist
+        counts, hist_sum = hist.counts_snapshot()
+    except RuntimeError as e:
+        log(f"FAIL: {e}")
+        failures += 1
+        stats = sup.engine.stats()
+        counts, hist_sum = [], 0.0
+        hist = None
+    finally:
+        sup.stop()
+        sup.engine.shutdown()
+
+    # --- all-device reference: huge pool, host tier off, same prompts.
+    log("=== all-device reference run ===")
+    ref_cfg = dataclasses.replace(
+        cfg, num_pages=aggregate_pages * 2 + 64, host_kv_bytes=0,
+        host_kv_resident_pages=0, kv_state_dir="",
+    )
+    ref_engine = InferenceEngine(ref_cfg, seed=args.seed)
+    try:
+        ref_streams = {}
+        for jobs in jobs_by_round:
+            ref_streams.update(_hk_run_turns(ref_engine, jobs, max_new))
+    finally:
+        ref_engine.shutdown()
+    shutil.rmtree(state_dir, ignore_errors=True)
+
+    # The restart round's streams were re-measured on the fresh engine;
+    # every (session, turn) key must match the uninterrupted reference.
+    mismatched = sorted(
+        key for key in ref_streams if streams.get(key) != ref_streams[key]
+    )
+    bit_identical = not mismatched and len(streams) >= len(ref_streams)
+
+    warm_p50 = float(np.median(warm_ttfts)) if warm_ttfts else None
+    cold_p50 = float(np.median(cold_ttfts)) if cold_ttfts else None
+    faults = (stats["kv_page_faults_prefix"], stats["kv_page_faults_ctx"])
+    # Projected capacity grade: hbm_weight_fraction against the v5e
+    # spec sheet — what fraction of a real chip's HBM the weights would
+    # pin, i.e. the budget this tier's host pages no longer compete for.
+    roof = grade(
+        model=args.model, dtype="float32", quantize=False, quantize_bits=8,
+        kv_dtype=args.kv_dtype, tok_s=0.0, avg_lanes=None,
+        avg_ctx=final_len, chip=CHIP_SPECS["tpu-v5e"],
+    )
+    # The north-star capacity statement: at llama-3-8b int8 on a 16 GiB
+    # v5e, weights pin this fraction of HBM — the complement is the
+    # device KV budget the host tier stops being the hard ceiling for.
+    roof_8b = grade(
+        model="llama-3-8b", dtype="bfloat16", quantize=True,
+        quantize_bits=8, kv_dtype="int8", tok_s=0.0, avg_lanes=None,
+        avg_ctx=4096, chip=CHIP_SPECS["tpu-v5e"],
+    )
+    result = {
+        "mode": "host_kv",
+        "config": {
+            "model": args.model, "kv_dtype": args.kv_dtype or "fp",
+            "slots": args.slots, "page_size": page_size,
+            "num_pages": num_pages, "max_seq_len": max_seq,
+            "sessions": S, "turns": T, "final_history_tokens": final_len,
+            "host_kv_bytes": cfg.host_kv_bytes,
+            "resident_floor_pages": cfg.host_kv_resident_pages,
+            "seed": args.seed,
+        },
+        "window_s": round(time.monotonic() - t_start, 1),
+        "aggregate_kv_pages": aggregate_pages,
+        "device_pool_pages": num_pages - 1,
+        "kv_footprint_ratio": round(footprint_ratio, 3),
+        "requests": len(streams),
+        "failed_rpcs": failures,
+        "bit_identical_to_all_device": bit_identical,
+        "mismatched_streams": mismatched[:8],
+        "kv_page_faults": {"prefix": faults[0], "ctx": faults[1]},
+        "kv_pages_evicted": stats["kv_pages_evicted"],
+        "kv_pages_restored": stats["kv_pages_restored"],
+        "kv_restore_ms_p50": stats.get("kv_restore_ms_p50"),
+        "kv_restore_ms_p95": stats.get("kv_restore_ms_p95"),
+        "cold_page_fault_hist": {
+            "bounds": list(hist.bounds) if hist is not None else [],
+            "counts": list(counts),
+            "sum_ms": round(float(hist_sum), 3),
+        },
+        "restart": {
+            "after_round": restart_round,
+            "recovery_s": (round(restart_recovery_s, 2)
+                           if restart_recovery_s else None),
+            "kv_reloaded_pages": kv_reloaded,
+            "warm_ttft_ms_p50": (round(warm_p50, 2)
+                                 if warm_p50 is not None else None),
+            "cold_ttft_ms_p50": (round(cold_p50, 2)
+                                 if cold_p50 is not None else None),
+            "warm_vs_cold_delta_ms": (
+                round(cold_p50 - warm_p50, 2)
+                if warm_p50 is not None and cold_p50 is not None else None
+            ),
+        },
+        "roofline": {
+            "chip": "tpu-v5e (projected; CPU run)",
+            "hbm_weight_fraction": roof.get("hbm_weight_fraction"),
+            "hbm_weight_fraction_8b_int8": roof_8b.get(
+                "hbm_weight_fraction"),
+        },
+        "platform": jax.devices()[0].platform,
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+
+    out_path = args.out or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "perf",
+        f"hostkv_soak_{time.strftime('%Y-%m-%d', time.gmtime())}.json",
+    )
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+        f.write("\n")
+    log(f"wrote {out_path}")
+    print(json.dumps(result))
+
+    ok = True
+    if failures:
+        log(f"FAIL: {failures} requests errored")
+        ok = False
+    if not bit_identical:
+        log(f"FAIL: {len(mismatched)} streams differ from the "
+            f"all-device reference (first: {mismatched[:3]})")
+        ok = False
+    if footprint_ratio < args.min_footprint:
+        log(f"FAIL: footprint ratio {footprint_ratio:.2f} < "
+            f"{args.min_footprint}")
+        ok = False
+    if sum(faults) == 0 or stats["kv_pages_restored"] == 0:
+        log("FAIL: the soak never faulted/restored a host page — the "
+            "tier was not exercised")
+        ok = False
+    if restart_round is not None:
+        if kv_reloaded == 0:
+            log("FAIL: the restart reloaded nothing from the durable "
+                "store")
+            ok = False
+        if warm_p50 is None or cold_p50 is None or warm_p50 >= cold_p50:
+            log(f"FAIL: post-restart warm TTFT {warm_p50} ms did not "
+                f"beat cold {cold_p50} ms")
+            ok = False
+    if ok:
+        tail = (
+            f"restart recovered warm TTFT {warm_p50:.0f} ms vs cold "
+            f"{cold_p50:.0f} ms ({kv_reloaded} pages reloaded)"
+            if restart_round is not None else "(restart leg skipped)"
+        )
+        log(f"OK: {len(streams)} sticky turns bit-identical at "
+            f"{footprint_ratio:.2f}x device pool; {tail}")
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":
