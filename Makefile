@@ -12,7 +12,8 @@ BUILD_DIR := build
 	obs-smoke chaos-smoke print-chaos occupancy-smoke occupancy-soak \
 	failover-smoke failover-soak timeline-capture perf-gate \
 	perf-gate-reference flightwatch ragged-smoke ragged-soak \
-	disagg-smoke disagg-soak hostkv-smoke hostkv-soak
+	disagg-smoke disagg-soak hostkv-smoke hostkv-soak \
+	postmortem postmortem-smoke
 
 help: ## Show available targets
 	@grep -E '^[a-zA-Z_-]+:.*?## .*$$' $(MAKEFILE_LIST) | \
@@ -176,6 +177,24 @@ disagg-smoke: ## Kill-workers drill at CI scale + lock-witness zero-cycle gate
 	$(PYTHON) -m polykey_tpu.analysis race --only CL001 \
 	  --witness /tmp/polykey-lock-witness
 
+# Cross-process black boxes (ISSUE 16): reconstruct the last seconds
+# before any member death from the checkpoints in a disagg state dir —
+# triage report + ONE merged clock-aligned Perfetto file.
+#   make postmortem STATE_DIR=/tmp/polykey-disagg-xyz
+postmortem: ## Triage a disagg state dir's black boxes (STATE_DIR=...)
+	@test -n "$(STATE_DIR)" || { \
+	  echo "usage: make postmortem STATE_DIR=<disagg state dir>"; exit 2; }
+	$(PYTHON) -m polykey_tpu.obs.postmortem $(STATE_DIR)
+
+# The crash-durability drill: SIGKILL a decode worker PROCESS
+# mid-stream (os._exit flushes nothing), then require the surviving
+# black boxes to reconstruct the death — fatal trace id in the dead
+# incarnation's ring, triage report names it, merged Perfetto rows for
+# every member. The victim stream itself must still finish (respawn +
+# re-route), so the drill also re-pins the recovery path.
+postmortem-smoke: ## Kill a decode worker mid-stream; black boxes must reconstruct the death
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/postmortem_smoke.py
+
 disagg-soak: ## The 2x2-worker / 30 s acceptance drill (writes perf/)
 	rm -rf /tmp/polykey-lock-witness
 	JAX_PLATFORMS=cpu POLYKEY_LOCK_WITNESS=1 \
@@ -279,13 +298,14 @@ scan: ## Security scan (Trivy fs over the tree + lockfile, CRITICAL/HIGH gate)
 	  --scanners vuln,secret \
 	  --severity CRITICAL,HIGH
 
-ci-check: ## Run the CI pipeline locally: lint+polylint+racelint+graphlint, chaos, failover, disagg(+lock-witness gate), occupancy, ragged, hostkv, obs, perf-gate, tests, native(+asan), scan
+ci-check: ## Run the CI pipeline locally: lint+polylint+racelint+graphlint, chaos, failover, disagg(+lock-witness gate), postmortem, occupancy, ragged, hostkv, obs, perf-gate, tests, native(+asan), scan
 	@$(MAKE) lint
 	@$(MAKE) racelint
 	@$(MAKE) graphlint
 	@$(MAKE) chaos-smoke
 	@$(MAKE) failover-smoke
 	@$(MAKE) disagg-smoke
+	@$(MAKE) postmortem-smoke
 	@$(MAKE) occupancy-smoke
 	@$(MAKE) ragged-smoke
 	@$(MAKE) hostkv-smoke

@@ -243,6 +243,14 @@ class EngineConfig:
     # obs-less deployment pays nothing. POLYKEY_TIMELINE_CAPACITY.
     timeline_capacity: int = 4096
 
+    # Black-box checkpoint cadence (ISSUE 16, obs/postmortem.py): a
+    # disagg member with a state dir flushes its timeline +
+    # flight-recorder rings to `blackbox-<role>.json` every this many
+    # timeline appends (plus forced flushes at control-plane op intake
+    # and on the supervisor trip path). 0 DISABLES black boxes even
+    # when a state dir exists. POLYKEY_BLACKBOX_EVERY.
+    blackbox_every: int = 64
+
     # SLO signal plane (ISSUE 11, obs/signals.py): seconds between ring
     # samples of the metrics registry — monotone counters become
     # windowed rates, cumulative histograms become delta-quantiles over
@@ -472,6 +480,9 @@ class EngineConfig:
             timeline_capacity=_env_int(
                 "POLYKEY_TIMELINE_CAPACITY", cls.timeline_capacity
             ),
+            blackbox_every=_env_int(
+                "POLYKEY_BLACKBOX_EVERY", cls.blackbox_every
+            ),
             signals_interval_s=_env_float(
                 "POLYKEY_SIGNALS_INTERVAL", cls.signals_interval_s
             ),
@@ -643,6 +654,10 @@ class EngineConfig:
         if self.timeline_capacity < 0:
             raise ValueError(
                 "timeline_capacity must be >= 0 (0 disables the ring)"
+            )
+        if self.blackbox_every < 0:
+            raise ValueError(
+                "blackbox_every must be >= 0 (0 disables black boxes)"
             )
         if self.signals_interval_s < 0:
             raise ValueError(

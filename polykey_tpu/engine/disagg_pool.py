@@ -54,13 +54,17 @@ import sys
 import threading
 import time
 import uuid
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 import numpy as np
 
-from ..obs.histogram import Histogram
-from ..obs.timeline import TimelineRecorder
+from ..obs.clocks import ClockSync
+from ..obs.histogram import Histogram, estimate_quantile
+from ..obs.postmortem import BlackBox
+from ..obs.signals import window_label, windows_from_spec
+from ..obs.timeline import TimelineRecorder, merge_timelines, to_perfetto
 from .config import EngineConfig
 from .engine import EngineDeadError, EngineOverloadedError, GenRequest
 from .kv_cache import KVWireError, validate_kv_blob
@@ -119,10 +123,21 @@ class _Worker:
     ping: dict = field(default_factory=dict)
     stats: dict = field(default_factory=dict)
     bw_ewma: float = 0.0          # measured ship bandwidth, bytes/s
+    # Clock alignment (ISSUE 16): maps this worker's monotonic clock
+    # onto the coordinator's; fed by the heartbeat's ping samples and
+    # reset when the worker's pid changes (new process = new epoch).
+    clock: ClockSync = field(default_factory=ClockSync)
+    last_pid: Optional[int] = None
 
     @property
     def name(self) -> str:
         return f"{self.tier}/{self.index}"
+
+    @property
+    def role(self) -> str:
+        """Black-box / clock-offset key: matches the worker-side
+        blackbox-<tier>-<replica>.json file name."""
+        return f"{self.tier}-{self.index}"
 
 
 class DisaggPool:
@@ -161,6 +176,26 @@ class DisaggPool:
         )
         self.requests_rerouted = 0
         self.streams_resumed = 0
+        # Cross-tier signal windows (ISSUE 16): a bounded ring of
+        # heartbeat-cadence samples of the pool's handoff counters, so
+        # signals_snapshot() can answer with WINDOWED wire bandwidth,
+        # handoff-latency delta-quantiles, and per-tier fault/restore
+        # rates — the autopilot's read API for tier scaling, and the
+        # observable counterpart of the NetKV bandwidth EWMA.
+        self.tier_faults = {PREFILL: 0, DECODE: 0}
+        self.tier_restores = {PREFILL: 0, DECODE: 0}
+        self._signal_windows = windows_from_spec(config.signals_windows)
+        interval = max(0.05, config.disagg_heartbeat_s)
+        self._signal_ring: deque = deque(maxlen=min(
+            8192, int(self._signal_windows[-1] / interval) + 2
+        ))
+        # Boot baseline: handoffs that land before the heartbeat's first
+        # cadence sample must still show up as window deltas.
+        self._sample_signals()
+        # Coordinator black box (obs/postmortem.py): created by
+        # create() when the pool has a state dir; carries the clock
+        # offsets a postmortem needs to merge the workers' rings.
+        self.blackbox: Optional[BlackBox] = None
         # Session stickiness (stage (c)): session key → worker index,
         # per tier. Prefill stickiness lands multi-turn users on their
         # warm prefix; decode stickiness amortizes the router's
@@ -204,6 +239,13 @@ class DisaggPool:
         pool._state_dir = state_dir
         pool._ready_timeout_s = ready_timeout_s
         pool._restart_cb = restart_cb
+        if state_dir and config.blackbox_every > 0:
+            pool.blackbox = BlackBox(
+                state_dir, "coordinator",
+                timeline=pool.timeline, recorder=recorder,
+                every=config.blackbox_every,
+                meta={"tier": "coordinator"},
+            )
         if workers is not None:
             counts: dict[str, int] = {}
             for tier, addr in workers:
@@ -335,10 +377,13 @@ class DisaggPool:
         elsewhere stays there)."""
         try:
             with WorkerConn(worker.addr, timeout=5.0) as conn:
+                t_send = time.monotonic()
                 reply, _ = conn.request({"op": "ping"}, timeout=5.0)
+                t_recv = time.monotonic()
         except (OSError, ConnectionError, ValueError):
             return
         worker.ping = reply
+        self._sync_clock(worker, reply, t_send, t_recv)
         sticky = self._sticky[worker.tier]
         with self._lock:
             for key in reply.get("warm_sessions", ()):
@@ -397,6 +442,9 @@ class DisaggPool:
         with self._lock:
             if worker.state != DRAINING:
                 return
+            self.tier_faults[worker.tier] = (
+                self.tier_faults.get(worker.tier, 0) + 1
+            )
             now = time.monotonic()
             worker.restart_times = [
                 t for t in worker.restart_times
@@ -460,6 +508,10 @@ class DisaggPool:
             return
         worker.misses = 0
         worker.restarts += 1
+        with self._lock:
+            self.tier_restores[worker.tier] = (
+                self.tier_restores.get(worker.tier, 0) + 1
+            )
         self._absorb_warm_sessions(worker)   # rejoin warm (persisted index)
         self._transition(worker, SERVING, only_from=(RESTARTING,))
 
@@ -474,10 +526,16 @@ class DisaggPool:
                     continue
                 try:
                     with WorkerConn(worker.addr, timeout=interval) as conn:
+                        t_send = time.monotonic()
                         reply, _ = conn.request({"op": "ping"},
                                                 timeout=interval)
+                        t_recv = time.monotonic()
                     worker.ping = reply
                     worker.misses = 0
+                    # Clock re-estimation rides every heartbeat: the
+                    # drift-aged best-sample filter in ClockSync keeps
+                    # the offset's uncertainty near RTT/2 forever.
+                    self._sync_clock(worker, reply, t_send, t_recv)
                     if reply.get("state") == "DEAD":
                         self._transition(worker, DEAD)
                     elif reply.get("state") == "SERVING":
@@ -487,6 +545,26 @@ class DisaggPool:
                     worker.misses += 1
                     if worker.misses >= self.config.disagg_miss:
                         self._on_worker_down(worker, "heartbeat missed")
+            self._sample_signals()
+            if self.blackbox is not None:
+                # The coordinator's box carries the clock offsets a
+                # postmortem needs to merge worker rings — refresh them
+                # right before the checkpoint.
+                self.blackbox.meta["clock_offsets"] = self.clock_offsets()
+                self.blackbox.tick(force=True)
+
+    def _sync_clock(self, worker: _Worker, reply: dict,
+                    t_send: float, t_recv: float) -> None:
+        pid = reply.get("pid")
+        if pid is not None and pid != worker.last_pid:
+            if worker.last_pid is not None:
+                # New process, new monotonic epoch: the old offset is
+                # meaningless and must not age gracefully.
+                worker.clock.reset()
+            worker.last_pid = pid
+        mono = reply.get("mono")
+        if isinstance(mono, (int, float)):
+            worker.clock.update(t_send, t_recv, float(mono))
 
     # -- engine-shaped surface ------------------------------------------------
 
@@ -546,6 +624,11 @@ class DisaggPool:
     def shutdown(self, timeout: float = 10.0) -> None:
         self._closing = True
         self._stop_heartbeat.set()
+        if self.blackbox is not None:
+            # Final checkpoint with fresh offsets: a postmortem over a
+            # cleanly-stopped pool should still merge.
+            self.blackbox.meta["clock_offsets"] = self.clock_offsets()
+            self.blackbox.tick(force=True)
         if self._heartbeat_thread is not None:
             self._heartbeat_thread.join(timeout=2.0)
         for worker in self.workers:
@@ -718,7 +801,8 @@ class DisaggPool:
                 if self.timeline is not None:
                     self.timeline.note(
                         "handoff_abort", phase=e.phase, cause=str(e),
-                        reroutes=reroutes,
+                        reroutes=reroutes, handoff_id=handoff_id,
+                        trace=self._trace_id(request),
                     )
                 if self.recorder is not None:
                     self.recorder.event(
@@ -764,6 +848,10 @@ class DisaggPool:
             return None
         return max(0.0, request.deadline - time.monotonic())
 
+    @staticmethod
+    def _trace_id(request: GenRequest) -> Optional[str]:
+        return request.trace.trace_id if request.trace is not None else None
+
     def _req_dict(self, request: GenRequest) -> dict:
         return {
             "prompt": request.prompt,
@@ -773,7 +861,40 @@ class DisaggPool:
             "top_k": request.top_k,
             "seed": request.seed,
             "deadline_in_s": self._deadline_in_s(request),
+            # Trace propagation (ISSUE 16): the gateway's x-trace-id
+            # rides every control-plane op so worker-side spans and
+            # timeline notes join the same distributed trace.
+            "trace_id": self._trace_id(request),
         }
+
+    def _graft_worker_trace(self, request: GenRequest, worker: _Worker,
+                            wire: Optional[dict]) -> None:
+        """Attach a worker's shipped span tree (absolute monotonic
+        start/end on ITS clock) under the gateway root, re-timed onto
+        the coordinator clock via the worker's heartbeat offset. Skipped
+        when no offset has landed yet — an unaligned subtree would
+        mis-order the root's children."""
+        if wire is None or request.trace is None:
+            return
+        offset = worker.clock.offset
+        if offset is None:
+            return
+        self._graft_node(request.trace, wire, offset, worker=worker.name)
+
+    def _graft_node(self, parent, wire: dict, offset: float,
+                    **extra) -> None:
+        start = wire.get("start")
+        end = wire.get("end")
+        child = parent.child(
+            str(wire.get("name", "span")),
+            start=(start + offset
+                   if isinstance(start, (int, float)) else None),
+            end=(end + offset if isinstance(end, (int, float)) else None),
+            **{**(wire.get("attrs") or {}), **extra},
+        )
+        for sub in wire.get("children") or ():
+            if isinstance(sub, dict):
+                self._graft_node(child, sub, offset)
 
     def _run_prefill(self, worker: _Worker, request: GenRequest,
                      handoff_id: str, skey: str) -> tuple:
@@ -785,6 +906,7 @@ class DisaggPool:
             self.timeline.note(
                 "handoff_start", worker=worker.name,
                 handoff_id=handoff_id, session=skey,
+                trace=self._trace_id(request),
             )
         try:
             with WorkerConn(worker.addr, timeout=30.0) as conn:
@@ -802,6 +924,8 @@ class DisaggPool:
                             event.get("prompt_tokens", 0)
                         )
                     elif kind == "done":
+                        self._graft_worker_trace(request, worker,
+                                                 event.get("trace"))
                         break
                     elif kind == "error":
                         if event.get("shed"):
@@ -829,10 +953,18 @@ class DisaggPool:
                 if not meta:
                     raise _HandoffRetry("prefill produced no handoff",
                                         "prefill", restart_prefill=True)
+                t_fetch = time.monotonic()
                 reply, blob = conn.request(
                     {"op": "fetch", "handoff_id": handoff_id},
                     timeout=timeout,
                 )
+                if request.trace is not None and reply.get("ok"):
+                    # Wire hop 1 of the handoff: prefill → coordinator.
+                    request.trace.child(
+                        "handoff_fetch", start=t_fetch,
+                        end=time.monotonic(), bytes=len(blob),
+                        worker=worker.name, handoff_id=handoff_id,
+                    )
                 if not reply.get("ok"):
                     raise _HandoffRetry(
                         reply.get("error", "fetch failed"), "handoff",
@@ -872,9 +1004,10 @@ class DisaggPool:
         seen = 0
         try:
             with WorkerConn(worker.addr, timeout=30.0) as conn:
+                req = self._req_dict(request)
+                req["handoff_id"] = meta.get("handoff_id")
                 t_ship = time.monotonic()
-                conn.send({"op": "decode", "req": self._req_dict(request)},
-                          blob)
+                conn.send({"op": "decode", "req": req}, blob)
                 timeout = self.config.request_timeout_s
                 event, _ = conn.recv(timeout=timeout)
                 if event.get("event") != "accepted":
@@ -900,20 +1033,37 @@ class DisaggPool:
                                             restart_prefill=False)
                     request.out.put(("error", message))
                     raise _Terminal()
-                ship_s = max(1e-6, time.monotonic() - t_ship)
+                t_accepted = time.monotonic()
+                ship_s = max(1e-6, t_accepted - t_ship)
                 measured = len(blob) / ship_s
                 worker.bw_ewma = (
                     measured if worker.bw_ewma == 0.0
                     else 0.7 * worker.bw_ewma + 0.3 * measured
                 )
+                # Exemplar (ISSUE 16 satellite): the handoff-latency
+                # bucket this observation lands in links back to the
+                # request's span tree on an OpenMetrics scrape.
                 self.handoff_ms.observe(
-                    (time.monotonic() - t_handoff) * 1e3
+                    (t_accepted - t_handoff) * 1e3,
+                    trace_id=self._trace_id(request),
                 )
+                if request.trace is not None:
+                    # Wire hop 2: coordinator → decode worker, ending
+                    # when the worker accepted (deserialize included —
+                    # its split ships back in the accepted frame and the
+                    # worker's own tree carries the exact child).
+                    request.trace.child(
+                        "handoff_ship", start=t_ship, end=t_accepted,
+                        bytes=len(blob), worker=worker.name,
+                        deserialize_ms=event.get("deserialize_ms"),
+                    )
                 if self.timeline is not None:
                     self.timeline.note(
                         "handoff_ack", worker=worker.name,
                         bytes=len(blob),
                         ship_ms=round(ship_s * 1e3, 3),
+                        handoff_id=meta.get("handoff_id"),
+                        trace=self._trace_id(request),
                     )
                 request.replica = worker.index
                 request.tier = (
@@ -945,6 +1095,8 @@ class DisaggPool:
                         timings.device_ms += float(
                             remote.get("device_ms", 0.0) or 0.0
                         )
+                        self._graft_worker_trace(request, worker,
+                                                 event.get("trace"))
                         request.out.put(("done", timings))
                         return delivered
                     elif kind == "error":
@@ -1040,10 +1192,166 @@ class DisaggPool:
         agg["handoff_ms_p50"] = round(self.handoff_ms.percentile(50), 2)
         agg["handoff_ms_p95"] = round(self.handoff_ms.percentile(95), 2)
         agg["per_worker"] = per
+        agg["tier_faults"] = dict(self.tier_faults)
+        agg["tier_restores"] = dict(self.tier_restores)
+        agg["clock_offsets"] = self.clock_offsets()
         with self._lock:
             self._stats_cache = agg
             self._stats_cache_t = now
         return agg
+
+    # -- cross-process flight deck (ISSUE 16) ---------------------------------
+
+    def clock_offsets(self) -> dict:
+        """Per-worker ClockSync snapshots, keyed by black-box role —
+        the merge key shared by live merged_timelines() and the
+        postmortem's offline merge."""
+        return {w.role: w.clock.snapshot() for w in list(self.workers)}
+
+    def handoff_now(self) -> dict:
+        """Instantaneous handoff signals: the per-decode-worker ship
+        bandwidth EWMA the NetKV router scores on — flightwatch's
+        HANDOFF row reads this next to the windowed deltas."""
+        return {
+            "wire_bw_ewma_bytes_per_s": {
+                w.role: round(w.bw_ewma, 1)
+                for w in list(self.workers)
+                if w.tier == DECODE and w.bw_ewma > 0.0
+            },
+        }
+
+    def _sample_signals(self) -> None:
+        """One heartbeat-cadence sample of the pool's handoff counters.
+        The ring stores ABSOLUTE counters; signal_windows() diffs two
+        samples into per-window deltas — same discipline as the
+        engine-side SignalPlane, so quantiles are over the window, not
+        since boot."""
+        counts, hsum = self.handoff_ms.counts_snapshot()
+        with self._lock:
+            self._signal_ring.append((
+                time.monotonic(), counts, hsum, self.handoff_bytes,
+                dict(self.handoffs), dict(self.tier_faults),
+                dict(self.tier_restores),
+            ))
+
+    def signal_windows(self) -> dict:
+        """Windowed cross-tier handoff signals — the autopilot read API
+        for tier scaling. Per configured window: handoff outcome deltas,
+        wire bandwidth (handoff bytes over covered wall time), handoff
+        latency delta-quantiles, and per-tier fault/restore rates."""
+        with self._lock:
+            ring = list(self._signal_ring)
+        if len(ring) < 2:
+            return {}
+        now_t, now_counts, _, now_bytes, now_outcomes, now_faults, \
+            now_restores = ring[-1]
+        out: dict = {}
+        for window in self._signal_windows:
+            base = ring[0]
+            # Oldest-first fallback: a young pool reports what it has,
+            # with covered_s telling the truth about how much that is.
+            for sample in reversed(ring[:-1]):
+                if now_t - sample[0] >= window:
+                    base = sample
+                    break
+            (base_t, base_counts, _, base_bytes, base_outcomes,
+             base_faults, base_restores) = base
+            covered = now_t - base_t
+            if covered <= 0:
+                continue
+            delta_counts = [
+                max(0, n - b) for n, b in zip(now_counts, base_counts)
+            ]
+            n = sum(delta_counts)
+            bytes_delta = max(0, now_bytes - base_bytes)
+            faults = {
+                tier: max(0, now_faults.get(tier, 0)
+                          - base_faults.get(tier, 0))
+                for tier in (PREFILL, DECODE)
+            }
+            out[window_label(window)] = {
+                "covered_s": round(covered, 3),
+                "handoffs": {
+                    outcome: max(0, now_outcomes.get(outcome, 0)
+                                 - base_outcomes.get(outcome, 0))
+                    for outcome in _OUTCOMES
+                },
+                "handoff_bytes": bytes_delta,
+                "wire_bandwidth_bytes_per_s": round(
+                    bytes_delta / covered, 1),
+                "handoff_ms_count": n,
+                "handoff_ms_p50": round(estimate_quantile(
+                    self.handoff_ms.bounds, delta_counts, n, 50), 2),
+                "handoff_ms_p95": round(estimate_quantile(
+                    self.handoff_ms.bounds, delta_counts, n, 95), 2),
+                "tier_faults": faults,
+                "tier_restores": {
+                    tier: max(0, now_restores.get(tier, 0)
+                              - base_restores.get(tier, 0))
+                    for tier in (PREFILL, DECODE)
+                },
+                "fault_rate_per_min": round(
+                    sum(faults.values()) * 60.0 / covered, 3),
+            }
+        return out
+
+    def worker_timeline(self, worker: _Worker) -> Optional[list]:
+        """Fetch one worker's live timeline ring over the control
+        plane; None when the worker is unreachable (the caller falls
+        back to its black-box file)."""
+        if worker.addr is None:
+            return None
+        try:
+            with WorkerConn(worker.addr, timeout=3.0) as conn:
+                reply, _ = conn.request({"op": "timeline"}, timeout=3.0)
+        except (OSError, ConnectionError, ValueError):
+            return None
+        if not reply.get("ok"):
+            return None
+        return reply.get("events") or []
+
+    def merged_timelines(self) -> list:
+        """The clock-aligned merged timeline: one (pid, label, events)
+        group per process — the coordinator's own ring at offset 0 plus
+        every worker's ring mapped onto the coordinator's clock by its
+        ClockSync offset. Dead workers contribute their last black-box
+        checkpoint, so a merge after a crash still shows the victim's
+        final seconds."""
+        groups: list = []
+        if self.timeline is not None:
+            groups.append((0, "coordinator",
+                           self.timeline.events() or [], 0.0))
+        state_dir = getattr(self, "_state_dir", None)
+        for pid, worker in enumerate(list(self.workers), start=1):
+            events = self.worker_timeline(worker)
+            if events is None and state_dir:
+                events = _blackbox_timeline(state_dir, worker.role)
+            if not events:
+                continue
+            groups.append((pid, worker.role, events,
+                           worker.clock.offset or 0.0))
+        return merge_timelines(groups)
+
+    def merged_perfetto(self) -> dict:
+        """ONE Perfetto trace for the whole pool: one process row per
+        worker plus the coordinator, all on the coordinator's clock, so
+        a handoff renders as a single causally-ordered arc from the
+        prefill worker's serialize end to the decode worker's scatter
+        start."""
+        return to_perfetto(
+            self.merged_timelines(),
+            meta={"clock_offsets": self.clock_offsets()},
+        )
+
+
+def _blackbox_timeline(state_dir: str, role: str) -> Optional[list]:
+    """Last-checkpoint fallback for a dead worker's timeline."""
+    from ..obs.postmortem import blackbox_path
+    try:
+        with open(blackbox_path(state_dir, role), encoding="utf-8") as f:
+            return json.load(f).get("timeline") or []
+    except (OSError, ValueError):
+        return None
 
 
 class _Terminal(Exception):
@@ -1094,6 +1402,7 @@ def _config_env(config: EngineConfig) -> dict:
         "POLYKEY_ADAPTIVE_BLOCK": flag if config.adaptive_block else "0",
         "POLYKEY_DISPATCH_LOOKAHEAD": str(config.lookahead_blocks),
         "POLYKEY_TIMELINE_CAPACITY": str(config.timeline_capacity),
+        "POLYKEY_BLACKBOX_EVERY": str(config.blackbox_every),
         "POLYKEY_SIGNALS_INTERVAL": str(config.signals_interval_s),
         "POLYKEY_TOP_P_CANDIDATES": str(config.top_p_candidates),
         "POLYKEY_WATCHDOG_TIMEOUT": str(config.watchdog_timeout_s),

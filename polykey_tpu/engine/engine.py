@@ -2773,11 +2773,12 @@ class InferenceEngine:
             self._prefix.reinsert_device(key, slot.pages[chain_idx])
         slot.restore_pages = None
         ms = (time.monotonic() - t0) * 1e3
-        self.metrics.on_kv_restore(len(items), ms)
+        trace_id = self._trace_id_of(slot.request)
+        self.metrics.on_kv_restore(len(items), ms, trace_id=trace_id)
         if self.timeline is not None:
             self.timeline.note(
                 "kv_restore", slot=slot_idx, pages=len(items),
-                ms=round(ms, 3),
+                ms=round(ms, 3), trace=trace_id,
             )
 
     def _spill_for(self, target_free: int) -> int:

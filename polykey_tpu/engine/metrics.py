@@ -349,10 +349,11 @@ class EngineMetrics:
         with self._lock:
             self.kv_pages_evicted += pages
 
-    def on_kv_restore(self, pages: int, ms: float) -> None:
+    def on_kv_restore(self, pages: int, ms: float,
+                      trace_id: Optional[str] = None) -> None:
         with self._lock:
             self.kv_pages_restored += pages
-        self.kv_restore_hist.observe(ms)
+        self.kv_restore_hist.observe(ms, trace_id=trace_id)
 
     def on_admit(self) -> None:
         with self._lock:

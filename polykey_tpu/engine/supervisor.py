@@ -65,6 +65,7 @@ class EngineSupervisor:
         self._restart_times: deque[float] = deque()
         self._listeners: list[Callable[[object], None]] = []
         self._giveup_listeners: list[Callable[[str], None]] = []
+        self._trip_listeners: list[Callable[[object, str], None]] = []
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name="polykey-supervisor", daemon=True
@@ -82,6 +83,16 @@ class EngineSupervisor:
         the rest of the pool keeps health SERVING — per-replica give-up
         instead of the single-engine whole-process NOT_SERVING."""
         self._giveup_listeners.append(callback)
+
+    def add_trip_listener(
+        self, callback: Callable[[object, str], None]
+    ) -> None:
+        """Called with (dead engine, reason) the moment the supervisor
+        notices a trip — BEFORE the drain/restart/give-up path runs.
+        Black boxes (ISSUE 16) hang a forced checkpoint here: the dying
+        engine's timeline ring still exists at this point, and the
+        moments before a trip are exactly what a postmortem needs."""
+        self._trip_listeners.append(callback)
 
     def start(self) -> "EngineSupervisor":
         self._thread.start()
@@ -104,6 +115,11 @@ class EngineSupervisor:
             engine = self.engine
             if engine.dead is None:
                 continue
+            for callback in self._trip_listeners:
+                try:
+                    callback(engine, engine.dead or "engine dead")
+                except Exception:
+                    pass  # a black-box flush must never break supervision
             if not self._budget_ok():
                 self._give_up(engine.dead)
                 return

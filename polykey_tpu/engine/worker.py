@@ -66,6 +66,7 @@ from typing import Optional
 import numpy as np
 
 from ..faults import get_injector
+from ..obs import BlackBox, FlightRecorder, Span, Tracer
 from .config import EngineConfig
 from .engine import (
     EngineDeadError,
@@ -238,9 +239,7 @@ class WorkerServer:
                 restart_window_s=worker_cfg.restart_window_s,
                 check_interval_s=supervisor_interval_s,
             )
-            self.supervisor.add_restart_listener(
-                lambda fresh: setattr(self, "engine", fresh)
-            )
+            self.supervisor.add_restart_listener(self._on_engine_restart)
         self._retained: OrderedDict[str, bytes] = OrderedDict()
         self._retained_lock = threading.Lock()
         self._conns: set = set()
@@ -259,6 +258,41 @@ class WorkerServer:
         # worker re-advertises its warm sessions to the router.
         self._warm_keys: "OrderedDict[str, bool]" = OrderedDict()
         self._load_warm_index()
+        # Worker-local span trees (ISSUE 16): the engine appends
+        # children to any request.trace, but the recorder that keeps
+        # finished trees lives with the gateway — a worker needs its own
+        # so its side of a cross-process request survives in the black
+        # box. The black box itself (crash-durable checkpoint of both
+        # rings) exists only when the pool gave this member a state dir.
+        self.recorder = FlightRecorder(capacity=32)
+        self.tracer = Tracer(self.recorder)
+        self.blackbox: Optional[BlackBox] = None
+        if state_dir and worker_cfg.blackbox_every > 0:
+            self.blackbox = BlackBox(
+                state_dir, f"{tier}-{replica}",
+                timeline=getattr(self.engine, "timeline", None),
+                recorder=self.recorder,
+                every=worker_cfg.blackbox_every,
+                meta={"tier": tier, "replica": replica},
+            )
+            if self.supervisor is not None:
+                self.supervisor.add_trip_listener(self._on_engine_trip)
+
+    def _on_engine_restart(self, fresh) -> None:
+        self.engine = fresh
+        if self.blackbox is not None:
+            self.blackbox.rebind(getattr(fresh, "timeline", None),
+                                 self.recorder)
+
+    def _on_engine_trip(self, dead_engine, reason: str) -> None:
+        # Forced checkpoint of the DYING engine's rings: rebind to the
+        # corpse for one flush so the trip evidence isn't lost to the
+        # restart swapping a fresh (empty) timeline in underneath us.
+        if self.blackbox is None:
+            return
+        self.blackbox.rebind(getattr(dead_engine, "timeline", None),
+                             self.recorder)
+        self.blackbox.tick(force=True)
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -396,6 +430,18 @@ class WorkerServer:
                 elif op == "stats":
                     send_msg(conn, {"ok": True,
                                     "stats": self._stats_reply()})
+                elif op == "timeline":
+                    # Live ring pull for the merged flight deck; `mono`
+                    # lets the caller sanity-check its clock offset.
+                    timeline = getattr(self.engine, "timeline", None)
+                    send_msg(conn, {
+                        "ok": True,
+                        "mono": time.monotonic(),
+                        "events": _json_safe(
+                            timeline.events()
+                            if timeline is not None else []
+                        ),
+                    })
                 elif op == "prefill":
                     self._handle_prefill(conn, header.get("req") or {})
                 elif op == "fetch":
@@ -450,6 +496,10 @@ class WorkerServer:
         return {
             "ok": True, "tier": self.tier, "replica": self.replica,
             "state": state, "pid": os.getpid(),
+            # Clock-sync sample (ISSUE 16, obs/clocks.py): this worker's
+            # monotonic timestamp, assumed by the coordinator to be
+            # taken at the ping's request/response midpoint.
+            "mono": time.monotonic(),
             "queued": engine._submit.qsize(),
             "slots_busy": sum(s is not None for s in engine._slots),
             "slots_total": engine.config.max_decode_slots,
@@ -484,11 +534,21 @@ class WorkerServer:
         snap["_hists"] = hists
         return snap
 
-    @staticmethod
-    def _build_request(req: dict, **extra) -> GenRequest:
+    def _build_request(self, req: dict, **extra) -> GenRequest:
         deadline = None
         if req.get("deadline_in_s") is not None:
             deadline = time.monotonic() + float(req["deadline_in_s"])
+        # Trace propagation (ISSUE 16): a req carrying the gateway's
+        # trace_id gets a worker-local root span with the SAME id, so
+        # the engine's queue_wait/prefill/decode children — stamped on
+        # this process's monotonic clock — join the distributed trace.
+        # The finished tree ships back in the `done` frame and feeds the
+        # local flight recorder (and therefore the black box).
+        trace = None
+        trace_id = req.get("trace_id")
+        if trace_id:
+            trace = Span(f"worker:{self.tier}{self.replica}",
+                         trace_id=str(trace_id))
         return GenRequest(
             prompt=req.get("prompt", ""),
             max_new_tokens=int(req.get("max_new_tokens", 64)),
@@ -497,8 +557,33 @@ class WorkerServer:
             top_k=int(req.get("top_k", 0)),
             seed=req.get("seed"),
             deadline=deadline,
+            trace=trace,
             **extra,
         )
+
+    def _box_note(self, note_kind: str, **attrs) -> None:
+        """Timeline note + FORCED black-box checkpoint: op intake calls
+        this so the fatal request's trace id is durably in the ring
+        before any fault site can kill the process (``os._exit`` flushes
+        nothing — the checkpoint must happen-before the death)."""
+        timeline = getattr(self.engine, "timeline", None)
+        if timeline is not None:
+            timeline.note(
+                note_kind,
+                **{k: v for k, v in attrs.items() if v is not None},
+            )
+        if self.blackbox is not None:
+            self.blackbox.tick(force=True)
+
+    def _finish_trace(self, request: GenRequest) -> Optional[dict]:
+        """Close a traced request's worker-side tree, file it in the
+        local flight recorder, and render the wire form (absolute
+        monotonic start/end — the coordinator grafts it onto the
+        gateway root after clock alignment)."""
+        if request.trace is None:
+            return None
+        self.tracer.finish_and_record(request.trace)
+        return _span_wire(request.trace)
 
     def _submit(self, conn: socket.socket, request: GenRequest) -> bool:
         try:
@@ -513,10 +598,12 @@ class WorkerServer:
         return False
 
     def _handle_prefill(self, conn: socket.socket, req: dict) -> None:
+        handoff_id = req.get("handoff_id") or uuid.uuid4().hex
+        self._box_note("prefill_op", trace=req.get("trace_id"),
+                       handoff_id=handoff_id)
         if self._maybe_exit("intake") is not None:
             self._die()           # queued / mid-prefill death
             return
-        handoff_id = req.get("handoff_id") or uuid.uuid4().hex
         request = self._build_request(req, prefill_only=True)
         if not self._submit(conn, request):
             return
@@ -525,7 +612,15 @@ class WorkerServer:
             while True:
                 kind, value = request.out.get()
                 if kind == "handoff":
+                    t_ser = time.monotonic()
                     blob = serialize_kv_state(value)
+                    t_ser_end = time.monotonic()
+                    serialize_ms = (t_ser_end - t_ser) * 1e3
+                    if request.trace is not None:
+                        request.trace.child(
+                            "handoff_serialize", start=t_ser, end=t_ser_end,
+                            handoff_id=handoff_id, bytes=len(blob),
+                        )
                     with self._retained_lock:
                         self._retained[handoff_id] = blob
                         while len(self._retained) > _RETAIN_CAP:
@@ -539,6 +634,14 @@ class WorkerServer:
                         timeline.note("handoff_retained",
                                       handoff_id=handoff_id,
                                       bytes=len(blob))
+                    # Arc source for the merged flight deck: serialize
+                    # END on this process's clock (+ forced checkpoint —
+                    # the next fault site is the mid-handoff fetch kill).
+                    self._box_note("handoff_serialize",
+                                   handoff_id=handoff_id,
+                                   trace=req.get("trace_id"),
+                                   bytes=len(blob),
+                                   serialize_ms=round(serialize_ms, 3))
                     send_msg(conn, {
                         "event": "handoff_ready",
                         "handoff_id": handoff_id,
@@ -546,10 +649,12 @@ class WorkerServer:
                         "prompt_tokens": value.prompt_len,
                         "first_token": value.first_token,
                         "session": key,
+                        "serialize_ms": round(serialize_ms, 3),
                     })
                 elif kind == "done":
                     send_msg(conn, {"event": "done",
-                                    "timings": _timings_dict(value)})
+                                    "timings": _timings_dict(value),
+                                    "trace": self._finish_trace(request)})
                     return
                 else:
                     send_msg(conn, {"event": "error",
@@ -596,19 +701,38 @@ class WorkerServer:
         if faults is not None:
             faults.maybe_sleep("handoff-delay", replica=self.replica,
                                tier=self.tier)
+        self._box_note("decode_op", trace=req.get("trace_id"),
+                       handoff_id=req.get("handoff_id"),
+                       bytes=len(payload))
         if self._maybe_exit("intake") is not None:
             self._die()           # death at resume intake
             return
+        t_deser = time.monotonic()
         try:
             state = deserialize_kv_state(payload)
         except Exception as e:
             send_msg(conn, {"event": "error",
                             "message": f"kv-handoff rejected: {e}"})
             return
+        t_deser_end = time.monotonic()
+        deserialize_ms = (t_deser_end - t_deser) * 1e3
         request = self._build_request(req, resume_state=state)
+        if request.trace is not None:
+            request.trace.child(
+                "handoff_deserialize", start=t_deser, end=t_deser_end,
+                handoff_id=req.get("handoff_id"), bytes=len(payload),
+            )
         if not self._submit(conn, request):
             return
-        send_msg(conn, {"event": "accepted"})
+        # Arc sink for the merged flight deck: the blob is resident and
+        # the engine's restore-scatter begins at this submit — scatter
+        # START on this process's clock.
+        self._box_note("handoff_scatter",
+                       handoff_id=req.get("handoff_id"),
+                       trace=req.get("trace_id"),
+                       deserialize_ms=round(deserialize_ms, 3))
+        send_msg(conn, {"event": "accepted",
+                        "deserialize_ms": round(deserialize_ms, 3)})
         # The stream-site kill arms only once a stream actually exists:
         # consuming the one-shot budget on a rejected/shed op would
         # silently lose the drill's armed mid-decode death.
@@ -620,13 +744,16 @@ class WorkerServer:
                 if kind == "token":
                     forwarded += 1
                     send_msg(conn, {"event": "token", "id": int(value)})
+                    if self.blackbox is not None:
+                        self.blackbox.tick()   # amortized (every K)
                     if exit_after is not None and forwarded >= exit_after:
                         request.cancelled.set()
                         self._die()  # mid-decode death, stream mid-flight
                         return
                 elif kind == "done":
                     send_msg(conn, {"event": "done",
-                                    "timings": _timings_dict(value)})
+                                    "timings": _timings_dict(value),
+                                    "trace": self._finish_trace(request)})
                     return
                 else:
                     send_msg(conn, {"event": "error",
@@ -639,6 +766,21 @@ class WorkerServer:
                 # the next block boundary.
                 request.cancelled.set()
                 return
+
+
+def _span_wire(span: Span) -> dict:
+    """Wire form of a span tree: unlike `Span.to_dict` it keeps the
+    ABSOLUTE monotonic start/end, which is exactly what the coordinator
+    needs to re-time the tree onto its own clock (offset + graft)."""
+    with span._lock:
+        children = list(span.children)
+        attrs = dict(span.attrs)
+    out: dict = {"name": span.name, "start": span.start, "end": span.end}
+    if attrs:
+        out["attrs"] = _json_safe(attrs)
+    if children:
+        out["children"] = [_span_wire(c) for c in children]
+    return out
 
 
 def _timings_dict(timings) -> dict:

@@ -12,8 +12,10 @@ serving component reports into one `Observability` bundle —
 Everything is stdlib-only and cheap enough to stay on in production.
 """
 
+from .clocks import ClockSync
 from .exposition import DebugSurface, MetricsHTTPServer, engine_collector
 from .histogram import DEFAULT_MS_BUCKETS, Histogram, log_buckets
+from .postmortem import BlackBox, load_blackboxes, merged_perfetto
 from .profiler import ProfilerBusyError, ProfilerCapture
 from .prometheus import (
     CONTENT_TYPE,
@@ -32,7 +34,12 @@ from .signals import (
     SloPolicy,
     signals_snapshot,
 )
-from .timeline import TimelineRecorder, engine_timelines, to_perfetto
+from .timeline import (
+    TimelineRecorder,
+    engine_timelines,
+    merge_timelines,
+    to_perfetto,
+)
 from .trace import (
     FlightRecorder,
     Span,
@@ -53,8 +60,10 @@ class Observability:
 
 
 __all__ = [
+    "BlackBox",
     "CONTENT_TYPE",
     "CONTENT_TYPE_OPENMETRICS",
+    "ClockSync",
     "Counter",
     "DEFAULT_MS_BUCKETS",
     "DebugSurface",
@@ -73,6 +82,9 @@ __all__ = [
     "signals_snapshot",
     "engine_collector",
     "engine_timelines",
+    "load_blackboxes",
+    "merge_timelines",
+    "merged_perfetto",
     "Registry",
     "Span",
     "Tracer",

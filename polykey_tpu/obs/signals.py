@@ -780,6 +780,20 @@ def signals_snapshot(engine_or_pool, registry=None) -> dict:
             )
             for w in windows
         }
+    pool_windows = getattr(engine_or_pool, "signal_windows", None)
+    if callable(pool_windows):
+        # Disagg pool (ISSUE 16): no in-process planes, but the
+        # coordinator keeps its OWN windowed ring of cross-tier handoff
+        # signals — wire bandwidth, handoff-latency delta-quantiles,
+        # per-tier fault/restore rates. The autopilot reads tier
+        # pressure here, same shape discipline as `aggregate`.
+        out["pool"] = pool_windows()
+        now_fn = getattr(engine_or_pool, "handoff_now", None)
+        if callable(now_fn):
+            out["pool_now"] = now_fn()
+        offsets = getattr(engine_or_pool, "clock_offsets", None)
+        if callable(offsets):
+            out["clock_offsets"] = offsets()
     if registry is not None:
         out["gateway"] = gateway_availability(registry)
     return out

@@ -85,12 +85,21 @@ class TimelineRecorder:
             )
         self.capacity = capacity
         self._ring: deque = deque(maxlen=capacity)
+        # Lifetime append count (NOT ring length): black boxes flush
+        # every K appends, so they need a counter that keeps growing
+        # after the ring wraps. Benign races on += from note() threads
+        # only ever delay a flush by a few events.
+        self.appended = 0
 
     # -- emission (engine thread; notes may come from other threads) ---------
 
+    def _append(self, entry: tuple) -> None:
+        self._ring.append(entry)
+        self.appended += 1
+
     def dispatch(self, seq: int, block_kind: str, lanes: int, steps: int,
                  gap_ms: float) -> None:
-        self._ring.append(
+        self._append(
             ("dispatch", time.monotonic(), seq, block_kind, lanes, steps,
              gap_ms)
         )
@@ -98,33 +107,33 @@ class TimelineRecorder:
     def process(self, seq: int, start: float, end: float,
                 stall_ms: Optional[float], lookahead: int,
                 queued_after: int, busy_ms: float) -> None:
-        self._ring.append(
+        self._append(
             ("process", start, seq, end, stall_ms, lookahead, queued_after,
              busy_ms)
         )
 
     def admit(self, slot: int, trace_id: Optional[str],
               prompt_tokens: int) -> None:
-        self._ring.append(
+        self._append(
             ("admit", time.monotonic(), slot, trace_id, prompt_tokens)
         )
 
     def prefill(self, slot: int, tokens: int, final: bool) -> None:
-        self._ring.append(
+        self._append(
             ("prefill", time.monotonic(), slot, tokens, final)
         )
 
     def slot_start(self, slot: int, trace_id: Optional[str]) -> None:
-        self._ring.append(("slot_start", time.monotonic(), slot, trace_id))
+        self._append(("slot_start", time.monotonic(), slot, trace_id))
 
     def slot_end(self, slot: int, reason: str, tokens: int) -> None:
-        self._ring.append(("slot_end", time.monotonic(), slot, reason, tokens))
+        self._append(("slot_end", time.monotonic(), slot, reason, tokens))
 
     def expire(self, phase: str, trace_id: Optional[str]) -> None:
-        self._ring.append(("expire", time.monotonic(), phase, trace_id))
+        self._append(("expire", time.monotonic(), phase, trace_id))
 
     def note(self, note_kind: str, **attrs) -> None:
-        self._ring.append(("note", time.monotonic(), note_kind, attrs))
+        self._append(("note", time.monotonic(), note_kind, attrs))
 
     # -- read side -----------------------------------------------------------
 
@@ -145,10 +154,17 @@ class TimelineRecorder:
 
 
 def engine_timelines(engine_or_pool) -> list[tuple[int, str, list[dict]]]:
-    """Normalize an engine or a replica pool into exporter input:
+    """Normalize an engine or a pool into exporter input:
     ``[(pid, label, events)]`` — one Perfetto process per replica, pid =
     replica index. Engines with the timeline disabled contribute an
-    empty event list (the export stays valid, just blank)."""
+    empty event list (the export stays valid, just blank). A disagg
+    pool brings its own clock-aligned merge (`DisaggPool
+    .merged_timelines`): one process per worker plus the coordinator,
+    worker timestamps mapped onto the coordinator's clock — so
+    /debug/timeline serves the cross-process flight deck unchanged."""
+    merged = getattr(engine_or_pool, "merged_timelines", None)
+    if callable(merged):
+        return merged()
     if hasattr(engine_or_pool, "replicas"):
         out = []
         for rep in engine_or_pool.replicas:
@@ -161,6 +177,34 @@ def engine_timelines(engine_or_pool) -> list[tuple[int, str, list[dict]]]:
     timeline = getattr(engine_or_pool, "timeline", None)
     return [(0, "engine",
              timeline.events() if timeline is not None else [])]
+
+
+def merge_timelines(
+    groups: Iterable[tuple[int, str, list[dict], float]],
+) -> list[tuple[int, str, list[dict]]]:
+    """Map N processes' timelines onto ONE clock for a merged export.
+
+    ``groups`` is ``[(pid, label, events, offset_s)]`` where ``offset_s``
+    translates that process's monotonic timestamps onto the reference
+    (coordinator) clock — ``local = remote + offset`` as estimated by
+    `obs.clocks.ClockSync` (the coordinator itself rides with offset 0).
+    Returns exporter input (``[(pid, label, events)]``) with every
+    timestamp field shifted; input event dicts are not mutated.
+    """
+    out = []
+    for pid, label, events, offset in groups:
+        if offset:
+            shifted = []
+            for event in events:
+                event = dict(event)
+                event["t"] = event["t"] + offset
+                end = event.get("end")
+                if isinstance(end, (int, float)):
+                    event["end"] = end + offset
+                shifted.append(event)
+            events = shifted
+        out.append((pid, label, list(events)))
+    return out
 
 
 # Track (Perfetto tid) layout within one engine's process. Slot rows
@@ -221,6 +265,13 @@ def to_perfetto(
         return int(round((t - t0) * 1e6))
 
     trace_events: list[dict] = []
+    # Handoff arcs (merged disagg exports): the prefill worker's
+    # `handoff_serialize` note marks serialize end, the decode worker's
+    # `handoff_scatter` note marks scatter start; matching handoff_ids
+    # become a Perfetto flow pair so the wire hop renders as ONE
+    # causally-ordered arc across process rows.
+    arc_starts: dict[str, tuple[int, int]] = {}
+    arc_ends: dict[str, tuple[int, int]] = {}
     for pid, label, events in named:
         if not events:
             continue        # disabled/empty timeline: no tracks to draw
@@ -317,9 +368,17 @@ def to_perfetto(
                     us(event["t"]), args={"trace_id": event["trace_id"]},
                 ))
             elif kind == "note":
+                note_kind = event["note_kind"]
+                attrs = dict(event["attrs"])
+                handoff_id = attrs.get("handoff_id")
+                if handoff_id is not None:
+                    if note_kind == "handoff_serialize":
+                        arc_starts[str(handoff_id)] = (pid, us(event["t"]))
+                    elif note_kind == "handoff_scatter":
+                        arc_ends[str(handoff_id)] = (pid, us(event["t"]))
                 trace_events.append(_instant(
-                    pid, _TID_ENGINE, event["note_kind"], us(event["t"]),
-                    args=dict(event["attrs"]),
+                    pid, _TID_ENGINE, note_kind, us(event["t"]),
+                    args=attrs,
                 ))
         # Requests still resident when the ring was exported: open tail
         # slices to the export horizon, marked open (frontier state is
@@ -337,6 +396,22 @@ def to_perfetto(
             trace_events.append(_thread_meta(
                 pid, _TID_SLOT0 + slot, f"slot {slot}"
             ))
+
+    for handoff_id, (start_pid, start_ts) in arc_starts.items():
+        end = arc_ends.get(handoff_id)
+        if end is None:
+            continue            # one-sided (aborted mid-wire): no arc
+        end_pid, end_ts = end
+        trace_events.append({
+            "ph": "s", "id": handoff_id, "pid": start_pid,
+            "tid": _TID_ENGINE, "ts": start_ts,
+            "name": "handoff", "cat": "handoff",
+        })
+        trace_events.append({
+            "ph": "f", "bp": "e", "id": handoff_id, "pid": end_pid,
+            "tid": _TID_ENGINE, "ts": end_ts,
+            "name": "handoff", "cat": "handoff",
+        })
 
     out = {"traceEvents": trace_events, "displayTimeUnit": "ms"}
     if meta:

@@ -87,17 +87,18 @@ class Span:
         it already tracks (RequestTimings) into spans after the fact."""
         span = Span(name, trace_id=self.trace_id, start=start,
                     _lock=self._lock)
-        if end is not None:
-            span.end = end
-        if attrs:
-            span.attrs.update(attrs)
         with self._lock:
+            if end is not None:
+                span.end = end
+            if attrs:
+                span.attrs.update(attrs)
             self.children.append(span)
         return span
 
     def finish(self, end: Optional[float] = None) -> None:
-        if self.end is None:
-            self.end = time.monotonic() if end is None else end
+        with self._lock:
+            if self.end is None:
+                self.end = time.monotonic() if end is None else end
 
     @property
     def duration_ms(self) -> float:

@@ -23,6 +23,7 @@ acceptance artifact comes from `make failover-soak` (3 replicas).
 """
 
 import argparse
+import itertools
 import json
 import os
 import sys
@@ -155,6 +156,70 @@ def _protocol_gate() -> bool:
     return rc == 0
 
 
+def _handoff_causal_gate(merged: dict) -> dict:
+    """ISSUE 16 acceptance read over the merged Perfetto trace: the
+    drill's killed-mid-handoff traffic must come out as ordinary,
+    causally-ordered rows — every matched handoff flow arc runs forward
+    in (coordinator-aligned) time, and at least one request's handoff
+    appears on THREE distinct process rows: a coordinator lifecycle
+    note, a prefill worker's serialize instant, and a decode worker's
+    scatter instant with serialize end <= scatter start."""
+    events = merged.get("traceEvents", [])
+    instants = [e for e in events if e.get("ph") == "i"]
+
+    def notes(name: str) -> list:
+        return [e for e in instants if e.get("name") == name]
+
+    def trace_of(event: dict):
+        return (event.get("args") or {}).get("trace")
+
+    arc_s = {str(e.get("id")): e for e in events
+             if e.get("ph") == "s" and e.get("name") == "handoff"}
+    arc_f = {str(e.get("id")): e for e in events
+             if e.get("ph") == "f" and e.get("name") == "handoff"}
+    matched = sorted(set(arc_s) & set(arc_f))
+    backwards = [i for i in matched if arc_s[i]["ts"] > arc_f[i]["ts"]]
+
+    # Prefer the kill's own evidence: traces the coordinator aborted
+    # mid-handoff. Fallback to any trace (a drill where the kill raced
+    # the handoff window still has to prove the three-row merge).
+    aborted = sorted({t for t in map(trace_of, notes("handoff_abort"))
+                      if t})
+    started = sorted({t for t in map(trace_of, notes("handoff_start"))
+                      if t})
+    three_row = None
+    for trace in (aborted or started):
+        coords = [e for e in notes("handoff_start")
+                  if trace_of(e) == trace]
+        serials = [e for e in notes("handoff_serialize")
+                   if trace_of(e) == trace]
+        scatters = [e for e in notes("handoff_scatter")
+                    if trace_of(e) == trace]
+        for serialize in serials:
+            for scatter in scatters:
+                rows = {coords[0]["pid"], serialize["pid"],
+                        scatter["pid"]} if coords else set()
+                if len(rows) == 3 and serialize["ts"] <= scatter["ts"]:
+                    three_row = {
+                        "trace": trace,
+                        "pids": sorted(rows),
+                        "serialize_to_scatter_us":
+                            scatter["ts"] - serialize["ts"],
+                        "aborted_then_rerouted": trace in aborted,
+                    }
+                    break
+            if three_row:
+                break
+        if three_row:
+            break
+    return {
+        "process_rows": len({e.get("pid") for e in events}),
+        "arcs_matched": len(matched),
+        "arcs_backwards": len(backwards),
+        "three_row_handoff": three_row,
+    }
+
+
 def _dump_lock_witness() -> None:
     """Write this process's observed lock-order graph (no-op unless
     POLYKEY_LOCK_WITNESS=1 armed the witness at import). Workers dump
@@ -255,8 +320,16 @@ def run_disagg(args) -> int:
                 "restarted": bool(getattr(request, "restarted", False)),
             })
 
+    fired = itertools.count()
+
     def fire(prompt: str, enqueued_at: float) -> threading.Thread:
+        from polykey_tpu.obs import Span
+
         request = GenRequest(prompt=prompt, max_new_tokens=args.max_new)
+        # Every drill request is traced like a gateway RPC would be —
+        # the causal gate keys its three-process-row evidence on the
+        # trace id riding the handoff notes and worker-side instants.
+        request.trace = Span("gateway", trace_id=f"soak-{next(fired)}")
         pool.submit(request)
         thread = threading.Thread(
             target=drain, args=(request, enqueued_at), daemon=True
@@ -342,6 +415,14 @@ def run_disagg(args) -> int:
         time.sleep(0.2)
 
     stats = pool.stats()
+
+    # ISSUE 16: ONE merged cross-process Perfetto trace — a process row
+    # per worker plus the coordinator, worker events mapped onto the
+    # coordinator clock via the heartbeat's ping-offset estimates (a
+    # dead worker's row falls back to its black-box checkpoint). The
+    # causal gate below is the drill's "read the arc" acceptance.
+    merged = pool.merged_perfetto()
+    causal = _handoff_causal_gate(merged)
     pool.shutdown()
     _dump_lock_witness()
 
@@ -407,16 +488,23 @@ def run_disagg(args) -> int:
                 s.get("requests_completed")
             for s in stats["per_worker"]
         },
+        "clock_offsets": stats.get("clock_offsets", {}),
+        "handoff_causal_gate": causal,
     }
     out = args.out or os.path.join(
         "perf", f"disagg_soak_{time.strftime('%Y-%m-%d')}.json"
     )
     os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+    perfetto_out = os.path.splitext(out)[0] + ".perfetto.json"
+    artifact["perfetto"] = perfetto_out
+    with open(perfetto_out, "w") as f:
+        json.dump(merged, f)
     with open(out, "w") as f:
         json.dump(artifact, f, indent=2, sort_keys=True)
         f.write("\n")
     log(json.dumps(artifact, indent=2, sort_keys=True))
     log(f"artifact -> {out}")
+    log(f"merged perfetto -> {perfetto_out}")
 
     ok = True
     if failed or alive:
@@ -443,6 +531,18 @@ def run_disagg(args) -> int:
         ok = False
     if recovered_s is None:
         log("FAIL: a killed worker never rejoined SERVING")
+        ok = False
+    if causal["arcs_matched"] < 1:
+        log("FAIL: merged perfetto has no matched handoff arc")
+        ok = False
+    if causal["arcs_backwards"] > 0:
+        log(f"FAIL: {causal['arcs_backwards']} handoff arc(s) run "
+            "backwards after clock alignment")
+        ok = False
+    if causal["three_row_handoff"] is None:
+        log("FAIL: no request's handoff spans three process rows "
+            "(coordinator + prefill serialize + decode scatter) in "
+            "causal order")
         ok = False
     log("disagg drill " + ("PASSED" if ok else "FAILED"))
     return 0 if ok else 1

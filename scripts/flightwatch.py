@@ -121,6 +121,46 @@ def render(families: dict, slo: dict, now: str, target: str) -> str:
             "",
         ]
 
+    # Cross-tier handoff plane (ISSUE 16): the disagg coordinator's
+    # windowed wire signals from /debug/slo's "pool" key, plus the live
+    # per-decode-worker ship-bandwidth EWMA the NetKV router scores on.
+    pool = (slo or {}).get("pool") or {}
+    if pool:
+        lines.append("HANDOFF        ok/rr/fail   wire MB/s   "
+                     "p50/p95 ms   faults p/d   flt/min")
+        for label, window in pool.items():
+            handoffs = window.get("handoffs") or {}
+            faults = window.get("tier_faults") or {}
+            bw = window.get("wire_bandwidth_bytes_per_s")
+            lines.append(
+                "  {:<11} {:>10} {:>11} {:>12} {:>12} {:>9}".format(
+                    label,
+                    "{}/{}/{}".format(
+                        handoffs.get("ok", 0),
+                        handoffs.get("rerouted", 0),
+                        handoffs.get("failed", 0),
+                    ),
+                    _fmt(None if bw is None else bw / 1e6, "{:.2f}"),
+                    "{}/{}".format(
+                        _fmt(window.get("handoff_ms_p50")),
+                        _fmt(window.get("handoff_ms_p95")),
+                    ),
+                    "{}/{}".format(
+                        _fmt(faults.get("prefill"), "{:.0f}", "0"),
+                        _fmt(faults.get("decode"), "{:.0f}", "0"),
+                    ),
+                    _fmt(window.get("fault_rate_per_min"), "{:.2f}"),
+                )
+            )
+        ewma = ((slo or {}).get("pool_now") or {}).get(
+            "wire_bw_ewma_bytes_per_s") or {}
+        if ewma:
+            lines.append("  bw EWMA      " + "   ".join(
+                f"{role} {bps / 1e6:.2f} MB/s"
+                for role, bps in sorted(ewma.items())
+            ))
+        lines.append("")
+
     aggregate = (slo or {}).get("aggregate") or {}
     if aggregate:
         lines.append("WINDOWS        ttft_p50  ttft_p95   itl_p95"

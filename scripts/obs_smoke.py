@@ -106,6 +106,19 @@ EXEMPLAR_RE = re.compile(
     r'# \{trace_id="[A-Za-z0-9_-]{1,64}"\} \d+(\.\d+)? \d+\.\d{3}'
 )
 
+# ISSUE 16 satellites: the coordinator's handoff histogram and the
+# engine's kv-restore histogram carry per-bucket trace-id exemplars too
+# — the wire between "this bucket is slow" and "open THIS trace".
+HANDOFF_EXEMPLAR_RE = re.compile(
+    r'polykey_handoff_ms_bucket\{le="[^"]+"\} \d+ '
+    r'# \{trace_id="disagg-smoke-trace-\d"\} \d+(\.\d+)?(e-?\d+)? '
+    r'\d+\.\d{3}'
+)
+KV_EXEMPLAR_RE = re.compile(
+    r'polykey_kv_restore_ms_bucket\{le="[^"]+"\} \d+ '
+    r'# \{trace_id="kv-exemplar-\d+"\} \d+(\.\d+)?(e-?\d+)? \d+\.\d{3}'
+)
+
 CONFIG = EngineConfig(
     model="tiny-llama", tokenizer="byte", dtype="float32",
     max_decode_slots=4, page_size=8, num_pages=64, max_seq_len=64,
@@ -132,6 +145,8 @@ POOL_FAMILIES = (
 # Disaggregated-tier families (ISSUE 13): engine families carry
 # {tier, replica} labels per worker, the handoff counters/histogram are
 # coordinator-owned, and the worker state machine renders per tier.
+# (The section boots 1 prefill + 2 decode workers: the second decode
+# worker is the re-route target for the ISSUE 16 trace-continuity kill.)
 DISAGG_FAMILIES = (
     'polykey_requests_completed_total{replica="0",tier="prefill"}',
     'polykey_requests_completed_total{replica="0",tier="decode"}',
@@ -139,7 +154,7 @@ DISAGG_FAMILIES = (
     'polykey_replica_state{replica="0",state="SERVING",tier="prefill"} 1',
     'polykey_replica_state{replica="0",state="SERVING",tier="decode"} 1',
     'polykey_replicas_serving{tier="prefill"} 1',
-    'polykey_replicas_serving{tier="decode"} 1',
+    'polykey_replicas_serving{tier="decode"} 2',
     'polykey_handoffs_total{outcome="ok"} 1',
     "polykey_handoff_bytes_total",
     'polykey_handoff_ms_bucket{le="+Inf"} 1',
@@ -504,22 +519,29 @@ def pool_smoke() -> list:
 
 
 def disagg_smoke() -> list:
-    """Disaggregated-tier exposition (ISSUE 13): one prefill + one
-    decode worker (in-process servers over real localhost sockets)
-    behind the coordinator, one generation through the service, then
-    assert the tier-labeled engine families, the handoff families, and
-    the pool timeline's handoff lifecycle notes."""
+    """Disaggregated-tier exposition (ISSUE 13 + 16): one prefill + two
+    decode workers (in-process servers over real localhost sockets)
+    behind the coordinator. A clean generation asserts the tier-labeled
+    engine families, the handoff families, and the pool timeline's
+    handoff lifecycle notes; then a decode worker is killed mid-stream
+    and the gateway trace id must survive the re-route — the same id on
+    the coordinator's handoff_start/abort/ack notes, on both workers'
+    grafted span subtrees, and as a per-bucket exemplar on the handoff
+    histogram's OpenMetrics page."""
+    from polykey_tpu import faults
     from polykey_tpu.engine.disagg_pool import DisaggPool
     from polykey_tpu.engine.worker import WorkerServer
+    from polykey_tpu.obs import Span
     from polykey_tpu.obs.timeline import engine_timelines, to_perfetto
+    from polykey_tpu.obs.trace import set_current_span
 
-    print("booting 1x1 disagg pool on CPU ...", flush=True)
+    print("booting 1-prefill/2-decode disagg pool on CPU ...", flush=True)
     logger = Logger(stream=open(os.devnull, "w"))
     obs = Observability()
     workers = [
-        WorkerServer(CONFIG, tier=tier, replica=0, seed=5,
+        WorkerServer(CONFIG, tier=tier, replica=replica, seed=5,
                      exit_mode="simulate").start()
-        for tier in ("prefill", "decode")
+        for tier, replica in (("prefill", 0), ("decode", 0), ("decode", 1))
     ]
     pool = DisaggPool.create(
         CONFIG,
@@ -528,13 +550,29 @@ def disagg_smoke() -> list:
     )
     service = TpuService.create(pool, logger=logger, obs=obs)
     failures: list[str] = []
-    try:
+
+    def generate(trace_id: str, prompt: str) -> bool:
+        """One generation with a gateway span installed — the same
+        x-trace-id channel the interceptor uses, minus the socket."""
         from google.protobuf import struct_pb2
 
-        params = struct_pb2.Struct()
-        params.update({"prompt": "disagg obs smoke", "max_tokens": 8})
-        response = service.execute_tool("llm_generate", params, None, None)
-        if response.status.code != 200:
+        span = Span("gateway", trace_id=trace_id)
+        set_current_span(span)
+        try:
+            params = struct_pb2.Struct()
+            params.update({"prompt": prompt, "max_tokens": 8})
+            response = service.execute_tool("llm_generate", params,
+                                            None, None)
+            return response.status.code == 200
+        finally:
+            set_current_span(None)
+
+    def coord_notes(note_kind: str) -> list:
+        return [e for e in pool.timeline.events()
+                if e["kind"] == "note" and e["note_kind"] == note_kind]
+
+    try:
+        if not generate("disagg-smoke-trace-0", "disagg obs smoke"):
             failures.append("disagg llm_generate failed")
         page = obs.registry.render()
         for family in DISAGG_FAMILIES:
@@ -551,10 +589,124 @@ def disagg_smoke() -> list:
                      engine_timelines(pool))["traceEvents"]}
         if "handoff_ack" not in names:
             failures.append("perfetto export missing handoff_ack")
+
+        # ISSUE 16: kill WHICHEVER decode worker takes the request after
+        # 3 streamed tokens (tier-scoped, shared @1 budget — the NetKV
+        # router's pick is load-dependent, the kill must not miss); the
+        # re-routed request must keep its trace id end to end.
+        faults.install("worker-exit=3@1:tier=decode")
+        try:
+            if not generate("disagg-smoke-trace-1", "disagg reroute smoke"):
+                failures.append("disagg re-routed llm_generate failed")
+        finally:
+            faults.clear()
+        for kind in ("handoff_start", "handoff_abort", "handoff_ack"):
+            if not any(e["attrs"].get("trace") == "disagg-smoke-trace-1"
+                       for e in coord_notes(kind)):
+                failures.append(
+                    f"coordinator {kind} notes lost the trace id "
+                    "across the re-route"
+                )
+        aborts = [e for e in coord_notes("handoff_abort")
+                  if e["attrs"].get("trace") == "disagg-smoke-trace-1"]
+        start_ids = {e["attrs"].get("handoff_id")
+                     for e in coord_notes("handoff_start")}
+        if aborts and aborts[0]["attrs"].get("handoff_id") not in start_ids:
+            failures.append("handoff_abort does not join a handoff_start")
+
+        # Per-bucket trace-id exemplar on the coordinator's handoff
+        # histogram — OpenMetrics page only, classic page stays clean.
+        om_page = obs.registry.render(openmetrics=True)
+        if not HANDOFF_EXEMPLAR_RE.search(om_page):
+            failures.append(
+                "no trace_id exemplar on polykey_handoff_ms buckets"
+            )
+        if "trace_id" in obs.registry.render():
+            failures.append("classic disagg page leaked exemplars")
+
+        # Clock-aligned merged timeline: one process row per live worker
+        # plus the coordinator, handoff arcs causally ordered. The
+        # killed decode worker's row is allowed to be absent: this
+        # in-process smoke runs without a state dir, so a severed worker
+        # has no black-box fallback (postmortem-smoke covers that path).
+        merged = pool.merged_perfetto()
+        events = merged.get("traceEvents", [])
+        pids = {e.get("pid") for e in events}
+        if len(pids) < 3:
+            failures.append(
+                f"merged perfetto has {len(pids)} process rows, wanted 3"
+            )
+        arc_s = {e["id"]: e for e in events if e.get("ph") == "s"}
+        arc_f = {e["id"]: e for e in events if e.get("ph") == "f"}
+        matched = set(arc_s) & set(arc_f)
+        if not matched:
+            failures.append("merged perfetto has no matched handoff arc")
+        if any(arc_s[i]["ts"] > arc_f[i]["ts"] for i in matched):
+            failures.append("a handoff arc runs backwards in time")
     finally:
         service.close()
         for worker in workers:
             worker.stop()
+    return failures
+
+
+def kv_exemplar_checks() -> list:
+    """ISSUE 16 satellite: the host-KV tier's restore histogram carries
+    per-bucket trace-id exemplars. A deliberately tiny device pool
+    (test_host_kv geometry) forces sticky-session prefixes to spill to
+    host and fault back in on revisit; each revisit rides a gateway
+    span, so the restore that slowed a request names that request."""
+    import dataclasses
+
+    from polykey_tpu.obs import Span
+    from polykey_tpu.obs.trace import set_current_span
+
+    print("booting host-KV engine for restore exemplars ...", flush=True)
+    config = dataclasses.replace(
+        CONFIG, num_pages=24, max_decode_slots=4, prefill_chunk=16,
+        prefix_cache=True, host_kv_bytes=64 << 20,
+        host_kv_resident_pages=12, default_max_new_tokens=8,
+    )
+    logger = Logger(stream=open(os.devnull, "w"))
+    obs = Observability()
+    engine = InferenceEngine(config, logger=logger)
+    service = TpuService.create(engine, logger=logger, obs=obs)
+    failures: list[str] = []
+    try:
+        from google.protobuf import struct_pb2
+
+        sessions = [
+            f"session {s} header padded out to be long enough xx"
+            for s in range(4)
+        ]
+        # First pass seeds + spills the prefixes; the revisit pass
+        # faults them back in from host (the restores we exemplar).
+        for index, prompt in enumerate(sessions + sessions):
+            span = Span("gateway", trace_id=f"kv-exemplar-{index}")
+            set_current_span(span)
+            try:
+                params = struct_pb2.Struct()
+                params.update({"prompt": prompt, "max_tokens": 8})
+                response = service.execute_tool("llm_generate", params,
+                                                None, None)
+                if response.status.code != 200:
+                    failures.append(f"host-KV generation {index} failed")
+            finally:
+                set_current_span(None)
+        stats = engine.stats()
+        restored = (stats.get("kv_page_faults_prefix", 0)
+                    + stats.get("kv_page_faults_ctx", 0))
+        if restored < 1:
+            failures.append(
+                "host-KV drill caused no page faults — the pool is not "
+                "tight enough to exercise restores"
+            )
+        if not KV_EXEMPLAR_RE.search(obs.registry.render(openmetrics=True)):
+            failures.append(
+                "no trace_id exemplar on polykey_kv_restore_ms buckets"
+            )
+    finally:
+        service.close()
     return failures
 
 
@@ -653,6 +805,7 @@ def main() -> int:
 
     failures += pool_smoke()
     failures += disagg_smoke()
+    failures += kv_exemplar_checks()
 
     if failures:
         print("obs-smoke FAILED:")
@@ -666,7 +819,9 @@ def main() -> int:
           f"{len(POOL_FAMILIES)} replica-pool families present, "
           "engine_stats aggregates across replicas, "
           f"{len(DISAGG_FAMILIES)} disagg-tier families present with "
-          "handoff lifecycle on the pool timeline")
+          "handoff lifecycle on the pool timeline, trace-id continuity "
+          "across a disagg re-route, handoff + kv-restore exemplars on "
+          "the OpenMetrics page, merged perfetto arcs causally ordered")
     return 0
 
 

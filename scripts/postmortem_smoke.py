@@ -1,0 +1,242 @@
+#!/usr/bin/env python3
+"""Postmortem drill: SIGKILL a decode worker mid-stream, then prove the
+black boxes can reconstruct the death (``make postmortem-smoke``).
+
+ISSUE 16's crash-durability acceptance in script form: a 1x1
+disaggregated pool of real worker PROCESSES runs with black-box
+checkpointing on; a decode worker is killed via ``os._exit(1)`` after
+forwarding 3 tokens of a traced request (nothing flushes on that path
+by design — only the checkpoints already on disk survive). The drill
+then requires:
+
+- the dead incarnation's box holds the fatal request's trace id (the
+  forced checkpoint at op intake happens-after the trace-id note);
+- ``python -m polykey_tpu.obs.postmortem <state-dir>`` exits 0, names
+  the casualty in its triage report with the fatal trace id, and emits
+  a merged Perfetto file with a process row per member;
+- the victim stream itself still completes token-complete (the
+  supervisor respawns the worker; the re-route keeps the trace id) —
+  the postmortem is forensics, not the recovery path.
+
+Exit 0 means an operator can answer "what was that worker doing when it
+died?" after ANY death, including ones that never got to say goodbye.
+"""
+
+import argparse
+import json
+import os
+import queue
+import shutil
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _config(args):
+    from polykey_tpu.engine.config import EngineConfig
+
+    return EngineConfig(
+        model=args.model,
+        dtype="float32",
+        max_decode_slots=4,
+        page_size=8,
+        num_pages=4 * (args.max_seq // 8) + 32,
+        max_seq_len=args.max_seq,
+        prefill_buckets=(16, 32),
+        max_new_tokens_cap=args.max_new,
+        default_max_new_tokens=args.max_new,
+        decode_block_steps=2,
+        adaptive_block=False,
+        compile_warmup=True,
+        max_queue_depth=0,
+        watchdog_timeout_s=300.0,
+        supervise=True,
+        max_engine_restarts=5,
+        restart_window_s=600.0,
+        disagg="1x1",
+        disagg_heartbeat_s=0.25,
+        disagg_recovery_wait_s=120.0,
+        max_reroutes=6,
+        blackbox_every=4,        # smoke-tight amortization window
+    )
+
+
+def _arm_decode_kill(pool, tokens: int) -> bool:
+    """Install the mid-stream kill inside the decode worker PROCESS over
+    its control plane: ``os._exit(1)`` after `tokens` forwarded tokens."""
+    from polykey_tpu.engine.worker import WorkerConn
+
+    for worker in pool.workers:
+        if worker.tier == "decode" and worker.index == 0:
+            try:
+                with WorkerConn(worker.addr, timeout=5.0) as conn:
+                    reply, _ = conn.request(
+                        {"op": "arm_faults",
+                         "spec": f"worker-exit={tokens}@1"
+                                 ":tier=decode:replica=0"},
+                        timeout=5.0,
+                    )
+                return bool(reply.get("ok"))
+            except (OSError, ConnectionError, ValueError):
+                return False
+    return False
+
+
+def _run(pool, prompt: str, trace_id: str, max_new: int,
+         timeout_s: float) -> tuple:
+    """One traced generation; returns (tokens, error)."""
+    from polykey_tpu.engine.engine import GenRequest
+    from polykey_tpu.obs import Span
+
+    request = GenRequest(prompt=prompt, max_new_tokens=max_new)
+    request.trace = Span("gateway", trace_id=trace_id)
+    pool.submit(request)
+    tokens, error = [], None
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            kind, value = request.out.get(
+                timeout=max(0.01, deadline - time.monotonic()))
+        except queue.Empty:
+            error = "drain timeout"
+            break
+        if kind == "token":
+            tokens.append(value)
+        elif kind == "done":
+            break
+        else:
+            error = value
+            break
+    else:
+        error = error or "drain timeout"
+    return tokens, error
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--max-new", type=int, default=12)
+    ap.add_argument("--max-seq", type=int, default=64)
+    ap.add_argument("--model", default="tiny-llama")
+    ap.add_argument("--kill-after-tokens", type=int, default=3)
+    ap.add_argument("--state-dir", default="",
+                    help="state dir to use (kept); default: a fresh "
+                         "temp dir, removed on success")
+    ap.add_argument("--timeout", type=float, default=240.0,
+                    help="victim-stream drain budget (covers the "
+                         "worker-process respawn: jax import + engine "
+                         "build + warmup)")
+    args = ap.parse_args()
+
+    from polykey_tpu.engine.disagg_pool import DisaggPool
+    from polykey_tpu.obs import postmortem
+
+    keep_state = bool(args.state_dir)
+    state_dir = args.state_dir or tempfile.mkdtemp(
+        prefix="polykey-postmortem-")
+    config = _config(args)
+    log(f"spawning 1x1 disagg pool (state dir {state_dir}) ...")
+    pool = DisaggPool.create(config, seed=7, state_dir=state_dir)
+    failures: list = []
+    victim_trace = "postmortem-victim"
+    try:
+        tokens, error = _run(pool, "warm both tiers up first",
+                             "postmortem-warm", args.max_new, 120.0)
+        if error is not None or len(tokens) != args.max_new:
+            failures.append(f"warm stream failed: {error}, "
+                            f"{len(tokens)} tokens")
+
+        if not _arm_decode_kill(pool, args.kill_after_tokens):
+            failures.append("could not arm the decode kill")
+        log(f"armed os._exit(1) on decode/0 after "
+            f"{args.kill_after_tokens} tokens; firing the victim ...")
+        tokens, error = _run(pool, "the stream that dies mid-flight",
+                             victim_trace, args.max_new, args.timeout)
+        if error is not None or len(tokens) != args.max_new:
+            failures.append(
+                f"victim stream not token-complete after respawn: "
+                f"{error}, {len(tokens)}/{args.max_new} tokens"
+            )
+    finally:
+        pool.shutdown()
+
+    # The dead incarnation's box: SIGKILL'd workers flush nothing, so
+    # everything below reads only checkpoints that were already durable.
+    boxes = postmortem.load_blackboxes(state_dir)
+    roles = [b.get("role") for b in boxes]
+    log(f"black boxes: {roles}")
+    if "coordinator" not in roles:
+        failures.append("coordinator black box missing")
+
+    def fatal_notes(box: dict) -> list:
+        return [e for e in box.get("timeline", [])
+                if e.get("kind") == "note"
+                and e.get("attrs", {}).get("trace") == victim_trace]
+
+    dead = [b for b in boxes if b.get("role") == "decode-0"
+            and fatal_notes(b)]
+    if not dead:
+        failures.append(
+            "no decode-0 box holds the fatal request's trace id — the "
+            "death was not reconstructable"
+        )
+    else:
+        kinds = {e["attrs"].get("note_kind", e.get("note_kind"))
+                 for e in dead[0].get("timeline", [])
+                 if e.get("kind") == "note"}
+        log(f"dead incarnation (os pid {dead[0].get('pid')}): "
+            f"{len(dead[0].get('timeline', []))} events, "
+            f"note kinds {sorted(k for k in kinds if k)}")
+
+    report = postmortem.triage_report(boxes)
+    if victim_trace not in report:
+        failures.append("triage report does not mention the fatal trace")
+
+    # The operator command, end to end: triage + merged Perfetto file.
+    rc = postmortem.main([state_dir])
+    if rc != 0:
+        failures.append(f"postmortem CLI exited {rc}")
+    perfetto_path = os.path.join(state_dir, "postmortem.perfetto.json")
+    try:
+        with open(perfetto_path) as f:
+            merged = json.load(f)
+    except (OSError, ValueError) as e:
+        failures.append(f"merged perfetto unreadable: {e}")
+        merged = {"traceEvents": []}
+    pids = {e.get("pid") for e in merged.get("traceEvents", [])}
+    if len(pids) < 3:
+        failures.append(
+            f"merged perfetto has {len(pids)} process rows, wanted >= 3"
+        )
+    if not any(
+        (e.get("args") or {}).get("trace") == victim_trace
+        for e in merged.get("traceEvents", [])
+    ):
+        failures.append("fatal trace id absent from the merged perfetto")
+
+    if failures:
+        log("postmortem-smoke FAILED:")
+        for failure in failures:
+            log(f"  - {failure}")
+        log(f"state dir kept for inspection: {state_dir}")
+        return 1
+    log(f"postmortem-smoke OK: death reconstructed from "
+        f"{len(boxes)} box(es), triage names {victim_trace}, merged "
+        f"perfetto spans {len(pids)} process rows")
+    if not keep_state:
+        shutil.rmtree(state_dir, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

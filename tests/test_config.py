@@ -196,8 +196,20 @@ def test_persistent_compile_cache(monkeypatch, tmp_path):
         assert any(cache_dir.iterdir()), "compile cache wrote no entries"
     finally:
         # Detach the global cache dir so later tests don't write into the
-        # (deleted) tmp_path.
+        # (deleted) tmp_path. Setting the config option back to None is NOT
+        # enough: once initialized, jax's compilation cache object keeps
+        # reading/writing the old directory, and with min_compile_time_secs
+        # still 0 every later compile in this process round-trips through the
+        # stale tmp cache (which destabilizes later engine tests). Reset the
+        # cache object itself and restore the min-compile threshold.
         jax.config.update("jax_compilation_cache_dir", None)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        try:
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:
+            pass
         monkeypatch.setattr(ec, "_compile_cache_dir", None)
 
 

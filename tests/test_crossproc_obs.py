@@ -1,0 +1,605 @@
+"""Cross-process flight deck (ISSUE 16): clock alignment, merged
+timelines with handoff arcs, crash-durable black boxes, and the
+postmortem reconstruction path.
+
+Pinned contracts:
+- `ClockSync` recovers a known skew within its stated uncertainty and
+  ages a stale estimate by the drift bound (fresh mediocre beats stale
+  perfect, eventually);
+- `merge_timelines` + `to_perfetto` render ONE valid Perfetto trace
+  with per-process monotone slices and a handoff flow arc connecting
+  the prefill worker's serialize end to the decode worker's scatter
+  start (causally ordered after alignment);
+- `BlackBox` checkpoints are amortized, atomic (tmp→rename, no torn
+  reads), and round-trip through `load_blackboxes`;
+- the postmortem CLI reconstructs a death: triage names the stalest
+  member first, surfaces in-flight trace ids, and emits a merged
+  Perfetto file;
+- live pool: trace ids thread end-to-end across a disagg re-route,
+  `merged_perfetto()` shows all three process rows + the arc, and
+  black-box checkpointing on vs off changes NOTHING about the stream
+  (the overhead gate).
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from polykey_tpu import faults
+from polykey_tpu.engine.config import EngineConfig
+from polykey_tpu.engine.disagg_pool import DECODE, PREFILL, DisaggPool
+from polykey_tpu.engine.engine import GenRequest
+from polykey_tpu.engine.worker import WorkerServer
+from polykey_tpu.obs import Span, signals_snapshot
+from polykey_tpu.obs.clocks import ClockSync
+from polykey_tpu.obs.postmortem import (
+    BlackBox,
+    blackbox_path,
+    load_blackboxes,
+    main as postmortem_main,
+    merged_perfetto,
+    triage_report,
+)
+from polykey_tpu.obs.timeline import (
+    TimelineRecorder,
+    merge_timelines,
+    to_perfetto,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# -- clock alignment ----------------------------------------------------------
+
+
+def _exchange(sync: ClockSync, local_t: float, skew: float,
+              rtt: float = 0.002) -> None:
+    """One ideal ping at local time `local_t` against a remote whose
+    clock reads local - skew; the reply is stamped at the midpoint."""
+    t_send = local_t
+    t_recv = local_t + rtt
+    remote_mono = (t_send + t_recv) / 2.0 - skew
+    sync.update(t_send, t_recv, remote_mono)
+
+
+def test_clock_recovers_known_skew_within_bound():
+    skew = 123.456789
+    sync = ClockSync()
+    for i in range(10):
+        _exchange(sync, 100.0 + i, skew, rtt=0.004)
+    assert sync.offset is not None
+    bound = sync.uncertainty(now=110.0)
+    # Best sample: rtt/2, drift-aged over the ~10 s since it landed.
+    assert bound <= 0.002 + 200e-6 * 10.0 + 1e-9
+    assert abs(sync.offset - skew) <= bound
+    # to_local maps a remote stamp back onto the local axis.
+    assert sync.to_local(50.0 - skew) == pytest.approx(50.0, abs=bound)
+
+
+def test_clock_recovers_offset_under_asymmetric_noise():
+    # Midpoint stamping is the NTP assumption; asymmetric service time
+    # shifts the estimate by at most rtt/2 — the stated uncertainty.
+    skew = -7.25
+    sync = ClockSync()
+    for i in range(20):
+        t_send = 10.0 + i
+        rtt = 0.001 + (i % 5) * 0.002
+        # Remote stamps at 80% through the exchange, not the midpoint.
+        remote_mono = t_send + 0.8 * rtt - skew
+        sync.update(t_send, t_send + rtt, remote_mono)
+    bound = sync.uncertainty(now=30.0)
+    assert abs(sync.offset - skew) <= bound
+
+
+def test_clock_drift_ages_stale_estimate():
+    sync = ClockSync(drift_ppm=200.0)
+    _exchange(sync, 0.0, 5.0, rtt=0.0001)      # near-perfect sample
+    tight = sync.uncertainty(now=0.0001)
+    # 10000 s later the 200 ppm budget has grown the bound by ~2 s …
+    aged = sync.uncertainty(now=10000.0)
+    assert aged > 1.9 and aged > tight
+    # … so a mediocre-but-fresh sample wins.
+    assert sync.update(10000.0, 10000.5, 10000.25 - 5.0) is True
+    assert sync.uncertainty(now=10000.5) <= 0.25 + 1e-9
+
+
+def test_clock_rejects_worse_samples_and_resets():
+    sync = ClockSync()
+    _exchange(sync, 0.0, 1.0, rtt=0.001)
+    assert sync.update(0.1, 0.5, 0.3 - 1.0) is False   # fatter rtt loses
+    assert sync.update(1.0, 0.9, 0.95) is False        # negative rtt
+    assert sync.accepted == 1 and sync.samples == 2
+    sync.reset()
+    assert sync.offset is None and sync.uncertainty() is None
+    assert sync.to_local(42.0) == 42.0                 # identity fallback
+
+
+# -- merged timeline + handoff arcs -------------------------------------------
+
+
+def _note(t: float, kind: str, **attrs) -> dict:
+    return {"kind": "note", "t": t, "note_kind": kind, "attrs": attrs}
+
+
+def _synthetic_groups(handoff_id: str = "h1"):
+    """Coordinator + prefill + decode rings for one handoff, each on its
+    own clock: prefill runs 10 s behind the coordinator, decode 3 s
+    ahead. After alignment the serialize end precedes the scatter start
+    by 50 ms of wire time."""
+    coord = [
+        _note(100.00, "handoff_start", handoff_id=handoff_id, trace="t-1"),
+        _note(100.20, "handoff_ack", handoff_id=handoff_id, trace="t-1"),
+    ]
+    prefill = [
+        _note(90.05, "prefill_op", handoff_id=handoff_id, trace="t-1"),
+        _note(90.10, "handoff_serialize", handoff_id=handoff_id,
+              trace="t-1", bytes=4096),
+    ]
+    decode = [
+        _note(103.12, "decode_op", handoff_id=handoff_id, trace="t-1"),
+        _note(103.15, "handoff_scatter", handoff_id=handoff_id,
+              trace="t-1"),
+    ]
+    return [
+        (0, "coordinator", coord, 0.0),
+        (1, "prefill-0", prefill, 10.0),
+        (2, "decode-0", decode, -3.0),
+    ]
+
+
+def _arc_pair(trace: dict):
+    starts = [e for e in trace["traceEvents"] if e.get("ph") == "s"]
+    ends = [e for e in trace["traceEvents"] if e.get("ph") == "f"]
+    return starts, ends
+
+
+def test_merged_timeline_golden():
+    merged = merge_timelines(_synthetic_groups())
+    # Shift applied, input order preserved, originals untouched.
+    assert [pid for pid, _, _ in merged] == [0, 1, 2]
+    prefill_events = merged[1][2]
+    assert prefill_events[1]["t"] == pytest.approx(100.10)
+    trace = to_perfetto(merged, meta={"clock_offsets": {"prefill-0": 10.0}})
+    json.loads(json.dumps(trace))                     # Perfetto-loadable
+    assert trace["otherData"]["clock_offsets"]["prefill-0"] == 10.0
+    # One process row per member.
+    process_names = {
+        e["pid"]: e["args"]["name"]
+        for e in trace["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    assert process_names == {0: "polykey coordinator",
+                             1: "polykey prefill-0",
+                             2: "polykey decode-0"}
+    starts, ends = _arc_pair(trace)
+    assert len(starts) == 1 and len(ends) == 1
+    start, end = starts[0], ends[0]
+    assert start["id"] == end["id"] == "h1"
+    assert start["pid"] == 1 and end["pid"] == 2      # prefill → decode
+    assert end["bp"] == "e"
+    # Causal order after alignment: serialize end <= scatter start.
+    assert start["ts"] <= end["ts"]
+    assert end["ts"] - start["ts"] == pytest.approx(50e3, rel=0.01)  # µs
+
+
+def test_merged_timeline_input_not_mutated():
+    groups = _synthetic_groups()
+    before = json.dumps(groups[1][2])
+    merge_timelines(groups)
+    assert json.dumps(groups[1][2]) == before
+
+
+def test_one_sided_arc_is_skipped():
+    groups = _synthetic_groups()
+    # Drop the decode ring: an abort mid-wire leaves serialize only.
+    trace = to_perfetto(merge_timelines(groups[:2]))
+    starts, ends = _arc_pair(trace)
+    assert starts == [] and ends == []
+
+
+# -- black boxes --------------------------------------------------------------
+
+
+def test_blackbox_roundtrip_and_amortization(tmp_path):
+    state_dir = str(tmp_path)
+    ring = TimelineRecorder(capacity=64)
+    box = BlackBox(state_dir, "decode-0", timeline=ring, every=8,
+                   meta={"tier": "decode"})
+    assert box.tick() is True            # first tick writes the baseline
+    for i in range(7):
+        ring.note("warmup", i=i)
+        assert box.tick() is False       # amortized: under the budget
+    ring.note("edge", i=7)
+    assert box.tick() is True            # 8th append crosses it
+    ring.note("fatal", trace="t-dead")
+    assert box.tick(force=True) is True  # forced beats the budget
+    assert box.flushes == 3
+    assert not os.path.exists(box.path + ".tmp")   # atomic: no tmp left
+
+    boxes = load_blackboxes(state_dir)
+    assert len(boxes) == 1
+    loaded = boxes[0]
+    assert loaded["role"] == "decode-0"
+    assert loaded["pid"] == os.getpid()
+    assert loaded["meta"] == {"tier": "decode"}
+    assert loaded["_path"] == blackbox_path(state_dir, "decode-0")
+    kinds = [e["attrs"].get("trace") for e in loaded["timeline"]
+             if e["kind"] == "note"]
+    assert "t-dead" in kinds
+
+
+def test_blackbox_rebind_resets_mark(tmp_path):
+    ring_a = TimelineRecorder(capacity=8)
+    for _ in range(5):
+        ring_a.note("old")
+    box = BlackBox(str(tmp_path), "prefill-0", timeline=ring_a, every=100)
+    assert box.tick() is True
+    assert box.tick() is False
+    ring_b = TimelineRecorder(capacity=8)
+    box.rebind(ring_b)
+    assert box.tick() is True            # fresh ring: baseline again
+
+
+def test_blackbox_rotation_preserves_dead_incarnation(tmp_path):
+    """A respawned worker binds the same role/path; the dead
+    incarnation's final checkpoint must survive as .prev.json and both
+    must load (the postmortem reads the death, not the boot baseline)."""
+    state_dir = str(tmp_path)
+    dead_ring = TimelineRecorder(capacity=8)
+    dead_ring.note("decode_op", trace="t-fatal")
+    BlackBox(state_dir, "decode-0", timeline=dead_ring).flush()
+
+    fresh = BlackBox(state_dir, "decode-0",
+                     timeline=TimelineRecorder(capacity=8))
+    fresh.flush()
+    boxes = load_blackboxes(state_dir)
+    assert [b["role"] for b in boxes] == ["decode-0", "decode-0"]
+    traces = [
+        e.get("attrs", {}).get("trace")
+        for b in boxes for e in b["timeline"] if e["kind"] == "note"
+    ]
+    assert "t-fatal" in traces
+
+
+def test_load_blackboxes_orders_and_skips_garbage(tmp_path):
+    state_dir = str(tmp_path)
+    for role in ("decode-0", "coordinator", "prefill-0"):
+        BlackBox(state_dir, role, timeline=None).flush()
+    with open(os.path.join(state_dir, "blackbox-squatter.json"), "w") as f:
+        f.write("{not json")
+    with open(os.path.join(state_dir, "unrelated.json"), "w") as f:
+        json.dump({"timeline": []}, f)
+    boxes = load_blackboxes(state_dir)
+    assert [b["role"] for b in boxes] == \
+        ["coordinator", "decode-0", "prefill-0"]
+    assert load_blackboxes(os.path.join(state_dir, "missing")) == []
+
+
+# -- postmortem reconstruction ------------------------------------------------
+
+
+def _shift_ring(ring: TimelineRecorder, delta: float) -> None:
+    """Move a recorder's events into another monotonic epoch — the
+    rings all come from THIS process, but the scene fabricates three
+    processes whose clocks disagree by the coordinator's offsets."""
+    ring._ring = type(ring._ring)(
+        ((entry[0], entry[1] + delta) + entry[2:] for entry in ring._ring),
+        maxlen=ring._ring.maxlen,
+    )
+
+
+def _write_crash_scene(state_dir: str) -> None:
+    """Fabricate the boxes a killed-mid-handoff run leaves behind."""
+    coord_ring = TimelineRecorder(capacity=32)
+    coord_ring.note("handoff_start", handoff_id="h9", trace="t-fatal")
+    coord = BlackBox(state_dir, "coordinator", timeline=coord_ring,
+                     meta={"clock_offsets": {
+                         "prefill-0": {"offset_s": 10.0,
+                                       "uncertainty_s": 0.001,
+                                       "samples": 4, "accepted": 2},
+                         "decode-0": {"offset_s": -3.0,
+                                      "uncertainty_s": 0.001,
+                                      "samples": 4, "accepted": 2},
+                     }})
+    prefill_ring = TimelineRecorder(capacity=32)
+    prefill_ring.note("handoff_serialize", handoff_id="h9",
+                      trace="t-fatal", bytes=1024)
+    # local = remote + offset, so each worker's ring lives at
+    # local − offset in its own epoch; the merge must undo this.
+    _shift_ring(prefill_ring, -10.0)
+    prefill = BlackBox(state_dir, "prefill-0", timeline=prefill_ring)
+    decode_ring = TimelineRecorder(capacity=32)
+    time.sleep(0.002)   # real wire time: serialize end < scatter start
+    decode_ring.note("decode_op", handoff_id="h9", trace="t-fatal")
+    decode_ring.note("handoff_scatter", handoff_id="h9", trace="t-fatal")
+    decode_ring.admit(0, "t-fatal", 16)       # admitted, never retired
+    _shift_ring(decode_ring, 3.0)
+    decode = BlackBox(state_dir, "decode-0", timeline=decode_ring)
+    # Decode dies FIRST (stalest checkpoint), survivors keep flushing.
+    decode.flush()
+    time.sleep(0.01)
+    prefill.flush()
+    coord.flush()
+
+
+def test_postmortem_reconstructs_death(tmp_path, capsys):
+    state_dir = str(tmp_path)
+    _write_crash_scene(state_dir)
+    boxes = load_blackboxes(state_dir)
+    report = triage_report(boxes)
+    assert "3 black box(es)" in report
+    assert "likely first casualty: decode-0" in report
+    assert "in-flight traces: t-fatal" in report
+
+    trace = merged_perfetto(boxes)
+    json.loads(json.dumps(trace))
+    assert trace["otherData"]["clock_offsets"] == \
+        {"prefill-0": 10.0, "decode-0": -3.0}
+    roles = {b["role"] for b in trace["otherData"]["boxes"]}
+    assert roles == {"coordinator", "prefill-0", "decode-0"}
+    starts, ends = _arc_pair(trace)
+    assert len(starts) == 1 and len(ends) == 1
+    assert starts[0]["ts"] <= ends[0]["ts"]
+
+    out_path = os.path.join(state_dir, "merged.json")
+    rc = postmortem_main([state_dir, "--out", out_path, "--last", "4"])
+    assert rc == 0
+    stdout = capsys.readouterr().out
+    assert "t-fatal" in stdout
+    with open(out_path) as f:
+        assert json.load(f)["traceEvents"]
+
+
+def test_postmortem_empty_dir_exits_2(tmp_path, capsys):
+    assert postmortem_main([str(tmp_path)]) == 2
+    assert "no black boxes" in capsys.readouterr().out
+
+
+# -- live pool: trace propagation, merge, overhead gate -----------------------
+
+
+def _config(**overrides) -> EngineConfig:
+    base = dict(
+        model="tiny-llama", dtype="float32", max_decode_slots=4,
+        page_size=8, num_pages=128, max_seq_len=64,
+        prefill_buckets=(16, 32), decode_block_steps=2,
+        adaptive_block=False, max_new_tokens_cap=12,
+        default_max_new_tokens=12, supervise=False,
+        disagg_heartbeat_s=0.1, disagg_recovery_wait_s=10.0,
+        blackbox_every=4,
+    )
+    base.update(overrides)
+    return EngineConfig(**base)
+
+
+def _run(pool, prompt: str, n: int = 10, **kw):
+    request = GenRequest(prompt=prompt, max_new_tokens=n, **kw)
+    pool.submit(request)
+    tokens = []
+    while True:
+        kind, value = request.out.get(timeout=60)
+        if kind == "token":
+            tokens.append(value)
+        elif kind == "done":
+            return tokens, None, request
+        else:
+            return tokens, value, request
+
+
+class _Stack:
+    def __init__(self, cfg, decode_workers=1, prefill_workers=1,
+                 state_dir=None):
+        self.cfg = cfg
+        self.workers = []
+        for i in range(prefill_workers):
+            self.workers.append(WorkerServer(
+                cfg, tier=PREFILL, replica=i, seed=7,
+                exit_mode="simulate", state_dir=state_dir,
+            ).start())
+        for i in range(decode_workers):
+            self.workers.append(WorkerServer(
+                cfg, tier=DECODE, replica=i, seed=7,
+                exit_mode="simulate", state_dir=state_dir,
+            ).start())
+        self.pool = DisaggPool.create(
+            cfg,
+            workers=[(w.tier, ("127.0.0.1", w.port)) for w in self.workers],
+            state_dir=state_dir,
+        )
+
+    def close(self):
+        self.pool.shutdown()
+        for worker in self.workers:
+            worker.stop()
+
+
+@pytest.fixture()
+def stacks():
+    opened = []
+
+    def make(cfg=None, **kw) -> _Stack:
+        stack = _Stack(cfg or _config(), **kw)
+        opened.append(stack)
+        return stack
+
+    yield make
+    for stack in opened:
+        stack.close()
+
+
+def _wait_for_clocks(pool, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if all(w.clock.offset is not None for w in pool.workers):
+            return
+        time.sleep(0.05)
+    raise AssertionError("heartbeat never delivered a clock sample")
+
+
+def test_trace_id_continuity_across_reroute(stacks):
+    stack = stacks(decode_workers=2)
+    _wait_for_clocks(stack.pool)
+    faults.install("worker-exit=3@1:tier=decode:replica=0")
+    trace = Span("gateway", trace_id="t-route")
+    toks, err, req = _run(stack.pool, "kill test prompt", trace=trace)
+    assert err is None and len(toks) == 10
+    assert req.restarted is True
+    # Coordinator notes: start/ack/abort all joined the SAME trace.
+    notes = [e for e in stack.pool.timeline.events() if e["kind"] == "note"]
+    by_kind = {}
+    for event in notes:
+        by_kind.setdefault(event["note_kind"], []).append(event["attrs"])
+    for kind in ("handoff_start", "handoff_ack", "handoff_abort"):
+        assert by_kind.get(kind), f"missing {kind} note"
+        assert all(a.get("trace") == "t-route" for a in by_kind[kind]), kind
+    # The abort and the retry share the request's handoff id.
+    abort = by_kind["handoff_abort"][0]
+    assert abort["handoff_id"] in {a.get("handoff_id")
+                                   for a in by_kind["handoff_start"]}
+    # Worker-side rings saw the same id at op intake.
+    worker_notes = []
+    for worker in stack.workers:
+        timeline = getattr(worker.engine, "timeline", None)
+        if timeline is not None:
+            worker_notes += [e for e in timeline.events()
+                             if e["kind"] == "note"]
+    intake = [e["attrs"] for e in worker_notes
+              if e["note_kind"] in ("prefill_op", "decode_op")]
+    assert intake and all(a.get("trace") == "t-route" for a in intake)
+    # Grafted spans: the surviving decode worker's subtree landed under
+    # the gateway root, re-timed onto the coordinator clock.
+    names = [c.name for c in trace.children]
+    assert "handoff_ship" in names and "handoff_fetch" in names
+    grafted = [c for c in trace.children if c.name.startswith("worker:")]
+    assert grafted, f"no worker subtree grafted (children: {names})"
+    child_names = {c.name for g in grafted for c in g.children}
+    assert "handoff_deserialize" in child_names
+
+
+def test_merged_perfetto_live_pool(stacks, tmp_path):
+    stack = stacks(state_dir=str(tmp_path))
+    _wait_for_clocks(stack.pool)
+    trace_span = Span("gateway", trace_id="t-merge")
+    toks, err, _ = _run(stack.pool, "hello disagg world", trace=trace_span)
+    assert err is None and len(toks) == 10
+    trace = stack.pool.merged_perfetto()
+    json.loads(json.dumps(trace))
+    process_rows = {
+        e["args"]["name"]
+        for e in trace["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    assert process_rows == {"polykey coordinator", "polykey prefill-0",
+                            "polykey decode-0"}
+    starts, ends = _arc_pair(trace)
+    assert starts and ends
+    pair = {(s["id"]) for s in starts} & {(e["id"]) for e in ends}
+    assert pair, "no matched serialize→scatter arc"
+    for start in starts:
+        end = next((e for e in ends if e["id"] == start["id"]), None)
+        if end is not None:
+            assert start["ts"] <= end["ts"], \
+                "handoff arc runs backwards after clock alignment"
+    # The coordinator's black box carried offsets for the postmortem.
+    stack.pool.shutdown()
+    boxes = load_blackboxes(str(tmp_path))
+    roles = {b["role"] for b in boxes}
+    assert {"coordinator", "prefill-0", "decode-0"} <= roles
+    offline = merged_perfetto(boxes)
+    assert offline["otherData"]["source"] == "postmortem"
+
+
+def test_pool_signal_windows_and_snapshot(stacks):
+    stack = stacks()
+    for prompt in ("hello disagg world", "kill test prompt"):
+        toks, err, _ = _run(stack.pool, prompt)
+        assert err is None
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        windows = stack.pool.signal_windows()
+        if windows and any(
+            w["handoffs"]["ok"] >= 2 for w in windows.values()
+        ):
+            break
+        time.sleep(0.05)
+    windows = stack.pool.signal_windows()
+    assert windows, "heartbeat never sampled the signal ring"
+    label, window = next(iter(windows.items()))
+    assert window["covered_s"] > 0
+    assert window["handoffs"]["ok"] >= 2
+    assert window["handoff_bytes"] > 0
+    assert window["wire_bandwidth_bytes_per_s"] > 0
+    assert window["handoff_ms_count"] >= 2
+    assert window["handoff_ms_p95"] >= window["handoff_ms_p50"] > 0
+    assert window["tier_faults"] == {PREFILL: 0, DECODE: 0}
+    assert window["fault_rate_per_min"] == 0
+    snap = signals_snapshot(stack.pool)
+    assert snap["replicas"] == {}          # engines live out of process
+    assert snap["pool"] == windows or snap["pool"].keys() == windows.keys()
+    assert set(snap["clock_offsets"]) == {"prefill-0", "decode-0"}
+
+
+def test_blackbox_overhead_gate(stacks, tmp_path):
+    """Checkpointing must be observability-only: greedy streams and the
+    scheduler's lane shape are identical with black boxes on vs off."""
+    on = stacks(cfg=_config(blackbox_every=2), state_dir=str(tmp_path))
+    off = stacks(cfg=_config(blackbox_every=0))
+    streams_on, streams_off = {}, {}
+    for prompt in ("hello disagg world", "kill test prompt"):
+        toks, err, _ = _run(on.pool, prompt)
+        assert err is None
+        streams_on[prompt] = toks
+        toks, err, _ = _run(off.pool, prompt)
+        assert err is None
+        streams_off[prompt] = toks
+    assert streams_on == streams_off
+    lanes_on = [w.engine.stats().get("avg_lanes")
+                for w in on.workers if w.tier == DECODE]
+    lanes_off = [w.engine.stats().get("avg_lanes")
+                 for w in off.workers if w.tier == DECODE]
+    assert lanes_on == lanes_off
+    # And the on-stack really did checkpoint.
+    assert load_blackboxes(str(tmp_path))
+
+
+def test_postmortem_after_mid_stream_death(stacks, tmp_path):
+    """The acceptance path: kill a decode worker mid-stream, then
+    reconstruct its final ring — fatal trace id included — from the
+    black box alone."""
+    state_dir = str(tmp_path)
+    stack = stacks(cfg=_config(blackbox_every=2), decode_workers=2,
+                   state_dir=state_dir)
+    _wait_for_clocks(stack.pool)
+    faults.install("worker-exit=3@1:tier=decode:replica=0")
+    trace = Span("gateway", trace_id="t-victim")
+    toks, err, req = _run(stack.pool, "kill test prompt", trace=trace)
+    assert err is None and len(toks) == 10 and req.restarted
+    victim_box = blackbox_path(state_dir, "decode-0")
+    assert os.path.exists(victim_box), \
+        "the victim's box must exist (forced flush at op intake)"
+    with open(victim_box) as f:
+        box = json.load(f)
+    fatal = [e for e in box["timeline"]
+             if e["kind"] == "note" and e["note_kind"] == "decode_op"]
+    assert fatal and fatal[-1]["attrs"]["trace"] == "t-victim"
+    report = triage_report(load_blackboxes(state_dir))
+    assert "t-victim" in report
+    # merged_timelines falls back to the corpse's box for dead workers.
+    merged = dict(
+        (label, events)
+        for _, label, events in stack.pool.merged_timelines()
+    )
+    assert "decode-0" in merged
+    assert any(
+        e.get("note_kind") == "decode_op"
+        and e.get("attrs", {}).get("trace") == "t-victim"
+        for e in merged["decode-0"] if e["kind"] == "note"
+    )

@@ -777,12 +777,28 @@ def test_flightwatch_parse_and_render():
                              "itl_ms_p95": 12.0, "tokens_per_sec": 123.4,
                              "availability": 1.0,
                              "device_busy_fraction": 0.987}},
+        # Disagg coordinator windows (ISSUE 16): the HANDOFF section.
+        "pool": {"1m": {
+            "covered_s": 60.0,
+            "handoffs": {"ok": 41, "rerouted": 2, "failed": 0},
+            "handoff_bytes": 123_000_000,
+            "wire_bandwidth_bytes_per_s": 2_050_000.0,
+            "handoff_ms_count": 43, "handoff_ms_p50": 3.1,
+            "handoff_ms_p95": 9.7,
+            "tier_faults": {"prefill": 1, "decode": 0},
+            "tier_restores": {"prefill": 1, "decode": 0},
+            "fault_rate_per_min": 1.0,
+        }},
+        "pool_now": {"wire_bw_ewma_bytes_per_s": {"decode-0": 2_400_000.0}},
     }
     frame = flightwatch.render(families, slo, "12:00:00Z", "test:0")
     assert "ENGINE" in frame and "123.4" in frame
     assert "WINDOWS" in frame and "900.0" in frame
     assert "SLO" in frame and "BREACHED" in frame
     assert "REPLICAS" in frame and "SERVING" in frame
+    assert "HANDOFF" in frame and "41/2/0" in frame
+    assert "3.1/9.7" in frame and "2.05" in frame
+    assert "decode-0 2.40 MB/s" in frame
     # Degrades without /debug/slo: still renders the engine section.
     frame = flightwatch.render(families, None, "12:00:00Z", "test:0")
     assert "ENGINE" in frame and "WINDOWS" not in frame

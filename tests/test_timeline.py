@@ -102,7 +102,9 @@ def _validate_perfetto(trace: dict) -> dict:
     dispatch_seqs, process_seqs = set(), set()
     max_lookahead = 0
     for event in events:
-        assert event.get("ph") in ("X", "M", "i"), event
+        # "s"/"f" are flow arcs (ISSUE 16 handoff arcs on merged
+        # disagg exports); single-engine exports emit none.
+        assert event.get("ph") in ("X", "M", "i", "s", "f"), event
         if event["ph"] == "M":
             if event["name"] == "thread_name":
                 named_tracks.add((event["pid"], event["args"]["name"]))
