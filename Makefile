@@ -119,10 +119,15 @@ ragged-smoke: ## Ragged kernel interpret parity + engine bit-identity vs buckete
 # to an all-device run, and a supervised restart mid-soak recovering
 # warm TTFT from the durable prefix store. Smoke scale for CI; the
 # committed acceptance artifact comes from hostkv-soak.
-hostkv-smoke: ## Host-KV tier drill at CI scale (spill/fault/restart, bit-identity gate)
-	JAX_PLATFORMS=cpu $(PYTHON) scripts/occupancy_soak.py --host-kv \
+hostkv-smoke: ## Host-KV tier drill at CI scale (spill/fault/restart, bit-identity gate + heap-witness zero-growth gate)
+	rm -rf /tmp/polykey-heap-witness-hostkv
+	JAX_PLATFORMS=cpu POLYKEY_HEAP_WITNESS=1 \
+	  POLYKEY_HEAP_WITNESS_OUT=/tmp/polykey-heap-witness-hostkv \
+	  $(PYTHON) scripts/occupancy_soak.py --host-kv \
 	  --slots 8 --hk-sessions 6 --hk-turns 3 --hk-base 64 \
 	  --hk-turn-tokens 32 --out /tmp/hostkv_smoke.json
+	$(PYTHON) -m polykey_tpu.analysis mem --only ML006 \
+	  --witness /tmp/polykey-heap-witness-hostkv
 
 hostkv-soak: ## The 12-session / 4-turn acceptance drill (writes perf/)
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/occupancy_soak.py --host-kv \
@@ -167,15 +172,19 @@ failover-soak: ## The 3-replica / 30 s acceptance drill (writes perf/)
 # acquisition-order edges from the coordinator + every worker process
 # then merge into racelint's static lock graph, which must stay
 # cycle-free (the zero-deadlock gate with real evidence).
-disagg-smoke: ## Kill-workers drill at CI scale + lock-witness zero-cycle gate
-	rm -rf /tmp/polykey-lock-witness
+disagg-smoke: ## Kill-workers drill at CI scale + lock-witness zero-cycle gate + heap-witness zero-growth gate
+	rm -rf /tmp/polykey-lock-witness /tmp/polykey-heap-witness-disagg
 	JAX_PLATFORMS=cpu POLYKEY_LOCK_WITNESS=1 \
 	  POLYKEY_LOCK_WITNESS_OUT=/tmp/polykey-lock-witness \
+	  POLYKEY_HEAP_WITNESS=1 \
+	  POLYKEY_HEAP_WITNESS_OUT=/tmp/polykey-heap-witness-disagg \
 	  $(PYTHON) scripts/failover_soak.py --disagg \
 	  --prefill 2 --decode 1 --duration 10 \
 	  --out /tmp/disagg_smoke.json
 	$(PYTHON) -m polykey_tpu.analysis race --only CL001 \
 	  --witness /tmp/polykey-lock-witness
+	$(PYTHON) -m polykey_tpu.analysis mem --only ML006 \
+	  --witness /tmp/polykey-heap-witness-disagg
 
 # Cross-process black boxes (ISSUE 16): reconstruct the last seconds
 # before any member death from the checkpoints in a disagg state dir —
@@ -234,7 +243,7 @@ multiproc-demo: ## 2-process jax.distributed train+serve on localhost CPU
 	bash scripts/run_multiproc_demo.sh
 
 # -- local CI reproduction (reference Makefile:217-308 scan/ci-check family) --
-.PHONY: lint polylint graphlint racelint native-asan scan ci-check
+.PHONY: lint polylint graphlint racelint memlint native-asan scan ci-check
 
 lint: ## Lint: ruff (pinned ruff.toml, same config as CI) + polylint
 	@if command -v ruff >/dev/null 2>&1; then \
@@ -264,6 +273,16 @@ racelint: ## Concurrency & protocol contract analysis (stdlib-only)
 # layout (GL005). ~1-2 min: it compile-warms two tiny engines.
 graphlint: ## Compiled-graph contract analysis (CPU-backed; ~1-2 min)
 	JAX_PLATFORMS=cpu $(PYTHON) -m polykey_tpu.analysis graph
+
+# The fourth analysis tier (ISSUE 17): memory & capacity contracts —
+# the analytic byte ledger vs ChipSpec.hbm_bytes across the served
+# matrix (ML001), unbounded container growth (ML002), and the
+# POLYKEY_* knob contracts: documented (ML003), single parse site
+# (ML004), shipped to disagg workers (ML005). Stdlib-only AST + pure
+# arithmetic; the runtime heap witness (ML006) rides hostkv-smoke and
+# disagg-smoke.
+memlint: ## Memory & capacity contract analysis (stdlib-only)
+	$(PYTHON) -m polykey_tpu.analysis mem
 
 ASAN_FLAGS := -g -O1 -fsanitize=address,undefined -fno-omit-frame-pointer
 
@@ -298,10 +317,11 @@ scan: ## Security scan (Trivy fs over the tree + lockfile, CRITICAL/HIGH gate)
 	  --scanners vuln,secret \
 	  --severity CRITICAL,HIGH
 
-ci-check: ## Run the CI pipeline locally: lint+polylint+racelint+graphlint, chaos, failover, disagg(+lock-witness gate), postmortem, occupancy, ragged, hostkv, obs, perf-gate, tests, native(+asan), scan
+ci-check: ## Run the CI pipeline locally: lint+polylint+racelint+graphlint+memlint, chaos, failover, disagg(+lock/heap-witness gates), postmortem, occupancy, ragged, hostkv(+heap-witness gate), obs, perf-gate, tests, native(+asan), scan
 	@$(MAKE) lint
 	@$(MAKE) racelint
 	@$(MAKE) graphlint
+	@$(MAKE) memlint
 	@$(MAKE) chaos-smoke
 	@$(MAKE) failover-smoke
 	@$(MAKE) disagg-smoke

@@ -718,7 +718,9 @@ def bench_engine(
         # roofline. On CPU mbu/mfu stay null but the per-token geometry
         # still lands. Accounting must never fail a measured phase.
         try:
-            from polykey_tpu.engine.roofline import detect_chip, grade
+            from polykey_tpu.engine.roofline import (
+                detect_chip, grade, kv_pool_bytes_spec)
+            from polykey_tpu.models.config import get_config
 
             kwargs = dict(
                 model=engine_cfg.model,
@@ -738,6 +740,13 @@ def bench_engine(
                 chip=detect_chip(),
                 draft_model=(engine_cfg.draft_model
                              if draft_params is not None else None),
+                # Device KV pool + int8 scale planes: grade() folds these
+                # into hbm_resident_fraction (weights-only
+                # hbm_weight_fraction is unchanged for replay parsing).
+                kv_pool_bytes=kv_pool_bytes_spec(
+                    get_config(engine_cfg.model), engine_cfg.num_pages,
+                    engine_cfg.page_size,
+                    engine_cfg.kv_dtype or engine_cfg.dtype),
             )
             # Phases whose EngineConfig understates the physics (E passes
             # pre-quantized params with quantize=False) correct it here.

@@ -34,3 +34,14 @@ if _os.environ.get("POLYKEY_LOCK_WITNESS", "") == "1":
     from .analysis import witness as _witness
 
     _witness.maybe_install()
+
+# Runtime heap witness (memlint's dynamic half, ISSUE 17): with
+# POLYKEY_HEAP_WITNESS=1, tracemalloc starts here — before jax and the
+# model registries import — so their allocation sites are attributed,
+# and soak checkpoints record labeled heap + pool-occupancy samples,
+# dumped per-process at exit for `python -m polykey_tpu.analysis mem
+# --witness`. Same gating shape as the lock witness above.
+if _os.environ.get("POLYKEY_HEAP_WITNESS", "") == "1":
+    from .analysis import heapwitness as _heapwitness
+
+    _heapwitness.maybe_install()

@@ -18,9 +18,10 @@ Usage::
     python -m polykey_tpu.analysis --prune            # drop stale baseline
     python -m polykey_tpu.analysis graph              # graphlint (2nd tier)
     python -m polykey_tpu.analysis race               # racelint (3rd tier)
+    python -m polykey_tpu.analysis mem                # memlint (4th tier)
     python -m polykey_tpu.analysis all                # every tier, one exit
 
-Three tiers, one discipline (per-tier baselines that trend toward
+Four tiers, one discipline (per-tier baselines that trend toward
 empty, mandatory-reason suppressions, content-hashed fingerprints):
 
 - **polylint** (``rules.py``, PL***) — what the *source* promises:
@@ -39,13 +40,24 @@ empty, mandatory-reason suppressions, content-hashed fingerprints):
   an opt-in runtime witness (``witness.py``, POLYKEY_LOCK_WITNESS=1)
   that merges *observed* acquisition-order edges — with stacks — into
   the static graph (``race --witness``).
+- **memlint** (``memory.py``, ML***) — what the *bytes* do: an
+  analytic capacity ledger (weights + device KV pool + int8 scale
+  planes + largest jit transient, with donation aliasing credits) that
+  must fit ``ChipSpec.hbm_bytes`` for every served-matrix entry,
+  unbounded-growth rules over long-lived containers, and the
+  ``POLYKEY_*`` knob contracts (documented in DEPLOY.md, single parse
+  site, shipped to disagg workers via ``_config_env``). Stdlib-only,
+  with an opt-in runtime heap witness (``heapwitness.py``,
+  POLYKEY_HEAP_WITNESS=1) that merges *observed* tracemalloc growth
+  and pool occupancies into the findings (``mem --witness``).
 
 Per-line suppression (reason required; reasonless or unused suppressions
 are themselves findings; the rule id's prefix names the tier that
-validates it, so PL and CL entries never cross-fire)::
+validates it, so PL/CL/ML entries never cross-fire)::
 
     packed = np.asarray(data)  # polylint: disable=PL001(resolve point)
     self._closing = True  # polylint: disable=CL002(one-way latch)
+    self._sticky[k] = v  # polylint: disable=ML002(EWMA per replica id)
 
 The package is stdlib-only by design: the CI lint job installs ruff and
 nothing else, and ``python -m polykey_tpu.analysis`` must run there.

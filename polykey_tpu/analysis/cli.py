@@ -16,7 +16,13 @@ protocol contracts — lock-order cycles, unguarded shared state,
 lock-scope escapes, interprocedural blocking-under-lock, and
 coordinator/worker protocol conformance. Stdlib-only like this tier.
 
-``python -m polykey_tpu.analysis all`` runs all three tiers with one
+``python -m polykey_tpu.analysis mem`` dispatches to the fourth tier
+(memlint, analysis/memory.py): memory & capacity contracts — the
+analytic byte ledger vs chip HBM, unbounded-growth AST rules, knob
+documentation/ship contracts, and the runtime heap-witness merge.
+Stdlib-only like this tier.
+
+``python -m polykey_tpu.analysis all`` runs all four tiers with one
 aggregate exit code (and one merged JSON object under ``--json``).
 """
 
@@ -81,16 +87,16 @@ def build_parser() -> argparse.ArgumentParser:
 
 def run_all(argv: list[str]) -> int:
     """``python -m polykey_tpu.analysis all [--json]``: polylint +
-    racelint + graphlint as one gate. Each tier runs its full default
-    sweep against its own committed baseline; the exit code is clean
-    only when every tier is. Tier-specific flags (--only, --prune,
+    racelint + graphlint + memlint as one gate. Each tier runs its full
+    default sweep against its own committed baseline; the exit code is
+    clean only when every tier is. Tier-specific flags (--only, --prune,
     --write-baseline, targets) are refused — partial aggregate runs
     would report 'all clean' while skipping debt (the graphlint --only
     precedent, applied across tiers)."""
     parser = argparse.ArgumentParser(
         prog="python -m polykey_tpu.analysis all",
         description="run every analysis tier (polylint + racelint + "
-                    "graphlint) with one aggregate exit code",
+                    "graphlint + memlint) with one aggregate exit code",
     )
     parser.add_argument("--root", default=".",
                         help="repo root for every tier (default: cwd)")
@@ -101,12 +107,13 @@ def run_all(argv: list[str]) -> int:
     import contextlib
     import io
 
-    from . import concurrency, graph
+    from . import concurrency, graph, memory
 
     tiers = (
         ("polylint", main),
         ("racelint", concurrency.main),
         ("graphlint", graph.main),
+        ("memlint", memory.main),
     )
     results: dict[str, dict] = {}
     codes: dict[str, int] = {}
@@ -157,6 +164,12 @@ def main(argv: list[str] | None = None) -> int:
         from . import concurrency
 
         return concurrency.main(argv[1:])
+    if argv and argv[0] == "mem":
+        # memlint is stdlib-only but imports engine.config/roofline for
+        # the byte ledger; keep it off the base tier's import path.
+        from . import memory
+
+        return memory.main(argv[1:])
     if argv and argv[0] == "all":
         return run_all(argv[1:])
     args = build_parser().parse_args(argv)

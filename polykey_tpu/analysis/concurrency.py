@@ -626,6 +626,7 @@ class RaceAnalyzer:
     def types_for(self, fn: FuncInfo) -> dict[str, str]:
         cached = self._types.get(fn.key)
         if cached is None:
+            # polylint: disable=ML002(memo keyed by function identity: bounded by the scanned repo, analyzer lives one run)
             cached = self._types[fn.key] = self.project.local_types(fn)
         return cached
 
@@ -761,6 +762,7 @@ class RaceAnalyzer:
                                     (fn.label,),
                                 )
                             elif not self.project.is_rlock(lock):
+                                # polylint: disable=ML002(findings list: bounded by acquire sites in the scanned repo, analyzer lives one run)
                                 self.self_deadlocks.append((
                                     lock, fn.rel, node.lineno, fn.label,
                                 ))
@@ -787,6 +789,7 @@ class RaceAnalyzer:
         existing = self.edges.get(key)
         if existing is None or (path, line) < (existing["path"],
                                                existing["line"]):
+            # polylint: disable=ML002(lock-order edge set: bounded by lock-class pairs in the scanned repo, analyzer lives one run)
             self.edges[key] = {
                 "path": path, "line": line,
                 "via": " -> ".join(chain),
@@ -832,6 +835,7 @@ class RaceAnalyzer:
             mapped = site_to_lock.get(site)
             if mapped is not None:
                 return mapped
+            # polylint: disable=ML002(bounded by distinct witness sites in one merged run, analyzer lives one run)
             self.witness_unmapped.setdefault(
                 site, witness_data.get("sites", {}).get(site, {}))
             return f"witness::{site}"
@@ -846,6 +850,7 @@ class RaceAnalyzer:
                 "count": edge.get("count", 0),
                 "stack": edge.get("stack") or [],
             }
+            # polylint: disable=ML002(bounded by witness edge pairs in one merged run, analyzer lives one run)
             self.witness_edges[key] = info
             static = self.edges.get(key)
             if static is not None:

@@ -31,11 +31,11 @@ SUPPRESS_RE = re.compile(r"polylint:\s*disable=(?P<entries>.+)$")
 # The reason may itself contain one level of balanced parentheses
 # ("async copy (D2H) landed"); deeper nesting is not supported.
 # The rule id's two-letter prefix names the tier that owns it: PL = the
-# AST tier here, CL = racelint (analysis/concurrency.py). One comment
-# syntax serves every line-anchored tier; each tier validates only the
-# suppressions in its own namespace, so a CL004 annotation in engine
-# code is invisible to a plain polylint run instead of an "unknown
-# rule" finding.
+# AST tier here, CL = racelint (analysis/concurrency.py), ML = memlint
+# (analysis/memory.py). One comment syntax serves every line-anchored
+# tier; each tier validates only the suppressions in its own namespace,
+# so a CL004 annotation in engine code is invisible to a plain polylint
+# run instead of an "unknown rule" finding.
 ENTRY_RE = re.compile(
     r"(?P<rule>[A-Z]{2}\d{3})\s*"
     r"(?:\((?P<reason>[^()]*(?:\([^()]*\)[^()]*)*)\))?"
@@ -44,7 +44,7 @@ ENTRY_RE = re.compile(
 # entry outside this set (a typo'd prefix, or GL — the graph tier
 # suppresses via class-level SUPPRESSIONS, not comments) suppresses
 # nothing; the base PL tier reports it so it can't sit dead forever.
-LINE_TIER_PREFIXES = frozenset({"PL", "CL"})
+LINE_TIER_PREFIXES = frozenset({"PL", "CL", "ML"})
 
 
 @dataclass

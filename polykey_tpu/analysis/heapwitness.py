@@ -1,0 +1,218 @@
+"""Runtime heap witness: the dynamic half of memlint's growth rules.
+
+Static unbounded-growth analysis (analysis/memory.py, ML002) reasons
+about container *shape* — it cannot see how fast a deliberately
+unbounded structure actually grows, and it cannot see growth hiding in
+C extensions or closures. This module records what actually happened:
+with ``POLYKEY_HEAP_WITNESS=1`` in the environment, ``tracemalloc``
+starts at import and soak harnesses (plus the engine block loop) call
+:func:`checkpoint` at round boundaries. Each checkpoint snapshots the
+traced Python heap (current/peak), the top allocating files, and —
+when the caller passes them — the ledger-declared pool occupancies
+(device KV pages, host-tier pages, prefix-store batches), so observed
+pool usage can be checked against the static ledger's declared
+capacity. The series dumps as JSON at process exit (and on demand),
+one file per process under ``POLYKEY_HEAP_WITNESS_OUT`` (a directory —
+the disagg drill spans several worker processes).
+
+``python -m polykey_tpu.analysis mem --witness <file-or-dir>`` merges
+these series into the static findings: sustained heap growth after
+warmup becomes an ML006 finding carrying the top-growing allocation
+sites (real evidence from a real run), and a pool observed above its
+declared capacity becomes an ML006 capacity violation.
+
+Approximations (documented, same contract as the lock witness):
+
+- tracemalloc sees Python allocations only. Device HBM is the static
+  ledger's job (ML001); native buffers (numpy data, jax executables)
+  appear as a single opaque allocation at their Python call site,
+  which is exactly the attribution the finding needs.
+- A process killed with ``os._exit`` (the worker-exit fault's real
+  mode) never dumps — the drill's witness comes from the coordinator
+  and the surviving workers.
+- The first checkpoints of a process include import/compile warmup;
+  the merge analysis discards the warmup prefix before fitting growth
+  (see memory.py's ``_witness_growth``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import tracemalloc
+
+HEAP_WITNESS_VERSION = 1
+ENV_FLAG = "POLYKEY_HEAP_WITNESS"
+ENV_OUT = "POLYKEY_HEAP_WITNESS_OUT"
+DEFAULT_OUT = "/tmp/polykey-heap-witness"
+
+# The witness itself must obey the discipline it audits: the checkpoint
+# series is a hard ring (oldest dropped), and the per-checkpoint top-site
+# list is truncated.
+_MAX_CHECKPOINTS = 4096
+_TOP_SITES = 12
+# Engine-loop checkpoints (heartbeat()) self-throttle so an idle spin
+# can't flood the ring with identical samples.
+_MIN_HEARTBEAT_S = 1.0
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+
+
+def _relpath(filename: str) -> str:
+    absolute = os.path.abspath(filename)
+    if absolute.startswith(_REPO_ROOT + os.sep):
+        return absolute[len(_REPO_ROOT) + 1:].replace(os.sep, "/")
+    return absolute.replace(os.sep, "/")
+
+
+class _Recorder:
+    def __init__(self) -> None:
+        self.t0 = time.monotonic()
+        self.checkpoints: list[dict] = []
+        self.dropped = 0
+        self._last_heartbeat = 0.0
+
+    def checkpoint(self, label: str, pools: dict | None = None) -> dict:
+        current, peak = tracemalloc.get_traced_memory()
+        top: list[dict] = []
+        try:
+            stats = tracemalloc.take_snapshot().statistics("filename")
+            for st in stats[:_TOP_SITES]:
+                frame = st.traceback[0]
+                top.append({
+                    "file": _relpath(frame.filename),
+                    "bytes": int(st.size),
+                    "blocks": int(st.count),
+                })
+        except Exception:
+            pass  # a failed snapshot must never fail the run
+        entry = {
+            "label": label,
+            "elapsed_s": round(time.monotonic() - self.t0, 3),
+            "traced_current": int(current),
+            "traced_peak": int(peak),
+            "top": top,
+        }
+        if pools:
+            entry["pools"] = dict(pools)
+        self.checkpoints.append(entry)
+        if len(self.checkpoints) > _MAX_CHECKPOINTS:
+            del self.checkpoints[0]
+            self.dropped += 1
+        return entry
+
+    def snapshot(self) -> dict:
+        return {
+            "version": HEAP_WITNESS_VERSION,
+            "pid": os.getpid(),
+            "argv0": _relpath(sys.argv[0]) if sys.argv else "",
+            "checkpoints": list(self.checkpoints),
+            "dropped_checkpoints": self.dropped,
+        }
+
+
+_recorder: _Recorder | None = None
+
+
+def install() -> None:
+    """Start tracemalloc and register the exit-time dump. Idempotent."""
+    global _recorder
+    if _recorder is not None:
+        return
+    if not tracemalloc.is_tracing():
+        tracemalloc.start()
+    _recorder = _Recorder()
+    import atexit
+
+    atexit.register(dump)
+
+
+def maybe_install() -> bool:
+    """install() iff POLYKEY_HEAP_WITNESS=1; returns whether installed."""
+    if os.environ.get(ENV_FLAG, "") == "1":
+        install()
+        return True
+    return False
+
+
+def installed() -> bool:
+    return _recorder is not None
+
+
+def checkpoint(label: str, pools: dict | None = None) -> None:
+    """Record one labeled heap sample (no-op unless installed). `pools`
+    carries observed allocator occupancies keyed by pool name, each a
+    ``{"used": n, "capacity": n}`` pair in the pool's native unit
+    (pages, batches) so the merge can compare against the declared cap."""
+    if _recorder is not None:
+        _recorder.checkpoint(label, pools)
+
+
+def heartbeat(label: str = "engine-block") -> None:
+    """Throttled checkpoint for hot loops: records at most one sample
+    per _MIN_HEARTBEAT_S, so the engine block loop can call this
+    unconditionally when the witness is armed."""
+    rec = _recorder
+    if rec is None:
+        return
+    now = time.monotonic()
+    if now - rec._last_heartbeat >= _MIN_HEARTBEAT_S:
+        rec._last_heartbeat = now
+        rec.checkpoint(label)
+
+
+def snapshot() -> dict:
+    if _recorder is None:
+        return {"version": HEAP_WITNESS_VERSION, "pid": os.getpid(),
+                "argv0": "", "checkpoints": [], "dropped_checkpoints": 0}
+    return _recorder.snapshot()
+
+
+def dump(out: str | None = None) -> str | None:
+    """Write this process's witness JSON. `out` (or
+    $POLYKEY_HEAP_WITNESS_OUT, default /tmp/polykey-heap-witness) is a
+    DIRECTORY; the file is heap_witness_<pid>.json so concurrent worker
+    processes never clobber each other. Returns the written path (None
+    when not installed)."""
+    if _recorder is None:
+        return None
+    directory = out or os.environ.get(ENV_OUT, DEFAULT_OUT)
+    try:
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, f"heap_witness_{os.getpid()}.json")
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(snapshot(), f, indent=2, sort_keys=True)
+            f.write("\n")
+        return path
+    except OSError:
+        return None  # a failed witness dump must never fail the run
+
+
+def load_witness(path: str) -> list[dict]:
+    """Load one witness file, or every heap_witness_*.json in a
+    directory (the multi-process drill). Returns a list of per-process
+    snapshots; raises ValueError on an unreadable/mismatched file."""
+    if os.path.isdir(path):
+        files = sorted(
+            os.path.join(path, name) for name in os.listdir(path)
+            if name.startswith("heap_witness_") and name.endswith(".json")
+        )
+        if not files:
+            raise ValueError(f"no heap_witness_*.json files under {path}")
+    else:
+        files = [path]
+    out: list[dict] = []
+    for name in files:
+        with open(name, encoding="utf-8") as f:
+            data = json.load(f)
+        if data.get("version") != HEAP_WITNESS_VERSION:
+            raise ValueError(
+                f"heap witness file {name} has version "
+                f"{data.get('version')!r}, expected {HEAP_WITNESS_VERSION}"
+            )
+        out.append(data)
+    return out

@@ -752,11 +752,11 @@ class DisaggPool:
                 if blob is None:
                     prefill_worker = self._wait_for_worker(PREFILL, skey)
                     if prefill_worker is None:
+                        self._count("aborted")
                         request.out.put((
                             "error",
                             "engine: no serving prefill-tier worker",
                         ))
-                        self._count("aborted")
                         return
                     blob, meta, source = self._run_prefill(
                         prefill_worker, request, handoff_id, skey
@@ -765,16 +765,15 @@ class DisaggPool:
                     DECODE, skey, payload_bytes=len(blob)
                 )
                 if decode_worker is None:
+                    self._count("aborted")
                     request.out.put((
                         "error", "engine: no serving decode-tier worker",
                     ))
-                    self._count("aborted")
                     return
                 delivered = self._run_decode(
                     decode_worker, request, blob, meta, delivered, source,
                     t_handoff,
                 )
-                self._count("ok")
                 self._release(source, handoff_id)
                 return
             except _HandoffRetry as e:
@@ -1097,6 +1096,10 @@ class DisaggPool:
                         )
                         self._graft_worker_trace(request, worker,
                                                  event.get("trace"))
+                        # Count BEFORE delivering the terminal event: a
+                        # client that consumes "done" and immediately
+                        # reads stats() must see this handoff as ok.
+                        self._count("ok")
                         request.out.put(("done", timings))
                         return delivered
                     elif kind == "error":
@@ -1404,6 +1407,12 @@ def _config_env(config: EngineConfig) -> dict:
         "POLYKEY_TIMELINE_CAPACITY": str(config.timeline_capacity),
         "POLYKEY_BLACKBOX_EVERY": str(config.blackbox_every),
         "POLYKEY_SIGNALS_INTERVAL": str(config.signals_interval_s),
+        # Signal-plane policy (found by memlint ML005): a programmatic
+        # pool with custom windows or an SLO must not spawn workers
+        # that silently evaluate the defaults — burn rates would
+        # disagree across tiers for the same traffic.
+        "POLYKEY_SIGNALS_WINDOWS": config.signals_windows,
+        "POLYKEY_SLO": config.slo_policy,
         "POLYKEY_TOP_P_CANDIDATES": str(config.top_p_candidates),
         "POLYKEY_WATCHDOG_TIMEOUT": str(config.watchdog_timeout_s),
         "POLYKEY_REQUEST_TIMEOUT": str(config.request_timeout_s),

@@ -1058,6 +1058,7 @@ class InferenceEngine:
 
         self._inflight_q: deque = deque()
         try:
+            # polylint: disable=ML004(documented operator override: env beats any programmatic config, see comment above)
             self._depth = max(1, int(os.environ.get(
                 "POLYKEY_DISPATCH_LOOKAHEAD", config.lookahead_blocks
             )))
@@ -1347,8 +1348,14 @@ class InferenceEngine:
             if trace:
                 tacc[key] = tacc.get(key, 0.0) + (time.monotonic() - t0)
 
+        # Heap-witness heartbeat (memlint ML006): bound once outside the
+        # loop; heartbeat() self-throttles to ~1 Hz and is a no-op
+        # unless POLYKEY_HEAP_WITNESS armed the witness at import.
+        from ..analysis.heapwitness import heartbeat as _heap_heartbeat
+
         try:
             while not self._stop.is_set():
+                _heap_heartbeat()
                 if trace:
                     tacc["iters"] += 1
                     if tacc["iters"] % 100 == 0:

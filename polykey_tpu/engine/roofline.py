@@ -161,6 +161,29 @@ def kv_bytes_per_token(cfg: ModelConfig, kv_dtype: str) -> float:
             * _bytes_per_el(kv_dtype))
 
 
+def kv_pool_bytes_split(cfg: ModelConfig, num_pages: int, page_size: int,
+                        kv_dtype: str) -> tuple[float, float]:
+    """(value_bytes, scale_bytes) the preallocated paged KV pool occupies
+    in HBM. Mirrors kv_cache.init_paged_kv's allocation exactly (a test
+    pins the two byte-for-byte): int8 pools carry a bf16 scale per
+    (k|v, head, token slot) alongside the int8 values; wider dtypes have
+    no scale plane. Pure model/geometry arithmetic — memlint's capacity
+    ledger calls this without importing jax."""
+    slots = 2.0 * cfg.num_layers * num_pages * page_size  # k + v planes
+    if kv_dtype == "int8":
+        return (slots * cfg.num_kv_heads * cfg.head_dim * 1.0,
+                slots * cfg.num_kv_heads * 2.0)
+    return (slots * cfg.num_kv_heads * cfg.head_dim
+            * _bytes_per_el(kv_dtype), 0.0)
+
+
+def kv_pool_bytes_spec(cfg: ModelConfig, num_pages: int, page_size: int,
+                       kv_dtype: str) -> float:
+    """Total paged-pool bytes (values + int8 scale planes)."""
+    values, scales = kv_pool_bytes_split(cfg, num_pages, page_size, kv_dtype)
+    return values + scales
+
+
 def decode_flops_per_token(cfg: ModelConfig, ctx: float) -> float:
     """MatMul FLOPs to decode one token at context length ctx."""
     attn_scores = 4.0 * cfg.num_layers * ctx * cfg.num_heads * cfg.head_dim
@@ -179,7 +202,8 @@ def grade(model: str, dtype: str, quantize: bool, quantize_bits: int,
           prompt_len: Optional[int] = None,
           chip: Optional[ChipSpec] = None,
           draft_model: Optional[str] = None,
-          n_chips: int = 1, assumed_lanes: float = 1.0) -> dict:
+          n_chips: int = 1, assumed_lanes: float = 1.0,
+          kv_pool_bytes: Optional[float] = None) -> dict:
     """Physics scorecard for one measured phase.
 
     Always emits the per-token geometry (bytes_per_token, flops_per_token
@@ -230,6 +254,15 @@ def grade(model: str, dtype: str, quantize: bool, quantize_bits: int,
                 get_config(draft_model), dtype, quantize, quantize_bits)
         out["hbm_weight_fraction"] = round(
             resident / (n_chips * chip.hbm_bytes), 4)
+        if kv_pool_bytes is not None:
+            # Full capacity statement (memlint's ML001 ledger): weights
+            # PLUS the preallocated paged KV pool and its int8 scale
+            # planes. hbm_weight_fraction keeps its weights-only meaning
+            # so committed artifacts and BENCH replay parsing stay valid;
+            # the extended accounting lands as new sibling keys.
+            out["hbm_kv_pool_bytes"] = round(kv_pool_bytes)
+            out["hbm_resident_fraction"] = round(
+                (resident + kv_pool_bytes) / (n_chips * chip.hbm_bytes), 4)
     if chip is not None and tok_s > 0:
         hbm_bw = n_chips * chip.hbm_bytes_per_s
         peak = n_chips * chip.peak_bf16_flops

@@ -84,6 +84,12 @@ from .watchdog import Watchdog
 # without bound — oldest entries fall off.
 _RETAIN_CAP = 64
 
+# Warm-session index cap (memlint ML002): the persisted index always
+# truncated to the newest 512 sessions, but the in-memory OrderedDict
+# grew one key per session for the worker's lifetime — bound both to
+# the same LRU window so they can't diverge.
+_WARM_KEYS_CAP = 512
+
 
 def session_key(prompt_ids: np.ndarray, page_size: int) -> str:
     """Session identity for sticky routing: a hash of the prompt's first
@@ -311,9 +317,11 @@ class WorkerServer:
         # slow engine teardown: the coordinator's terminate() follow-up
         # beats both atexit and a post-shutdown dump (no-op unless
         # POLYKEY_LOCK_WITNESS armed the witness at import).
-        from ..analysis import witness as lock_witness
+        from ..analysis import heapwitness, witness as lock_witness
 
         lock_witness.dump()
+        heapwitness.checkpoint("worker-stop")
+        heapwitness.dump()
         if self.supervisor is not None:
             self.supervisor.stop()
         self.watchdog.stop()
@@ -385,6 +393,8 @@ class WorkerServer:
             with open(path) as f:
                 for key in json.load(f).get("sessions", []):
                     self._warm_keys[str(key)] = True
+                while len(self._warm_keys) > _WARM_KEYS_CAP:
+                    self._warm_keys.popitem(last=False)
         except (OSError, ValueError):
             pass  # a corrupt index only costs warmth, never liveness
 
@@ -397,7 +407,8 @@ class WorkerServer:
             tmp = path + ".tmp"
             with open(tmp, "w") as f:
                 json.dump(
-                    {"sessions": list(self._warm_keys)[-512:]}, f
+                    {"sessions": list(self._warm_keys)[-_WARM_KEYS_CAP:]},
+                    f,
                 )
             os.replace(tmp, path)
         except OSError:
@@ -467,9 +478,12 @@ class WorkerServer:
                     # Witness dump BEFORE the ack: the coordinator
                     # terminates this process right after the reply
                     # lands, and SIGTERM runs no atexit hooks.
-                    from ..analysis import witness as lock_witness
+                    from ..analysis import heapwitness, \
+                        witness as lock_witness
 
                     lock_witness.dump()
+                    heapwitness.checkpoint("worker-exit")
+                    heapwitness.dump()
                     send_msg(conn, {"ok": True})
                     threading.Thread(target=self.stop, daemon=True).start()
                     return
@@ -506,7 +520,7 @@ class WorkerServer:
             "queue_delay_s": engine.queue_delay_estimate_s(),
             "load": engine.load_fraction(),
             "retained_handoffs": len(self._retained),
-            "warm_sessions": list(self._warm_keys)[-512:],
+            "warm_sessions": list(self._warm_keys)[-_WARM_KEYS_CAP:],
             # Host-KV tier warmth advertisement (ISSUE 15): how much
             # cold-but-warm state this worker holds (host-resident pages
             # restore in ~ms; a cold recompute costs a full prefill) —
@@ -628,6 +642,8 @@ class WorkerServer:
                     key = session_key(value.prompt_ids, value.page_size)
                     self._warm_keys[key] = True
                     self._warm_keys.move_to_end(key)
+                    while len(self._warm_keys) > _WARM_KEYS_CAP:
+                        self._warm_keys.popitem(last=False)
                     persist_index = True
                     timeline = getattr(self.engine, "timeline", None)
                     if timeline is not None:

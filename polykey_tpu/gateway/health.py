@@ -35,6 +35,7 @@ class HealthService(HealthServicer):
         with self._cond:
             if self._shutdown:
                 return
+            # polylint: disable=ML002(keyed by registered service name: a handful of static strings, not per-request data)
             self._statuses[service] = status
             self._cond.notify_all()
 

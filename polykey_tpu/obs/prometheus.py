@@ -160,6 +160,7 @@ class Counter:
             raise ValueError("counters only go up")
         key = self._key(labels)
         with self._lock:
+            # polylint: disable=ML002(prometheus-client contract: label-set cardinality is a declared operator responsibility, the label vocab is static)
             self._values[key] = self._values.get(key, 0) + amount
 
     def value(self, **labels) -> float:
@@ -243,7 +244,9 @@ class Registry:
         with self._lock:
             if metric.name in self._names:
                 raise ValueError(f"duplicate metric name {metric.name!r}")
+            # polylint: disable=ML002(registration is import/startup-time only: bounded by metric definitions in the codebase)
             self._names.add(metric.name)
+            # polylint: disable=ML002(registration is import/startup-time only: bounded by metric definitions in the codebase)
             self._metrics.append(metric)
 
     def get(self, name: str):
@@ -291,6 +294,7 @@ class Registry:
 
     def register_collector(self, fn: Callable[[], list[str]]) -> None:
         with self._lock:
+            # polylint: disable=ML002(registration is import/startup-time only: bounded by collector definitions in the codebase)
             self._collectors.append(fn)
 
     def render(self, openmetrics: bool = False) -> str:

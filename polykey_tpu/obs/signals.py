@@ -122,6 +122,7 @@ def windows_from_spec(spec: str) -> tuple[float, ...]:
 
 
 def windows_from_env() -> tuple[float, ...]:
+    # polylint: disable=ML004(fallback when no EngineConfig exists (standalone plane); the engine passes config.signals_windows through)
     return windows_from_spec(os.environ.get(ENV_WINDOWS, ""))
 
 
@@ -269,6 +270,7 @@ class SloPolicy:
 
     @classmethod
     def from_env(cls) -> Optional["SloPolicy"]:
+        # polylint: disable=ML004(fallback when no EngineConfig exists (standalone plane); the engine passes config.slo_policy through)
         return cls.from_spec(os.environ.get(ENV_POLICY, ""))
 
 

@@ -196,6 +196,7 @@ def mesh_from_env(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
         name: int(os.environ.get(f"POLYKEY_{name.upper()}", "0") or 0)
         for name in AXIS_NAMES
     }
+    # polylint: disable=ML004(mesh bootstrap runs before any EngineConfig exists; from_env later reads the same env)
     num_slices = int(os.environ.get("POLYKEY_NUM_SLICES", "1") or 1)
     known = 1
     for v in axes.values():

@@ -519,7 +519,32 @@ def run_hostkv_main(args) -> int:
 
     from polykey_tpu.engine.config import EngineConfig
     from polykey_tpu.engine.engine import GenRequest, InferenceEngine
-    from polykey_tpu.engine.roofline import CHIP_SPECS, grade
+    from polykey_tpu.analysis import heapwitness
+    from polykey_tpu.engine.roofline import (
+        CHIP_SPECS,
+        grade,
+        kv_pool_bytes_spec,
+    )
+    from polykey_tpu.models.config import get_config as _model_config
+
+    def _heap_checkpoint(label: str, engine) -> None:
+        # Observed pool occupancy vs declared capacity rides every
+        # heap sample, so `mem --witness` can catch the allocator
+        # drifting past the ledger (ML006) — no-op unless
+        # POLYKEY_HEAP_WITNESS armed the witness.
+        if not heapwitness.installed():
+            return
+        st = engine.stats()
+        heapwitness.checkpoint(label, pools={
+            "device_kv_pages": {
+                "used": st["kv_device_pages"],
+                "capacity": engine.config.num_pages - 1,
+            },
+            "host_kv_pages": {
+                "used": st["kv_host_pages"],
+                "capacity": st["kv_host_capacity"],
+            },
+        })
     from polykey_tpu.engine.supervisor import EngineSupervisor
 
     page_size = 16
@@ -584,6 +609,7 @@ def run_hostkv_main(args) -> int:
             if round_idx == measured_round:
                 continue   # consumed by the post-restart measurement
             streams.update(_hk_run_turns(sup.engine, jobs, max_new))
+            _heap_checkpoint(f"hostkv-round-{round_idx}", sup.engine)
             if round_idx == restart_round:
                 # --- supervised restart mid-soak: quiesced crash (the
                 # bare supervisor's recovery unit is the engine; the
@@ -632,6 +658,8 @@ def run_hostkv_main(args) -> int:
                     engine.submit(r)
                     _, timings = _hk_collect(r)
                     cold_ttfts.append(timings.ttft_ms)
+                _heap_checkpoint("hostkv-post-restart", sup.engine)
+        _heap_checkpoint("hostkv-final", sup.engine)
         stats = sup.engine.stats()
         hist = sup.engine.metrics.kv_restore_hist
         counts, hist_sum = hist.counts_snapshot()
@@ -677,14 +705,23 @@ def run_hostkv_main(args) -> int:
         model=args.model, dtype="float32", quantize=False, quantize_bits=8,
         kv_dtype=args.kv_dtype, tok_s=0.0, avg_lanes=None,
         avg_ctx=final_len, chip=CHIP_SPECS["tpu-v5e"],
+        kv_pool_bytes=kv_pool_bytes_spec(
+            _model_config(args.model), num_pages, page_size,
+            args.kv_dtype or "float32",
+        ),
     )
     # The north-star capacity statement: at llama-3-8b int8 on a 16 GiB
     # v5e, weights pin this fraction of HBM — the complement is the
     # device KV budget the host tier stops being the hard ceiling for.
+    # kv_pool_bytes at the EngineConfig default geometry (2048 pages x
+    # 16 tokens): the resident fraction the ML001 ledger re-derives.
     roof_8b = grade(
         model="llama-3-8b", dtype="bfloat16", quantize=True,
         quantize_bits=8, kv_dtype="int8", tok_s=0.0, avg_lanes=None,
         avg_ctx=4096, chip=CHIP_SPECS["tpu-v5e"],
+        kv_pool_bytes=kv_pool_bytes_spec(
+            _model_config("llama-3-8b"), 2048, 16, "int8",
+        ),
     )
     result = {
         "mode": "host_kv",

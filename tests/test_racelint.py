@@ -878,11 +878,13 @@ def test_all_aggregates_tiers(tmp_path, capsys, monkeypatch):
     monkeypatch.setattr(graph, "main", fake_graph_main)
     (tmp_path / "polykey_tpu").mkdir()
     (tmp_path / "polykey_tpu" / "clean.py").write_text("x = 1\n")
+    (tmp_path / "DEPLOY.md").write_text("")   # memlint's ML003 input
     rc = cli_main(["all", "--root", str(tmp_path), "--json"])
     payload = json.loads(capsys.readouterr().out)
     assert rc == 0
     assert calls        # graph tier was dispatched
-    assert set(payload["tiers"]) == {"polylint", "racelint", "graphlint"}
+    assert set(payload["tiers"]) == {"polylint", "racelint", "graphlint",
+                                     "memlint"}
     assert payload["summary"]["all_clean"] is True
 
     # A blocking finding in ANY tier fails the aggregate.

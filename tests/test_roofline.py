@@ -143,6 +143,42 @@ def test_grade_hbm_weight_fraction():
     assert "hbm_weight_fraction" not in g_cpu
 
 
+def test_grade_resident_fraction_extends_without_breaking_replay():
+    """ISSUE 17: passing the pool bytes folds device KV + scale pools
+    into a full-residency fraction as NEW sibling fields —
+    hbm_weight_fraction keeps its weights-only meaning and committed
+    BENCH artifacts (graded without the pool) replay with the same
+    schema."""
+    from polykey_tpu.engine.roofline import kv_pool_bytes_spec
+    from polykey_tpu.models.config import get_config
+
+    spec = CHIP_SPECS["tpu-v5e"]
+    base = grade("llama-3-8b", "bfloat16", True, 8, "int8",
+                 tok_s=100.0, avg_lanes=8, avg_ctx=192, chip=spec)
+    assert "hbm_resident_fraction" not in base     # replay-compatible
+    assert "hbm_kv_pool_bytes" not in base
+    pool = kv_pool_bytes_spec(get_config("llama-3-8b"), 2048, 16, "int8")
+    g = grade("llama-3-8b", "bfloat16", True, 8, "int8",
+              tok_s=100.0, avg_lanes=8, avg_ctx=192, chip=spec,
+              kv_pool_bytes=pool)
+    assert g["hbm_weight_fraction"] == base["hbm_weight_fraction"]
+    assert g["hbm_kv_pool_bytes"] == round(pool)
+    assert g["hbm_resident_fraction"] == pytest.approx(
+        g["hbm_weight_fraction"] + pool / spec.hbm_bytes, abs=2e-4)
+    assert g["hbm_resident_fraction"] < 1.0        # the config fits
+    # Multi-chip: the pool shards with the weights.
+    g4 = grade("llama-3-8b", "bfloat16", True, 8, "int8",
+               tok_s=100.0, avg_lanes=8, avg_ctx=192, chip=spec,
+               n_chips=4, kv_pool_bytes=pool)
+    assert g4["hbm_resident_fraction"] == pytest.approx(
+        g["hbm_resident_fraction"] / 4, rel=1e-3)
+    # Off-chip runs still emit no capacity fields at all.
+    g_cpu = grade("tiny-llama", "bfloat16", False, 8, "",
+                  tok_s=100.0, avg_lanes=4, avg_ctx=24, chip=None,
+                  kv_pool_bytes=pool)
+    assert "hbm_resident_fraction" not in g_cpu
+
+
 def test_detect_chip_unknown_kind_returns_none(monkeypatch):
     """An unknown v5 variant (or any unrecognized kind) must NOT grade
     against the v5p roofline (ADVICE r5): only explicit v5e/v5p kinds
