@@ -13,6 +13,7 @@ BUILD_DIR := build
 	failover-smoke failover-soak timeline-capture perf-gate \
 	perf-gate-reference flightwatch ragged-smoke ragged-soak \
 	disagg-smoke disagg-soak hostkv-smoke hostkv-soak \
+	autopilot-smoke autopilot-soak \
 	postmortem postmortem-smoke
 
 help: ## Show available targets
@@ -204,6 +205,32 @@ postmortem: ## Triage a disagg state dir's black boxes (STATE_DIR=...)
 postmortem-smoke: ## Kill a decode worker mid-stream; black boxes must reconstruct the death
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/postmortem_smoke.py
 
+# Autopilot drill (ISSUE 18): the closed control loop armed over a
+# disaggregated pool, a 4x mid-run arrival ramp AND a decode-worker
+# SIGKILL — the controller (tier scale-up + knob actuations, every one
+# a typed autopilot_decision timeline event) plus the pool's own
+# supervision must recover p95 TTFT to within tolerance of the
+# pre-ramp baseline with zero failed RPCs and ZERO human intervention.
+# Smoke scale runs under the heap witness and finishes with the
+# four-tier `analysis all` gate (zero blocking findings).
+autopilot-smoke: ## Ramp+SIGKILL drill at CI scale, controller-only recovery + analysis-all gate + heap-witness gate
+	rm -rf /tmp/polykey-heap-witness-autopilot
+	JAX_PLATFORMS=cpu \
+	  POLYKEY_HEAP_WITNESS=1 \
+	  POLYKEY_HEAP_WITNESS_OUT=/tmp/polykey-heap-witness-autopilot \
+	  $(PYTHON) scripts/autopilot_soak.py \
+	  --prefill 1 --decode 1 --baseline-s 12 --ramp-s 35 --tail-s 10 \
+	  --max-p95-added-ms 45000 \
+	  --out /tmp/autopilot_smoke.json
+	$(PYTHON) -m polykey_tpu.analysis all
+	$(PYTHON) -m polykey_tpu.analysis mem --only ML006 \
+	  --witness /tmp/polykey-heap-witness-autopilot
+
+autopilot-soak: ## The 1+1 -> scaled / 65 s acceptance drill (writes perf/)
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/autopilot_soak.py \
+	  --prefill 1 --decode 1 \
+	  --out perf/autopilot_soak_$$(date -u +%Y%m%d_%H%M%S).json
+
 disagg-soak: ## The 2x2-worker / 30 s acceptance drill (writes perf/)
 	rm -rf /tmp/polykey-lock-witness
 	JAX_PLATFORMS=cpu POLYKEY_LOCK_WITNESS=1 \
@@ -317,7 +344,7 @@ scan: ## Security scan (Trivy fs over the tree + lockfile, CRITICAL/HIGH gate)
 	  --scanners vuln,secret \
 	  --severity CRITICAL,HIGH
 
-ci-check: ## Run the CI pipeline locally: lint+polylint+racelint+graphlint+memlint, chaos, failover, disagg(+lock/heap-witness gates), postmortem, occupancy, ragged, hostkv(+heap-witness gate), obs, perf-gate, tests, native(+asan), scan
+ci-check: ## Run the CI pipeline locally: lint+polylint+racelint+graphlint+memlint, chaos, failover, disagg(+lock/heap-witness gates), postmortem, occupancy, ragged, hostkv(+heap-witness gate), autopilot(+analysis-all gate), obs, perf-gate, tests, native(+asan), scan
 	@$(MAKE) lint
 	@$(MAKE) racelint
 	@$(MAKE) graphlint
@@ -329,6 +356,7 @@ ci-check: ## Run the CI pipeline locally: lint+polylint+racelint+graphlint+memli
 	@$(MAKE) occupancy-smoke
 	@$(MAKE) ragged-smoke
 	@$(MAKE) hostkv-smoke
+	@$(MAKE) autopilot-smoke
 	@$(MAKE) obs-smoke
 	@$(MAKE) perf-gate
 	@$(MAKE) test

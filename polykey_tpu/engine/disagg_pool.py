@@ -119,6 +119,11 @@ class _Worker:
     state: str = NEW
     misses: int = 0
     restarts: int = 0
+    # Elastic scale-down (ISSUE 18): marks a worker the autopilot is
+    # deliberately draining out of the pool — its eventual death is
+    # the PLAN, so _on_worker_down must retire it instead of spending
+    # restart budget respawning it.
+    retiring: bool = False
     restart_times: list = field(default_factory=list)
     ping: dict = field(default_factory=dict)
     stats: dict = field(default_factory=dict)
@@ -204,6 +209,20 @@ class DisaggPool:
         self._seed_rng = np.random.default_rng()
         self._stats_cache: dict = {}
         self._stats_cache_t = 0.0
+        # Autopilot attachment point (ISSUE 18): the running controller
+        # publishes itself here so /debug/slo and /metrics see it; the
+        # knob setpoints it pushed are remembered so a respawned worker
+        # (fresh process, config-default knobs) gets them re-applied.
+        self.autopilot = None
+        self._knob_setpoints: dict = {}
+        # Requests currently parked in _wait_for_worker because their
+        # tier has no SERVING member: token -> wait start. The age of
+        # the oldest waiter is tier_now's queue-delay evidence DURING
+        # an outage, when the dead tier's pings can say nothing — it
+        # lets the controller scale up in parallel with the respawn
+        # instead of discovering the backlog only after it.
+        self._tier_waiters: dict = {PREFILL: {}, DECODE: {}}
+        self._waiter_seq = 0
 
     # -- construction ---------------------------------------------------------
 
@@ -325,6 +344,10 @@ class DisaggPool:
             env.update(_config_env(self.config))
             env["POLYKEY_DISAGG"] = ""          # workers never recurse
             env["POLYKEY_REPLICAS"] = "1"
+            # Workers never run their own control loop: the
+            # coordinator's autopilot actuates them via the knobs op,
+            # and two controllers fighting over one knob diverge.
+            env["POLYKEY_AUTOPILOT"] = "0"
             env["POLYKEY_METRICS_PORT"] = "0"   # no port clash with the
             # gateway's exposition sidecar
             repo_root = os.path.dirname(os.path.dirname(
@@ -438,6 +461,13 @@ class DisaggPool:
         )
 
     def _on_worker_down(self, worker: _Worker, cause: str) -> None:
+        if worker.retiring:
+            # A draining scale-down target dying IS the plan (or close
+            # enough): retire it instead of burning restart budget
+            # respawning capacity the controller just decided to shed.
+            self._transition(worker, DEAD)
+            self._remove_worker(worker)
+            return
         self._transition(worker, DRAINING, only_from=(NEW, SERVING))
         with self._lock:
             if worker.state != DRAINING:
@@ -513,6 +543,7 @@ class DisaggPool:
                 self.tier_restores.get(worker.tier, 0) + 1
             )
         self._absorb_warm_sessions(worker)   # rejoin warm (persisted index)
+        self._push_knobs(worker)             # actuations outlive the respawn
         self._transition(worker, SERVING, only_from=(RESTARTING,))
 
     def _heartbeat_loop(self) -> None:
@@ -538,7 +569,10 @@ class DisaggPool:
                     self._sync_clock(worker, reply, t_send, t_recv)
                     if reply.get("state") == "DEAD":
                         self._transition(worker, DEAD)
-                    elif reply.get("state") == "SERVING":
+                    elif reply.get("state") == "SERVING" and \
+                            not worker.retiring:
+                        # A retiring worker pings healthy all the way
+                        # through its drain — never re-promote it.
                         self._transition(worker, SERVING,
                                          only_from=(NEW, DRAINING))
                 except (OSError, ConnectionError, ValueError):
@@ -565,6 +599,196 @@ class DisaggPool:
         mono = reply.get("mono")
         if isinstance(mono, (int, float)):
             worker.clock.update(t_send, t_recv, float(mono))
+
+    # -- elastic capacity (autopilot actuation surface, ISSUE 18) -------------
+
+    def tier_now(self) -> dict:
+        """Instantaneous per-tier capacity + pressure: the autopilot's
+        scaling evidence. queue_delay_s is the mean across the tier's
+        serving workers' last heartbeat pings; during an outage, when
+        the dead tier's pings can say nothing, the ages of the requests
+        parked in _wait_for_worker join the mean instead. None (never
+        zero) when neither exists: no evidence, no verdict."""
+        out: dict = {}
+        now = time.monotonic()
+        with self._lock:
+            members = {
+                tier: [w for w in self.workers if w.tier == tier]
+                for tier in (PREFILL, DECODE)
+            }
+            waiting = {
+                tier: [now - t0 for t0 in self._tier_waiters[tier].values()]
+                for tier in (PREFILL, DECODE)
+            }
+        for tier, workers in members.items():
+            serving = [w for w in workers if w.state == SERVING]
+            delays = [
+                float(w.ping["queue_delay_s"])
+                for w in serving
+                if w.ping.get("queue_delay_s") is not None
+            ]
+            delays += waiting.get(tier, [])
+            loads = [
+                float(w.ping["load"]) for w in serving
+                if w.ping.get("load") is not None
+            ]
+            out[tier] = {
+                "serving": len(serving),
+                "total": sum(w.state != DEAD for w in workers),
+                "queue_delay_s": (
+                    round(sum(delays) / len(delays), 4) if delays else None
+                ),
+                "load": (
+                    round(sum(loads) / len(loads), 4) if loads else None
+                ),
+            }
+        return out
+
+    def scale_up(self, tier: str) -> Optional[str]:
+        """Grow `tier` by one worker. The new member enters in
+        RESTARTING (the heartbeat skips it until its addr exists) and
+        the seconds-long spawn runs on a background thread — the
+        controller tick must never block on a jax import. Returns the
+        new worker's name, or None when the pool can't spawn."""
+        if self._closing or not hasattr(self, "_seed"):
+            return None   # test-constructed pool: no process factory
+        with self._lock:
+            indices = [w.index for w in self.workers if w.tier == tier]
+            worker = _Worker(
+                tier=tier, index=(max(indices) + 1 if indices else 0),
+                state=RESTARTING,
+            )
+            self.workers.append(worker)
+        # Closure construction only (the actual Popen + ready-wait run
+        # on the _boot thread) — but it lives outside the lock so the
+        # critical section provably never reaches a blocking call.
+        worker.spawn = self._spawner(worker)
+        if self.timeline is not None:
+            self.timeline.note("tier_scale_up", tier=tier,
+                               worker=worker.name)
+
+        def _boot() -> None:
+            try:
+                worker.addr, worker.proc = worker.spawn()
+            except Exception as e:
+                if self.logger is not None:
+                    self.logger.error("tier scale-up spawn failed",
+                                      worker=worker.name, error=str(e))
+                self._remove_worker(worker)
+                return
+            if self._closing:
+                try:
+                    worker.proc.kill()
+                except OSError:
+                    pass
+                self._remove_worker(worker)
+                return
+            self._absorb_warm_sessions(worker)
+            self._push_knobs(worker)
+            self._transition(worker, SERVING, only_from=(RESTARTING,))
+
+        threading.Thread(target=_boot, daemon=True).start()
+        return worker.name
+
+    def scale_down(self, tier: str) -> Optional[str]:
+        """Shrink `tier` by one worker — drain before kill. The
+        highest-index SERVING worker flips to DRAINING (instantly out
+        of routing), then a background thread waits for its in-flight
+        work to finish before the exit op + kill. Refuses (None) when
+        the tier has no second serving worker to leave behind."""
+        with self._lock:
+            serving = sorted(
+                (w for w in self.workers
+                 if w.tier == tier and w.state == SERVING),
+                key=lambda w: w.index,
+            )
+            if len(serving) < 2:
+                return None
+            worker = serving[-1]
+            worker.retiring = True
+        self._transition(worker, DRAINING, only_from=(SERVING,))
+        if self.timeline is not None:
+            self.timeline.note("tier_scale_down", tier=tier,
+                               worker=worker.name)
+        threading.Thread(
+            target=self._drain_and_retire, args=(worker,), daemon=True,
+        ).start()
+        return worker.name
+
+    def _drain_and_retire(self, worker: _Worker) -> None:
+        deadline = time.monotonic() + max(
+            5.0, 2.0 * self.config.disagg_recovery_wait_s
+        )
+        poll = min(0.2, self.config.disagg_heartbeat_s)
+        while time.monotonic() < deadline and not self._closing:
+            try:
+                with WorkerConn(worker.addr, timeout=2.0) as conn:
+                    reply, _ = conn.request({"op": "ping"}, timeout=2.0)
+                if (reply.get("slots_busy", 0) == 0
+                        and reply.get("queued", 0) == 0
+                        and reply.get("retained_handoffs", 0) == 0):
+                    break
+            except (OSError, ConnectionError, ValueError):
+                break   # already gone; retirement proceeds
+            time.sleep(poll)
+        try:
+            with WorkerConn(worker.addr, timeout=2.0) as conn:
+                conn.request({"op": "exit"}, timeout=2.0)
+        except (OSError, ConnectionError, ValueError):
+            pass
+        if worker.proc is not None:
+            try:
+                worker.proc.terminate()
+                worker.proc.wait(timeout=5.0)
+            except (OSError, subprocess.TimeoutExpired):
+                try:
+                    worker.proc.kill()
+                except OSError:
+                    pass
+        self._transition(worker, DEAD)
+        self._remove_worker(worker)
+
+    def _remove_worker(self, worker: _Worker) -> None:
+        """Drop a retired/never-booted worker from the pool. Sticky
+        entries pointing at the removed index are left alone: routing
+        treats a sticky miss as a plain re-score (the removed-index
+        safety the sticky map already guarantees)."""
+        with self._lock:
+            try:
+                self.workers.remove(worker)
+            except ValueError:
+                pass
+
+    def apply_knobs(self, knobs: dict) -> dict:
+        """Broadcast live-knob setpoints to every SERVING worker (the
+        autopilot's cross-process actuation path) and remember them so
+        respawns and future scale-ups boot onto the same setpoints.
+        Returns the last worker's post-clamp applied dict (tiers run
+        identical configs, so any worker's clamp is THE clamp)."""
+        with self._lock:
+            # polylint: disable=ML002(keyed by knob name: 4 static engine-knob names from _ENGINE_KNOB_SETTERS, not per-request data)
+            self._knob_setpoints.update(knobs)
+            targets = [w for w in self.workers if w.state == SERVING]
+        applied: dict = dict(knobs)
+        for worker in targets:
+            got = self._push_knobs(worker)
+            if got:
+                applied = got
+        return applied
+
+    def _push_knobs(self, worker: _Worker) -> Optional[dict]:
+        with self._lock:
+            knobs = dict(self._knob_setpoints)
+        if not knobs or worker.addr is None:
+            return None
+        try:
+            with WorkerConn(worker.addr, timeout=2.0) as conn:
+                reply, _ = conn.request(
+                    {"op": "knobs", "knobs": knobs}, timeout=2.0
+                )
+            return reply.get("applied") or None
+        except (OSError, ConnectionError, ValueError):
+            return None   # heartbeat owns liveness; a miss here is fine
 
     # -- engine-shaped surface ------------------------------------------------
 
@@ -624,6 +848,8 @@ class DisaggPool:
     def shutdown(self, timeout: float = 10.0) -> None:
         self._closing = True
         self._stop_heartbeat.set()
+        if self.autopilot is not None:
+            self.autopilot.stop()
         if self.blackbox is not None:
             # Final checkpoint with fresh offsets: a postmortem over a
             # cleanly-stopped pool should still merge.
@@ -631,7 +857,7 @@ class DisaggPool:
             self.blackbox.tick(force=True)
         if self._heartbeat_thread is not None:
             self._heartbeat_thread.join(timeout=2.0)
-        for worker in self.workers:
+        for worker in list(self.workers):
             if worker.addr is not None:
                 try:
                     with WorkerConn(worker.addr, timeout=2.0) as conn:
@@ -666,13 +892,25 @@ class DisaggPool:
         explicit exclusion: a death already moved them out of SERVING
         via the state machine."""
         deadline = time.monotonic() + self.config.disagg_recovery_wait_s
-        while True:
-            candidates = self._serving(tier)
-            if candidates:
-                return self._score(tier, candidates, skey, payload_bytes)
-            if time.monotonic() >= deadline or self._closing:
-                return None
-            time.sleep(min(0.05, self.config.disagg_heartbeat_s))
+        token = None
+        try:
+            while True:
+                candidates = self._serving(tier)
+                if candidates:
+                    return self._score(tier, candidates, skey,
+                                       payload_bytes)
+                if token is None:
+                    with self._lock:
+                        self._waiter_seq += 1
+                        token = self._waiter_seq
+                        self._tier_waiters[tier][token] = time.monotonic()
+                if time.monotonic() >= deadline or self._closing:
+                    return None
+                time.sleep(min(0.05, self.config.disagg_heartbeat_s))
+        finally:
+            if token is not None:
+                with self._lock:
+                    self._tier_waiters[tier].pop(token, None)
 
     def _score(self, tier: str, candidates: list[_Worker], skey: str,
                payload_bytes: int) -> _Worker:

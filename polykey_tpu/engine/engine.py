@@ -829,9 +829,17 @@ class InferenceEngine:
             )
         # Resident working set: _finish spills cold pages whenever a
         # retirement leaves fewer free device pages than this floor.
+        # Live attribute (not a frozen-config read): the autopilot's
+        # set_resident_floor actuation must land mid-run.
         self._resident_low = (
             config.host_kv_resident_pages or config.num_pages // 8
         )
+        # Per-iteration restore budget. Mirrors the frozen config field
+        # into a live attribute so _issue_restores reads THIS every
+        # iteration — a mid-run set_kv_restore_slots actuation takes
+        # effect on the next loop pass instead of being silently
+        # ignored (the knob-application audit, ISSUE 18).
+        self._restore_slots = config.host_kv_restore_slots
         # Restore-frontier round-robin cursor (the _chunk_rr
         # discipline for page faults).
         self._restore_rr = 0
@@ -1232,6 +1240,67 @@ class InferenceEngine:
         ids = np.asarray(ids, dtype=np.int32)
         dev, host = self._prefix.probe_tiered(ids)
         return (dev + _HOST_WARMTH_WEIGHT * host) / len(ids)
+
+    # -- live-knob actuation (autopilot; any thread) -------------------------
+    #
+    # The scheduling knobs below were once read from the frozen config
+    # (or captured at construction) exactly once — a mid-run change was
+    # silently ignored. Each setter mutates the ONE attribute the engine
+    # loop reads per iteration, so an actuation lands within one loop
+    # pass. Plain int/float attribute swaps: GIL-atomic against the loop
+    # thread, no lock needed (racelint: no blocking under any lock).
+    # Every setter clamps to the engine's own hard bounds and returns
+    # the value actually applied — the autopilot records old→new from
+    # the return, never from its request.
+
+    def set_lookahead(self, depth: int) -> int:
+        """Dispatch pipeline depth (POLYKEY_DISPATCH_LOOKAHEAD). The
+        adaptive _depth_target recomputes from _depth on every dispatch,
+        so the new depth governs the very next block."""
+        self._depth = max(1, min(64, int(depth)))
+        return self._depth
+
+    def set_prefill_budget(self, tokens: int) -> int:
+        """Interleaved-prefill token budget per loop iteration. Floored
+        at one chunk (the knob bounds stall length, it must never
+        deadlock a long prompt); in ragged mode capped at the
+        compile-static prefill-stream width — the executable cannot
+        carry more prefill tokens than it was built for."""
+        tokens = max(int(tokens), self._chunk)
+        if self._ragged:
+            tokens = min(tokens, self._ragged_width)
+        self._prefill_budget = tokens
+        return tokens
+
+    def set_kv_restore_slots(self, slots: int) -> int:
+        """Per-iteration restore-frontier budget (POLYKEY_KV_RESTORE_
+        SLOTS): host→device page-fault scatters issued ahead of each
+        iteration's dispatches."""
+        self._restore_slots = max(1, min(
+            int(slots), self.config.max_decode_slots
+        ))
+        return self._restore_slots
+
+    def set_resident_floor(self, pages: int) -> int:
+        """Host-KV resident floor (POLYKEY_KV_RESIDENT_PAGES): _finish
+        spills cold pages whenever a retirement leaves fewer free
+        device pages than this."""
+        self._resident_low = max(0, min(
+            int(pages), self.config.num_pages
+        ))
+        return self._resident_low
+
+    def knob_setpoints(self) -> dict:
+        """The live values of every actuated knob — what the loop will
+        read on its next iteration, not what any config said at boot."""
+        out = {
+            "lookahead": self._depth,
+            "prefill_budget": self._prefill_budget,
+        }
+        if self._host_kv is not None:
+            out["restore_slots"] = self._restore_slots
+            out["resident_floor"] = self._resident_low
+        return out
 
     @staticmethod
     def _deadline_expired(request: GenRequest) -> bool:
@@ -2718,7 +2787,7 @@ class InferenceEngine:
             slot = self._slots[i]
             if slot is None or slot.restore_pages is None:
                 continue
-            if issued >= self.config.host_kv_restore_slots:
+            if issued >= self._restore_slots:
                 self._restore_rr = i        # starved slot goes first next
                 return issued
             if slot.request.cancelled.is_set():

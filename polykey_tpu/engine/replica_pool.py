@@ -200,6 +200,48 @@ class ReplicaPool:
         # attempt must replay the SAME stream, so the root is fixed
         # before the first attempt instead of drawn inside one engine.
         self._seed_rng = np.random.default_rng()
+        # Live router weights (autopilot actuation surface): _route
+        # reads THESE per call, not the frozen config, so a mid-run
+        # set_route_weights lands on the very next routing decision.
+        self._route_prefix_weight = config.route_prefix_weight
+        self._route_delay_weight = config.route_delay_weight
+
+    # -- live-knob actuation (autopilot; any thread) -------------------------
+
+    def set_route_weights(self, prefix: Optional[float] = None,
+                          delay: Optional[float] = None) -> tuple:
+        """Update the router score weights in place (floats, GIL-atomic
+        against concurrent _route calls). None leaves a weight alone;
+        both clamp non-negative. Returns the applied pair."""
+        if prefix is not None:
+            self._route_prefix_weight = max(0.0, float(prefix))
+        if delay is not None:
+            self._route_delay_weight = max(0.0, float(delay))
+        return (self._route_prefix_weight, self._route_delay_weight)
+
+    def knob_setpoints(self) -> dict:
+        """Pool-level live knobs plus replica 0's engine knobs (all
+        replicas receive identical actuations — the autopilot
+        broadcasts through apply_engine_knobs)."""
+        out = {
+            "route_prefix_weight": round(self._route_prefix_weight, 4),
+            "route_delay_weight": round(self._route_delay_weight, 4),
+        }
+        if self.replicas:
+            out.update(self.replicas[0].engine.knob_setpoints())
+        return out
+
+    def apply_engine_knobs(self, knobs: dict) -> dict:
+        """Broadcast engine-level knob setpoints to EVERY replica (a
+        restarted replica's fresh engine is re-covered by the
+        autopilot's restart listener). Returns the values applied by
+        the last replica — identical engines apply identically."""
+        from .autopilot import apply_engine_knobs
+
+        applied: dict = {}
+        for rep in self.replicas:
+            applied = apply_engine_knobs(rep.engine, knobs)
+        return applied
 
     # -- construction --------------------------------------------------------
 
@@ -542,8 +584,8 @@ class ReplicaPool:
             # delay until their first completion — without it, every
             # cold-burst request would land on replica 0).
             score = (
-                self.config.route_prefix_weight * warmth
-                - self.config.route_delay_weight * delay
+                self._route_prefix_weight * warmth
+                - self._route_delay_weight * delay
                 - 1e-3 * rep.engine.load_fraction()
             )
             scored.append((rep, warmth, delay, feasible, score))

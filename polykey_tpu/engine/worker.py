@@ -264,6 +264,10 @@ class WorkerServer:
         # worker re-advertises its warm sessions to the router.
         self._warm_keys: "OrderedDict[str, bool]" = OrderedDict()
         self._load_warm_index()
+        # Autopilot knob setpoints last pushed by the coordinator
+        # ({"op": "knobs"}); re-applied to the fresh engine after a
+        # supervised restart so actuations survive worker recovery.
+        self._knob_setpoints: dict = {}
         # Worker-local span trees (ISSUE 16): the engine appends
         # children to any request.trace, but the recorder that keeps
         # finished trees lives with the gateway — a worker needs its own
@@ -286,9 +290,26 @@ class WorkerServer:
 
     def _on_engine_restart(self, fresh) -> None:
         self.engine = fresh
+        if self._knob_setpoints:
+            # A fresh engine boots with config-default knobs; the
+            # coordinator's autopilot actuations must outlive this
+            # worker's own supervised restart (adoption carries
+            # metrics, not engine attributes).
+            self._apply_knobs(self._knob_setpoints)
         if self.blackbox is not None:
             self.blackbox.rebind(getattr(fresh, "timeline", None),
                                  self.recorder)
+
+    def _apply_knobs(self, knobs: dict) -> dict:
+        """Apply coordinator-pushed live-knob setpoints (the autopilot's
+        cross-process actuation path) and remember them so a supervised
+        engine restart re-applies rather than silently reverting."""
+        from .autopilot import apply_engine_knobs
+
+        applied = apply_engine_knobs(self.engine, knobs)
+        # polylint: disable=ML002(keyed by knob name: 4 static engine-knob names from _ENGINE_KNOB_SETTERS, not per-request data)
+        self._knob_setpoints.update(applied)
+        return applied
 
     def _on_engine_trip(self, dead_engine, reason: str) -> None:
         # Forced checkpoint of the DYING engine's rings: rebind to the
@@ -474,6 +495,16 @@ class WorkerServer:
                     # mid-run kill pattern, across the process boundary).
                     self.engine._faults = injector
                     send_msg(conn, {"ok": True})
+                elif op == "knobs":
+                    # Autopilot actuation push: apply through the LIVE
+                    # engine's setters, reply with what actually landed
+                    # (post-clamp) so the coordinator records truth.
+                    send_msg(conn, {
+                        "ok": True,
+                        "applied": self._apply_knobs(
+                            header.get("knobs") or {}
+                        ),
+                    })
                 elif op == "exit":
                     # Witness dump BEFORE the ack: the coordinator
                     # terminates this process right after the reply

@@ -71,6 +71,10 @@ class TpuService(Service):
         # Set by create() when supervision is on; the supervisor swaps
         # `self.engine` to the fresh instance after every restart.
         self.supervisor: Optional[EngineSupervisor] = None
+        # Set by from_env() when POLYKEY_AUTOPILOT=1: the closed-loop
+        # controller thread (engine/autopilot.py); close() stops it
+        # before the engine so no actuation races the teardown.
+        self.autopilot = None
         self.secrets = secrets      # gateway.security.SecretStore or None
         self.logger = logger
         self.obs = obs
@@ -227,6 +231,19 @@ class TpuService(Service):
             engine, health=health, logger=logger,
             secrets=SecretStore.from_env(logger), obs=obs,
         )
+        # Close the control loop (ISSUE 18): POLYKEY_AUTOPILOT=1 arms
+        # the supervised controller thread over whatever target this
+        # process serves (bare engine, replica pool, or disagg
+        # coordinator). Default off — unset, nothing constructs and
+        # every existing path is byte-identical. A start-time refusal
+        # (signal plane disabled) propagates: that misconfiguration
+        # must fail the boot, not silently serve an inert controller.
+        from ..engine.autopilot import maybe_start
+
+        service.autopilot = maybe_start(
+            service.engine, supervisor=service.supervisor,
+            obs=obs, logger=logger,
+        )
         if logger is not None:
             logger.info(
                 "engine initialized",
@@ -253,6 +270,8 @@ class TpuService(Service):
             )
 
     def close(self) -> None:
+        if self.autopilot is not None:
+            self.autopilot.stop()
         if self.supervisor is not None:
             self.supervisor.stop()
         if self.watchdog is not None:

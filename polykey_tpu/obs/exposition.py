@@ -422,6 +422,42 @@ def _disagg_lines(pool) -> list[str]:
     return lines
 
 
+def _autopilot_lines(target) -> list[str]:
+    """Controller families (ISSUE 18): empty when no autopilot is
+    attached, so POLYKEY_AUTOPILOT unset leaves the page byte-identical."""
+    autopilot = getattr(target, "autopilot", None)
+    if autopilot is None:
+        return []
+    snap = autopilot.snapshot()
+    lines = render_header(
+        "polykey_autopilot_decisions_total",
+        "Autopilot actuations by action and direction", "counter",
+    )
+    for key, count in snap["decisions_total"].items():
+        action, _, direction = key.partition(":")
+        lines.append(render_sample(
+            "polykey_autopilot_decisions_total",
+            {"action": action, "direction": direction}, count,
+        ))
+    lines += render_header(
+        "polykey_autopilot_setpoint",
+        "Current autopilot-managed knob setpoints", "gauge",
+    )
+    for name, value in sorted(snap["setpoints"].items()):
+        lines.append(render_sample(
+            "polykey_autopilot_setpoint", {"name": name}, value,
+        ))
+    lines += render_header(
+        "polykey_autopilot_paused",
+        "1 while the autopilot is paused for a supervised restart",
+        "gauge",
+    )
+    lines.append(render_sample(
+        "polykey_autopilot_paused", {}, int(snap["paused"]),
+    ))
+    return lines
+
+
 def engine_collector(engine_or_provider):
     """Scrape-time collector over a live InferenceEngine OR a
     ReplicaPool: counters and gauges come from `stats()` snapshots (the
@@ -445,7 +481,7 @@ def engine_collector(engine_or_provider):
         if hasattr(target, "workers"):
             # Disaggregated pool (ISSUE 13): per-worker snapshots ride
             # the control plane; families render {tier, replica}-labeled.
-            return _disagg_lines(target)
+            return _disagg_lines(target) + _autopilot_lines(target)
         pool = target if hasattr(target, "replicas") else None
         if pool is not None:
             members = [
@@ -491,6 +527,7 @@ def engine_collector(engine_or_provider):
         if pool is not None:
             lines += _pool_lines(pool, members)
         lines += _slo_lines(members)
+        lines += _autopilot_lines(target)
         return lines
 
     return collect

@@ -37,6 +37,7 @@ import argparse
 import json
 import os
 import sys
+import threading
 import time
 from typing import Optional
 
@@ -82,6 +83,10 @@ class BlackBox:
         # amortized window must still leave evidence it booted).
         self._mark: Optional[int] = None
         self.flushes = 0
+        # Serializes concurrent flushes: a forced shutdown flush racing
+        # an amortized heartbeat tick would both write the SAME tmp
+        # path, and whichever os.replace loses finds it already gone.
+        self._flush_lock = threading.Lock()
         os.makedirs(state_dir, exist_ok=True)
         self._rotate()
 
@@ -107,15 +112,17 @@ class BlackBox:
         self._rotate()
         self._timeline = timeline
         self._recorder = recorder
-        self._mark = None
+        with self._flush_lock:
+            self._mark = None
 
     def tick(self, force: bool = False) -> bool:
         appended = (self._timeline.appended
                     if self._timeline is not None else 0)
-        if (not force and self._mark is not None
-                and 0 <= appended - self._mark < self.every):
-            return False
-        self._mark = appended
+        with self._flush_lock:
+            if (not force and self._mark is not None
+                    and 0 <= appended - self._mark < self.every):
+                return False
+            self._mark = appended
         self.flush()
         return True
 
@@ -135,10 +142,11 @@ class BlackBox:
                        if self._recorder is not None else []),
         }
         tmp = self.path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(payload, f)
-        os.replace(tmp, self.path)
-        self.flushes += 1
+        with self._flush_lock:
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, self.path)
+            self.flushes += 1
         return self.path
 
 

@@ -74,6 +74,10 @@ HIST_SIGNALS: dict[str, str] = {
     "itl_ms": "itl_hist",
     "host_stall_ms": "host_stall_hist",
     "request_device_ms": "device_ms_hist",
+    # Host-KV restore latency (ISSUE 15): windowed restore tails are
+    # the autopilot's evidence for tuning POLYKEY_KV_RESTORE_SLOTS
+    # (p95 >> p50 means restores queue behind the per-iteration budget).
+    "kv_restore_ms": "kv_restore_hist",
 }
 
 # Windowed scalar signals floor/ceiling objectives may bound; values
@@ -326,6 +330,26 @@ def summarize_deltas(deltas: dict, bounds: dict) -> dict:
             round(c.get("lookahead_sum", 0) / processed, 2)
             if processed else None
         ),
+        # Autopilot contract fields (ISSUE 18). Explicit None when the
+        # window holds no evidence — the controller treats None as
+        # "hold", never as zero. arrival_rate_per_s is the interactive-
+        # presence signal (prefill-budget actuation); the kv_* rates
+        # are the PR 15 fault-pressure signals (restore-slot and
+        # resident-floor actuations).
+        "arrival_rate_per_s": (
+            round(c.get("requests_admitted", 0) / covered, 3)
+            if covered > 0 else None
+        ),
+        "kv_page_faults": (
+            c.get("kv_page_faults_prefix", 0)
+            + c.get("kv_page_faults_ctx", 0)
+        ),
+        "kv_fault_rate_per_min": (
+            round((c.get("kv_page_faults_prefix", 0)
+                   + c.get("kv_page_faults_ctx", 0)) * 60.0 / covered, 3)
+            if covered > 0 else None
+        ),
+        "kv_pages_restored": c.get("kv_pages_restored", 0),
     }
     for name, (counts, _sum) in deltas["hists"].items():
         n = sum(counts)
@@ -796,9 +820,41 @@ def signals_snapshot(engine_or_pool, registry=None) -> dict:
         offsets = getattr(engine_or_pool, "clock_offsets", None)
         if callable(offsets):
             out["clock_offsets"] = offsets()
+        tiers_fn = getattr(engine_or_pool, "tier_now", None)
+        if callable(tiers_fn):
+            # Per-tier live pressure (ISSUE 18): serving/total counts
+            # plus heartbeat-fed queue-delay and load means — the tier-
+            # scaling controller's primary reading. queue_delay_s is
+            # explicitly None when no serving worker has answered a
+            # ping yet (no evidence ⇒ the controller holds).
+            out["tiers"] = tiers_fn()
+    autopilot = getattr(engine_or_pool, "autopilot", None)
+    if autopilot is not None:
+        # Closed-loop controller state (ISSUE 18): current setpoints,
+        # pause state, and the last-N decision ring — /debug/slo is how
+        # flightwatch's AUTOPILOT section reads them.
+        out["autopilot"] = autopilot.snapshot()
     if registry is not None:
         out["gateway"] = gateway_availability(registry)
     return out
+
+
+def signals_available(engine_or_pool) -> bool:
+    """Whether `signals_snapshot` over this target yields evidence a
+    controller may act on — the autopilot's refuse-to-start gate
+    (POLYKEY_SIGNALS_INTERVAL=0 allocates no plane, and a control loop
+    reading permanently-absent windows would hold forever while
+    claiming to supervise). A disagg pool's coordinator ring samples on
+    the heartbeat, but its spawned workers inherit the same
+    signals_interval_s; the config gate covers both layouts."""
+    if hasattr(engine_or_pool, "workers"):
+        config = getattr(engine_or_pool, "config", None)
+        return bool(config is not None
+                    and getattr(config, "signals_interval_s", 0) > 0)
+    return any(
+        getattr(engine.metrics, "signals", None) is not None
+        for _index, engine in _engines_of(engine_or_pool)
+    )
 
 
 def gateway_availability(registry) -> Optional[dict]:

@@ -1,0 +1,460 @@
+"""Autopilot drill: ramp arrivals 4x and SIGKILL a decode worker — the
+controller alone recovers.
+
+ISSUE 18's acceptance criterion in script form: a disaggregated pool
+(prefill + decode worker processes) under open-loop Poisson load, with
+the closed-loop controller armed on the coordinator, survives BOTH
+
+- a **4x mid-run arrival ramp** — per-tier queue-delay evidence must
+  drive at least one tier scale-up decision, and the windowed
+  arrival/handoff evidence at least one knob actuation, each recorded
+  as an ``autopilot_decision`` timeline event (cause and effect on one
+  Perfetto screen); and
+- a **decode-worker SIGKILL** mid-ramp — the heartbeat respawn plus
+  the controller's re-applied setpoints bring the tier back with no
+  operator action;
+
+with **zero failed RPCs**, every stream token-complete, and the
+post-recovery tail's p95 TTFT within tolerance of the pre-ramp
+baseline. ZERO human intervention: the script only generates load and
+one signal — every corrective action must come from the autopilot or
+the pool's own supervision.
+
+Writes a JSON artifact and exits nonzero on any violated bound. CI
+runs `make autopilot-smoke` (1+1 workers, short ramp); the committed
+acceptance artifact comes from `make autopilot-soak`.
+"""
+
+import argparse
+import itertools
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def percentile(values: list, q: float) -> float:
+    if not values:
+        return 0.0
+    return float(np.percentile(np.asarray(values, dtype=np.float64), q))
+
+
+def _config(args):
+    from polykey_tpu.engine.config import EngineConfig
+
+    return EngineConfig(
+        model=args.model,
+        dtype="float32",
+        max_decode_slots=args.slots,
+        page_size=8,
+        num_pages=args.slots * (args.max_seq // 8) + 32,
+        max_seq_len=args.max_seq,
+        prefill_buckets=(16, 32),
+        max_new_tokens_cap=args.max_new,
+        default_max_new_tokens=args.max_new,
+        decode_block_steps=2,
+        adaptive_block=False,
+        lookahead_blocks=2,
+        compile_warmup=True,
+        # Open-loop ramp keeps a backlog by design; shedding would turn
+        # the controller's scaling evidence into "failed RPCs".
+        max_queue_depth=0,
+        watchdog_timeout_s=300.0,
+        supervise=True,
+        max_engine_restarts=5,
+        restart_window_s=600.0,
+        disagg=f"{args.prefill}x{args.decode}",
+        # A scale-up boot (jax import + engine build + warmup compile)
+        # pins every core for seconds; a trigger-happy liveness window
+        # then declares the HEALTHY workers down for slow pings and the
+        # false respawns cascade into a real outage. 0.5 s x 10 misses
+        # = 5 s of grace rides out a compile storm while a SIGKILL is
+        # still caught instantly via poll().
+        disagg_heartbeat_s=0.5,
+        disagg_miss=10,
+        disagg_recovery_wait_s=90.0,
+        max_reroutes=6,
+        signals_interval_s=0.25,
+    )
+
+
+def _pilot_config(args):
+    """Soak-cadence controller: the production defaults (2 s tick, 20 s
+    cooldown) are right for a long-lived server but would sleep through
+    a 60-second drill — the drill compresses time, not thresholds'
+    SHAPE (hysteresis bands and bounds keep their relative geometry)."""
+    from polykey_tpu.engine.autopilot import AutopilotConfig
+
+    return AutopilotConfig(
+        interval_s=0.5,
+        cooldown_s=args.cooldown,
+        tier_min=1,
+        tier_max=args.tier_max,
+        queue_high_s=args.queue_high,
+        queue_low_s=args.queue_high / 10.0,
+        min_evidence_s=2.0,
+        arrival_high_per_s=args.arrival_high,
+        arrival_low_per_s=args.arrival_high / 10.0,
+    )
+
+
+def run(args) -> int:
+    from polykey_tpu.engine.autopilot import (
+        SCALE_DECODE,
+        SCALE_PREFILL,
+        UP,
+        Autopilot,
+    )
+    from polykey_tpu.engine.disagg_pool import DisaggPool
+    from polykey_tpu.engine.engine import GenRequest
+
+    import tempfile
+
+    rng = np.random.default_rng(args.seed)
+    config = _config(args)
+    state_dir = tempfile.mkdtemp(prefix="polykey-autopilot-")
+    log(f"spawning {args.prefill} prefill + {args.decode} decode workers "
+        f"(compile warmup; logs in {state_dir}) ...")
+    pool = DisaggPool.create(config, seed=args.seed, state_dir=state_dir)
+    pilot = Autopilot(pool, config=_pilot_config(args)).start()
+    log(f"autopilot armed: setpoints {pilot.state.setpoints}")
+
+    # Narration: worker-state flips and controller decisions as they
+    # happen, so a failing run reads as a story instead of a corpse.
+    monitor_stop = threading.Event()
+    monitor_t0 = time.monotonic()
+
+    def monitor() -> None:
+        last_states = ""
+        seen = 0
+        while not monitor_stop.wait(1.0):
+            t = time.monotonic() - monitor_t0
+            states = " ".join(
+                f"{w.name}={w.state}" for w in list(pool.workers))
+            if states != last_states:
+                log(f"[t+{t:.1f}s] pool: {states}")
+                last_states = states
+            decisions = list(pilot.decisions)
+            for d in decisions[seen:]:
+                log(f"[t+{t:.1f}s] decision: {d['action']} {d['direction']} "
+                    f"{d['old']} -> {d['new']} ({d['reason']})")
+            seen = len(decisions)
+
+    threading.Thread(target=monitor, daemon=True).start()
+
+    results_lock = threading.Lock()
+    results: list[dict] = []
+
+    def drain(request: GenRequest, enqueued_at: float) -> None:
+        tokens = 0
+        error = None
+        deadline = time.monotonic() + 240.0
+        while time.monotonic() < deadline:
+            try:
+                kind, value = request.out.get(
+                    timeout=max(0.001, deadline - time.monotonic()))
+            except Exception:
+                # Justified: queue.Empty / deadline-edge timeout both
+                # mean the stream starved — recorded as a failure.
+                error = "drain timeout"
+                break
+            if kind == "token":
+                tokens += 1
+            elif kind == "done":
+                break
+            else:
+                error = value
+                break
+        else:
+            error = error or "drain timeout"
+        with results_lock:
+            results.append({
+                "enqueued_at": enqueued_at,
+                "tokens": tokens,
+                "error": error,
+                "ttft_ms": request.timings.ttft_ms,
+            })
+
+    fired = itertools.count()
+
+    def fire(enqueued_at: float) -> threading.Thread:
+        request = GenRequest(
+            prompt=f"autopilot soak request {next(fired)}",
+            max_new_tokens=args.max_new,
+        )
+        pool.submit(request)
+        thread = threading.Thread(
+            target=drain, args=(request, enqueued_at), daemon=True,
+        )
+        thread.start()
+        return thread
+
+    # Rate calibration: one warm probe bounds the service time.
+    probe = fire(0.0)
+    probe.join(timeout=180)
+    with results_lock:
+        probe_ttft = results[0]["ttft_ms"] if results else 0.0
+        results.clear()
+    service_s = max(0.1, probe_ttft / 1000.0 * 4)
+    # Base well under single-tier capacity so the 4x ramp lands just
+    # UNDER it: the sustained ramp alone stays servable, and the
+    # compounding decode SIGKILL is what actually breaks the tier —
+    # its outage backlog is the scaling evidence, one scale-up absorbs
+    # the drain, and the tail can recover. Ramping far past capacity
+    # instead proves nothing about the controller: no amount of
+    # scaling outruns an open-loop overload on a CPU box that must
+    # also pay a compile storm per spawned worker.
+    base_rate = args.rate or min(
+        1.5, max(0.5, 0.2 * args.decode * args.slots / service_s)
+    )
+    ramp_rate = args.ramp * base_rate
+    ramp_at = args.baseline_s
+    kill_at = ramp_at + args.kill_delay
+    duration = ramp_at + args.ramp_s
+    log(f"baseline {base_rate:.2f}/s for {ramp_at:.0f}s, then "
+        f"{args.ramp:.0f}x ramp to {ramp_rate:.2f}/s; SIGKILL decode/0 "
+        f"at t+{kill_at:.0f}s; total {duration:.0f}s")
+
+    start = time.monotonic()
+    threads = []
+    index = 0
+    next_arrival = start
+    killed_at = None
+    killed_pid = None
+    while True:
+        now = time.monotonic()
+        t = now - start
+        if killed_at is None and t >= kill_at:
+            victim = next(
+                (w for w in pool.workers
+                 if w.tier == "decode" and w.proc is not None
+                 and w.proc.poll() is None), None,
+            )
+            if victim is not None:
+                killed_pid = victim.proc.pid
+                os.kill(killed_pid, signal.SIGKILL)
+                killed_at = t
+                log(f"t+{t:.1f}s: SIGKILL decode worker {victim.name} "
+                    f"(pid {killed_pid}) — hands off the keyboard")
+        if t >= duration:
+            break
+        rate = ramp_rate if t >= ramp_at else base_rate
+        if now >= next_arrival:
+            threads.append(fire(t))
+            index += 1
+            next_arrival = max(
+                next_arrival + rng.exponential(1.0 / rate), now - 0.5
+            )
+        else:
+            time.sleep(min(0.005, next_arrival - now))
+
+    log(f"arrivals done ({index}); draining ...")
+    for thread in threads:
+        thread.join(timeout=300)
+    alive = sum(t.is_alive() for t in threads)
+
+    # Recovery: every non-retired worker back to SERVING without anyone
+    # touching the pool (the heartbeat respawn + controller re-apply).
+    recovered_s = None
+    recovery_deadline = time.monotonic() + args.recovery_timeout
+    while time.monotonic() < recovery_deadline:
+        states = [w.state for w in pool.workers]
+        if states and all(s == "SERVING" for s in states):
+            recovered_s = (time.monotonic() - start) - (killed_at or 0.0)
+            break
+        time.sleep(0.2)
+
+    snapshot = pilot.snapshot()
+    tiers_final = pool.tier_now()
+    timeline_kinds: dict = {}
+    if pool.timeline is not None:
+        for event in pool.timeline.events():
+            # Notes expand as kind="note" with the typed name in
+            # note_kind — autopilot_decision events live there.
+            kind = event.get("note_kind") or event.get("kind")
+            timeline_kinds[kind] = timeline_kinds.get(kind, 0) + 1
+    monitor_stop.set()
+    pilot.stop()
+    pool.shutdown()
+
+    with results_lock:
+        done = list(results)
+    failed = [r for r in done if r["error"] is not None]
+    short = [r for r in done if r["error"] is None
+             and r["tokens"] != args.max_new]
+    baseline = [r["ttft_ms"] for r in done
+                if r["error"] is None and r["enqueued_at"] < ramp_at
+                and r["ttft_ms"] > 0]
+    ramp_all = [r["ttft_ms"] for r in done
+                if r["error"] is None and r["enqueued_at"] >= ramp_at
+                and r["ttft_ms"] > 0]
+    tail_from = duration - args.tail_s
+    tail = [r["ttft_ms"] for r in done
+            if r["error"] is None and r["enqueued_at"] >= tail_from
+            and r["ttft_ms"] > 0]
+    p95_base = percentile(baseline, 95)
+    p95_ramp = percentile(ramp_all, 95)
+    p95_tail = percentile(tail, 95)
+    added_ms = p95_tail - p95_base
+
+    totals = snapshot["decisions_total"]
+    scale_ups = sum(
+        count for key, count in totals.items()
+        if key in (f"{SCALE_DECODE}:{UP}", f"{SCALE_PREFILL}:{UP}")
+    )
+    knob_actuations = sum(
+        count for key, count in totals.items()
+        if not key.startswith("scale_")
+    )
+
+    artifact = {
+        "schema": "polykey_autopilot_soak_v1",
+        "prefill_workers": args.prefill,
+        "decode_workers": args.decode,
+        "slots_per_replica": args.slots,
+        "duration_s": round(duration, 1),
+        "baseline_rate_per_s": round(base_rate, 2),
+        "ramp_multiplier": args.ramp,
+        "ramp_rate_per_s": round(ramp_rate, 2),
+        "ramp_at_s": round(ramp_at, 1),
+        "arrivals": index,
+        "completed": len(done) - len(failed),
+        "failed": len(failed),
+        "failed_errors": sorted({str(r["error"]) for r in failed})[:5],
+        "short_streams": len(short),
+        "undrained": alive,
+        "decode_sigkill_at_s": (
+            round(killed_at, 2) if killed_at is not None else None
+        ),
+        "decode_sigkill_pid": killed_pid,
+        "ttft_ms_p50_baseline": round(percentile(baseline, 50), 1),
+        "ttft_ms_p95_baseline": round(p95_base, 1),
+        "ttft_ms_p95_ramp": round(p95_ramp, 1),
+        "ttft_ms_p50_tail": round(percentile(tail, 50), 1),
+        "ttft_ms_p95_tail": round(p95_tail, 1),
+        "tail_window_s": args.tail_s,
+        "p95_added_ms": round(added_ms, 1),
+        "max_p95_added_ms": args.max_p95_added_ms,
+        "recovered_to_full_capacity_s": (
+            round(recovered_s, 2) if recovered_s is not None else None
+        ),
+        "tiers_final": tiers_final,
+        "autopilot_setpoints_final": snapshot["setpoints"],
+        "autopilot_decisions_total": totals,
+        "autopilot_decisions": snapshot["decisions"],
+        "scale_up_decisions": scale_ups,
+        "knob_actuations": knob_actuations,
+        "timeline_decision_events": timeline_kinds.get(
+            "autopilot_decision", 0
+        ),
+        "timeline_scale_events": (
+            timeline_kinds.get("tier_scale_up", 0)
+            + timeline_kinds.get("tier_scale_down", 0)
+        ),
+    }
+    out = args.out or os.path.join(
+        "perf", f"autopilot_soak_{time.strftime('%Y-%m-%d')}.json"
+    )
+    os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=2, sort_keys=True)
+        f.write("\n")
+    log(json.dumps(artifact, indent=2, sort_keys=True))
+    log(f"artifact -> {out}")
+
+    ok = True
+    if failed or alive:
+        log(f"FAIL: {len(failed)} failed requests, {alive} undrained "
+            "(zero-intervention recovery requires ZERO failed RPCs)")
+        ok = False
+    if short:
+        log(f"FAIL: {len(short)} streams finished short of "
+            f"{args.max_new} tokens")
+        ok = False
+    if killed_at is None:
+        log("FAIL: the decode SIGKILL never fired (duration too short)")
+        ok = False
+    if scale_ups < 1:
+        log("FAIL: the 4x ramp produced no tier scale-up decision")
+        ok = False
+    if knob_actuations < 1:
+        log("FAIL: no knob actuation decision fired")
+        ok = False
+    if artifact["timeline_decision_events"] < 1:
+        log("FAIL: no autopilot_decision timeline event recorded")
+        ok = False
+    if recovered_s is None:
+        log("FAIL: the pool never returned to full SERVING capacity")
+        ok = False
+    if added_ms > args.max_p95_added_ms:
+        log(f"FAIL: tail p95 TTFT {p95_tail:.0f}ms exceeds baseline "
+            f"{p95_base:.0f}ms by {added_ms:.0f}ms "
+            f"(> {args.max_p95_added_ms:.0f}ms tolerance)")
+        ok = False
+    log("autopilot drill " + ("PASSED" if ok else "FAILED"))
+    return 0 if ok else 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--prefill", type=int, default=1,
+                    help="prefill-tier worker processes at boot")
+    ap.add_argument("--decode", type=int, default=1,
+                    help="decode-tier worker processes at boot (the "
+                         "ramp should force a scale-up beyond this)")
+    ap.add_argument("--slots", type=int, default=4,
+                    help="decode slots PER worker")
+    ap.add_argument("--tier-max", type=int, default=3,
+                    help="autopilot scale ceiling per tier")
+    ap.add_argument("--baseline-s", type=float, default=20.0,
+                    help="pre-ramp window (the recovery reference)")
+    ap.add_argument("--ramp-s", type=float, default=45.0,
+                    help="post-ramp window (scale-up + kill + recovery)")
+    ap.add_argument("--tail-s", type=float, default=15.0,
+                    help="final window whose p95 must be recovered")
+    ap.add_argument("--ramp", type=float, default=4.0,
+                    help="arrival-rate multiplier at the ramp")
+    ap.add_argument("--kill-delay", type=float, default=10.0,
+                    help="SIGKILL the decode worker this long after "
+                         "the ramp")
+    ap.add_argument("--rate", type=float, default=0.0,
+                    help="baseline arrivals/s; 0 -> auto-calibrate")
+    ap.add_argument("--cooldown", type=float, default=8.0,
+                    help="autopilot per-action cooldown (drill cadence; "
+                         "long enough that one scale-up's compile storm "
+                         "settles before the same action re-fires)")
+    ap.add_argument("--queue-high", type=float, default=0.2,
+                    help="tier queue-delay scale-up edge (seconds)")
+    ap.add_argument("--arrival-high", type=float, default=0.5,
+                    help="interactive-presence edge (arrivals/s)")
+    ap.add_argument("--max-new", type=int, default=8)
+    ap.add_argument("--max-seq", type=int, default=64)
+    ap.add_argument("--model", default="tiny-llama")
+    ap.add_argument("--max-p95-added-ms", type=float, default=30000.0,
+                    help="tail p95 TTFT may exceed the pre-ramp "
+                         "baseline by at most this (worker respawn "
+                         "pays jax import + build + warmup on CPU)")
+    ap.add_argument("--recovery-timeout", type=float, default=120.0)
+    ap.add_argument("--seed", type=int, default=17)
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+    return run(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

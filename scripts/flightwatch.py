@@ -233,6 +233,50 @@ def render(families: dict, slo: dict, now: str, target: str) -> str:
                 )
             )
         lines.append("")
+
+    # AUTOPILOT (ISSUE 18): current setpoints come from the gauge
+    # family (present on any autopiloted server); the decision tail
+    # needs /debug/slo's richer snapshot and degrades to the
+    # decisions_total counters without it.
+    autopilot = (slo or {}).get("autopilot") or {}
+    setpoint_rows = [
+        (sample_labels.get("name", "?"), value)
+        for sample_labels, value
+        in families.get("polykey_autopilot_setpoint", ())
+    ]
+    if setpoint_rows or autopilot:
+        paused = autopilot.get("paused") or bool(
+            metric(families, "polykey_autopilot_paused", 0)
+        )
+        lines.append("AUTOPILOT{}".format("      [PAUSED]" if paused
+                                          else ""))
+        if setpoint_rows:
+            lines.append("  setpoints    " + "  ".join(
+                "{}={}".format(name, _fmt(value, "{:g}"))
+                for name, value in sorted(setpoint_rows)
+            ))
+        totals = autopilot.get("decisions_total") or {
+            "{}:{}".format(sample_labels.get("action", "?"),
+                           sample_labels.get("direction", "?")): value
+            for sample_labels, value
+            in families.get("polykey_autopilot_decisions_total", ())
+        }
+        if totals:
+            lines.append("  decisions    " + "  ".join(
+                f"{key}={int(count)}" for key, count
+                in sorted(totals.items())
+            ))
+        for decision in (autopilot.get("decisions") or [])[-5:]:
+            lines.append(
+                "  {:<14} {:<4} {} -> {}  ({})".format(
+                    decision.get("action", "?")[:14],
+                    decision.get("direction", "?"),
+                    _fmt(decision.get("old"), "{:g}"),
+                    _fmt(decision.get("new"), "{:g}"),
+                    str(decision.get("reason", ""))[:48],
+                )
+            )
+        lines.append("")
     return "\n".join(lines)
 
 

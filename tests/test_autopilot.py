@@ -1,0 +1,576 @@
+"""Autopilot controller tests (ISSUE 18).
+
+Three layers, mirroring the module's pure/impure split:
+
+1. The PURE decision core on canned snapshots — hysteresis bands,
+   per-action cooldowns, hard bounds, null-verdict holds (no evidence,
+   no verdict), the min-evidence gate, and no flapping under an
+   oscillating synthetic signal.
+2. The live-knob actuation surfaces (the knob-application audit):
+   every actuated knob reaches an attribute the engine loop reads per
+   iteration, so a mid-run actuation takes effect within one pass —
+   pinned here so a refactor can't silently reintroduce the
+   read-once-at-construction bug.
+3. The Autopilot thread's lifecycle contracts: typed refuse-to-start
+   when the signal plane is off, supervisor pause / setpoint re-apply /
+   re-arm, and the DisaggPool elastic surface (scale-down drains
+   before killing; a retiring worker's death never burns restart
+   budget).
+"""
+
+import time
+from dataclasses import replace
+
+import pytest
+
+from polykey_tpu.engine import autopilot as ap
+from polykey_tpu.engine.config import EngineConfig
+from polykey_tpu.engine.disagg_pool import (
+    DEAD,
+    DECODE,
+    DRAINING,
+    PREFILL,
+    SERVING,
+    DisaggPool,
+    _Worker,
+)
+from polykey_tpu.engine.engine import GenRequest, InferenceEngine
+
+CONFIG = EngineConfig(
+    model="tiny-llama",
+    tokenizer="byte",
+    dtype="float32",
+    max_decode_slots=4,
+    page_size=8,
+    num_pages=64,
+    max_seq_len=64,
+    prefill_buckets=(16,),
+    max_new_tokens_cap=32,
+    default_max_new_tokens=8,
+    decode_block_steps=4,
+    signals_interval_s=0.05,
+)
+
+CFG = ap.AutopilotConfig(
+    interval_s=0.05, cooldown_s=10.0, target_busy=0.75,
+    lookahead_max=6, tier_min=1, tier_max=3,
+    queue_high_s=0.3, queue_low_s=0.03, min_evidence_s=10.0,
+)
+
+
+def make_state() -> ap.ControllerState:
+    state = ap.ControllerState()
+    state.setpoints = {
+        ap.LOOKAHEAD: 2, ap.PREFILL_BUDGET: 32,
+        ap.RESTORE_SLOTS: 2, ap.RESIDENT_FLOOR: 8,
+        ap.ROUTE_DELAY_WEIGHT: 1.0,
+    }
+    state.baselines = dict(state.setpoints)
+    state.bounds = {
+        ap.LOOKAHEAD: (2, 6), ap.PREFILL_BUDGET: (16, 64),
+        ap.RESTORE_SLOTS: (2, 4), ap.RESIDENT_FLOOR: (8, 32),
+        ap.ROUTE_DELAY_WEIGHT: (1.0, 8.0),
+    }
+    state.steps = {ap.PREFILL_BUDGET: 16, ap.RESIDENT_FLOOR: 4}
+    return state
+
+
+def summary(**kw) -> dict:
+    base = {"covered_s": 60.0}
+    base.update(kw)
+    return base
+
+
+# -- 1. pure decision core ---------------------------------------------------
+
+
+class TestDecideLookahead:
+    def test_deepens_on_stall_with_idle_device(self):
+        d = ap.decide_lookahead(
+            summary(host_stall_ms_p95=5.0, device_busy_fraction=0.4),
+            make_state(), CFG, 100.0,
+        )
+        assert d is not None and d.direction == ap.UP
+        assert (d.old, d.new) == (2, 3)
+
+    def test_holds_when_device_already_busy(self):
+        # Stall evidence alone is not enough: a busy device means the
+        # pipeline is not the bottleneck — deeper lookahead just adds
+        # wasted-work exposure.
+        d = ap.decide_lookahead(
+            summary(host_stall_ms_p95=5.0, device_busy_fraction=0.9),
+            make_state(), CFG, 100.0,
+        )
+        assert d is None
+
+    def test_relaxes_toward_baseline_when_healthy(self):
+        state = make_state()
+        state.setpoints[ap.LOOKAHEAD] = 4
+        d = ap.decide_lookahead(
+            summary(host_stall_ms_p95=0.0, device_busy_fraction=0.9),
+            state, CFG, 100.0,
+        )
+        assert d is not None and d.direction == ap.DOWN
+        assert (d.old, d.new) == (4, 3)
+
+    def test_never_relaxes_below_baseline(self):
+        d = ap.decide_lookahead(
+            summary(host_stall_ms_p95=0.0, device_busy_fraction=0.9),
+            make_state(), CFG, 100.0,
+        )
+        assert d is None  # already at the boot depth
+
+    def test_bounded_at_max(self):
+        state = make_state()
+        state.setpoints[ap.LOOKAHEAD] = 6
+        d = ap.decide_lookahead(
+            summary(host_stall_ms_p95=5.0, device_busy_fraction=0.4),
+            state, CFG, 100.0,
+        )
+        assert d is None  # clamp leaves the value unchanged → no decision
+
+    def test_null_reading_holds(self):
+        # Idle engine: no dispatches → host_stall p95 is None, never 0.
+        d = ap.decide_lookahead(
+            summary(host_stall_ms_p95=None, device_busy_fraction=None),
+            make_state(), CFG, 100.0,
+        )
+        assert d is None
+
+    def test_inside_band_holds(self):
+        # Between the edges (stall present but small, device mid-load):
+        # neither the up edge nor the down edge — hysteresis holds.
+        d = ap.decide_lookahead(
+            summary(host_stall_ms_p95=0.5, device_busy_fraction=0.5),
+            make_state(), CFG, 100.0,
+        )
+        assert d is None
+
+
+class TestDecidePrefillBudget:
+    def test_narrows_under_interactive_arrivals(self):
+        d = ap.decide_prefill_budget(
+            summary(arrival_rate_per_s=2.0), None, make_state(), CFG, 100.0,
+        )
+        assert d is not None and d.direction == ap.DOWN
+        assert (d.old, d.new) == (32, 16)
+
+    def test_widens_when_quiet(self):
+        d = ap.decide_prefill_budget(
+            summary(arrival_rate_per_s=0.0), None, make_state(), CFG, 100.0,
+        )
+        assert d is not None and d.direction == ap.UP
+        assert (d.old, d.new) == (32, 48)
+
+    def test_floor_is_one_chunk(self):
+        state = make_state()
+        state.setpoints[ap.PREFILL_BUDGET] = 16
+        d = ap.decide_prefill_budget(
+            summary(arrival_rate_per_s=2.0), None, state, CFG, 100.0,
+        )
+        assert d is None  # already at the chunk floor
+
+    def test_no_arrival_evidence_holds(self):
+        d = ap.decide_prefill_budget(
+            summary(arrival_rate_per_s=None), None, make_state(), CFG, 100.0,
+        )
+        assert d is None
+
+    def test_disagg_falls_back_to_pool_handoff_rate(self):
+        pool_windows = {"1m": {
+            "covered_s": 60.0, "handoffs": {"ok": 120, "failed": 0},
+        }}
+        d = ap.decide_prefill_budget(
+            None, pool_windows, make_state(), CFG, 100.0,
+        )
+        assert d is not None and d.direction == ap.DOWN
+
+
+class TestDecideKvKnobs:
+    def test_restore_slots_up_under_fault_pressure(self):
+        d = ap.decide_restore_slots(
+            summary(kv_fault_rate_per_min=90.0), make_state(), CFG, 100.0,
+        )
+        assert d is not None and d.direction == ap.UP
+        assert (d.old, d.new) == (2, 3)
+
+    def test_restore_slots_decays_when_quiet(self):
+        state = make_state()
+        state.setpoints[ap.RESTORE_SLOTS] = 4
+        d = ap.decide_restore_slots(
+            summary(kv_fault_rate_per_min=0.0), state, CFG, 100.0,
+        )
+        assert d is not None and d.direction == ap.DOWN
+
+    def test_resident_floor_up_under_fault_pressure(self):
+        d = ap.decide_resident_floor(
+            summary(kv_fault_rate_per_min=90.0), make_state(), CFG, 100.0,
+        )
+        assert d is not None and d.direction == ap.UP
+        assert (d.old, d.new) == (8, 12)
+
+    def test_no_host_kv_tier_holds(self):
+        state = make_state()
+        del state.setpoints[ap.RESTORE_SLOTS]
+        d = ap.decide_restore_slots(
+            summary(kv_fault_rate_per_min=90.0), state, CFG, 100.0,
+        )
+        assert d is None
+
+
+class TestDecideRouteWeights:
+    @staticmethod
+    def replicas(*p95s):
+        return {
+            i: {"windows": {"1m": {"covered_s": 60.0, "ttft_ms_p95": v}}}
+            for i, v in enumerate(p95s)
+        }
+
+    def test_skew_doubles_delay_weight(self):
+        d = ap.decide_route_weights(
+            self.replicas(50.0, 900.0), make_state(), CFG, 100.0,
+        )
+        assert d is not None and d.direction == ap.UP
+        assert (d.old, d.new) == (1.0, 2.0)
+
+    def test_healed_skew_decays(self):
+        state = make_state()
+        state.setpoints[ap.ROUTE_DELAY_WEIGHT] = 4.0
+        d = ap.decide_route_weights(
+            self.replicas(50.0, 60.0), state, CFG, 100.0,
+        )
+        assert d is not None and d.direction == ap.DOWN
+        assert (d.old, d.new) == (4.0, 2.0)
+
+    def test_single_replica_holds(self):
+        assert ap.decide_route_weights(
+            self.replicas(900.0), make_state(), CFG, 100.0,
+        ) is None
+
+
+class TestDecideScale:
+    @staticmethod
+    def tiers(delay, serving=1, total=None):
+        return {DECODE: {
+            "queue_delay_s": delay, "serving": serving,
+            "total": serving if total is None else total,
+        }}
+
+    def test_scales_up_on_queue_pressure(self):
+        d = ap.decide_scale(
+            DECODE, self.tiers(1.0), make_state(), CFG, 100.0,
+        )
+        assert d is not None
+        assert (d.action, d.direction) == (ap.SCALE_DECODE, ap.UP)
+
+    def test_up_bounded_by_tier_max_including_booting(self):
+        # Two serving + one still booting = three TOTAL: at tier_max the
+        # in-flight spawn must not be doubled by another decision.
+        d = ap.decide_scale(
+            DECODE, self.tiers(1.0, serving=2, total=3),
+            make_state(), CFG, 100.0,
+        )
+        assert d is None
+
+    def test_up_waits_for_inflight_boot(self):
+        # One serving + one booting, well under tier_max, pressure
+        # present: a worker boot pays a compile storm, and stacking a
+        # second starves the first — one boot in flight means hold.
+        d = ap.decide_scale(
+            DECODE, self.tiers(5.0, serving=1, total=2),
+            make_state(), CFG, 100.0,
+        )
+        assert d is None
+
+    def test_scales_down_with_headroom(self):
+        d = ap.decide_scale(
+            DECODE, self.tiers(0.0, serving=2), make_state(), CFG, 100.0,
+        )
+        assert d is not None and d.direction == ap.DOWN
+
+    def test_never_below_tier_min(self):
+        assert ap.decide_scale(
+            DECODE, self.tiers(0.0, serving=1), make_state(), CFG, 100.0,
+        ) is None
+
+    def test_null_queue_delay_holds(self):
+        # Empty tier / no heartbeat yet: None is "no evidence", and the
+        # controller must not read it as "no delay" and scale down.
+        assert ap.decide_scale(
+            DECODE, self.tiers(None, serving=2), make_state(), CFG, 100.0,
+        ) is None
+
+
+class TestEvaluate:
+    @staticmethod
+    def snap(**agg):
+        return {"aggregate": {"1m": summary(**agg)}}
+
+    def test_cooldown_gates_repeat_decisions(self):
+        state = make_state()
+        snap = self.snap(host_stall_ms_p95=5.0, device_busy_fraction=0.4)
+        first = ap.evaluate(snap, state, CFG, 100.0)
+        assert any(d.action == ap.LOOKAHEAD for d in first)
+        # Simulate _apply's bookkeeping, then re-evaluate inside the
+        # cooldown window: same evidence, no decision.
+        state.last_fired[ap.LOOKAHEAD] = 100.0
+        state.setpoints[ap.LOOKAHEAD] = 3
+        assert not any(
+            d.action == ap.LOOKAHEAD
+            for d in ap.evaluate(snap, state, CFG, 105.0)
+        )
+        # Past the cooldown the evidence fires again.
+        assert any(
+            d.action == ap.LOOKAHEAD
+            for d in ap.evaluate(snap, state, CFG, 111.0)
+        )
+
+    def test_min_evidence_gate(self):
+        snap = {"aggregate": {"1m": {
+            "covered_s": 1.0, "host_stall_ms_p95": 5.0,
+            "device_busy_fraction": 0.4,
+        }}}
+        assert ap.evaluate(snap, make_state(), CFG, 100.0) == []
+
+    def test_no_flapping_under_oscillating_signal(self):
+        # A signal bouncing INSIDE the hysteresis band must produce
+        # zero decisions no matter how long it oscillates.
+        state = make_state()
+        decisions = []
+        for i in range(50):
+            stall = 0.8 if i % 2 else 0.1   # below the 1.0ms up edge
+            busy = 0.5                       # below the down edge's target
+            snap = self.snap(
+                host_stall_ms_p95=stall, device_busy_fraction=busy,
+                arrival_rate_per_s=0.2,      # inside [0.05, 0.5]
+                kv_fault_rate_per_min=10.0,  # inside (0, 30]
+            )
+            decisions += ap.evaluate(snap, state, CFG, 100.0 + i)
+        assert decisions == []
+
+    def test_empty_snapshot_holds_everything(self):
+        assert ap.evaluate({}, make_state(), CFG, 100.0) == []
+
+
+# -- 2. live-knob actuation (the knob-application audit) ---------------------
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = InferenceEngine(CONFIG)
+    yield eng
+    eng.shutdown()
+
+
+class TestLiveKnobSetters:
+    def test_lookahead_lands_on_loop_attribute(self, engine):
+        old = engine._depth
+        try:
+            assert engine.set_lookahead(5) == 5
+            # _depth_target recomputes from _depth on EVERY dispatch:
+            # the attribute the setter wrote is the one the loop reads.
+            assert engine._depth == 5
+            assert engine.set_lookahead(0) == 1      # clamp floor
+            assert engine.set_lookahead(999) == 64   # clamp ceiling
+        finally:
+            engine.set_lookahead(old)
+
+    def test_prefill_budget_lands_on_loop_attribute(self, engine):
+        old = engine._prefill_budget
+        try:
+            applied = engine.set_prefill_budget(engine._chunk * 3)
+            assert engine._prefill_budget == applied == engine._chunk * 3
+            # Floor: the budget may never starve a chunk (deadlock).
+            assert engine.set_prefill_budget(1) == engine._chunk
+        finally:
+            engine.set_prefill_budget(old)
+
+    def test_knob_setpoints_reports_live_values(self, engine):
+        old = engine._depth
+        try:
+            engine.set_lookahead(4)
+            assert engine.knob_setpoints()["lookahead"] == 4
+        finally:
+            engine.set_lookahead(old)
+
+    def test_actuation_mid_run_takes_effect(self, engine):
+        # Behavioral pin: actuate mid-run, then complete a generation —
+        # the engine loop runs with the new setpoints (it reads the
+        # attributes per iteration; nothing caches the old values).
+        engine.set_lookahead(3)
+        engine.set_prefill_budget(engine._chunk * 2)
+        req = GenRequest(prompt="hi", max_new_tokens=4)
+        engine.submit(req)
+        deadline = time.monotonic() + 30
+        done = False
+        while time.monotonic() < deadline:
+            kind, _val = req.out.get(timeout=30)
+            if kind in ("done", "error"):
+                done = kind == "done"
+                break
+        assert done
+
+    def test_apply_engine_knobs_maps_and_clamps(self, engine):
+        old = engine._depth
+        try:
+            applied = ap.apply_engine_knobs(
+                engine, {"lookahead": 999, "unknown_knob": 7},
+            )
+            assert applied == {"lookahead": 64}
+        finally:
+            engine.set_lookahead(old)
+
+    def test_restore_slots_setter_requires_host_kv_engine(self, engine):
+        # This config has no host-KV tier, so the setter still clamps
+        # and writes the live attribute the restore loop would read.
+        assert engine.set_kv_restore_slots(3) == 3
+        assert engine._restore_slots == 3
+
+
+class TestLiveRouteWeights:
+    def test_route_weights_live_on_pool(self):
+        from polykey_tpu.engine.replica_pool import ReplicaPool
+
+        pool = ReplicaPool(replace(CONFIG, replicas=2))
+        assert pool.set_route_weights(delay=4.0) == (1.0, 4.0)
+        assert pool._route_delay_weight == 4.0   # what _route reads
+        assert pool.set_route_weights(prefix=0.5) == (0.5, 4.0)
+        setpoints = pool.knob_setpoints()
+        assert setpoints["route_delay_weight"] == 4.0
+
+
+# -- 3. lifecycle: refuse-to-start, pause/re-arm, elastic pool ---------------
+
+
+class TestRefuseToStart:
+    def test_typed_error_when_signal_plane_off(self):
+        eng = InferenceEngine(replace(CONFIG, signals_interval_s=0.0))
+        try:
+            with pytest.raises(ap.AutopilotUnavailableError):
+                ap.Autopilot(eng, config=CFG).start()
+        finally:
+            eng.shutdown()
+
+    def test_starts_and_publishes_on_target(self, engine):
+        pilot = ap.Autopilot(engine, config=CFG).start()
+        try:
+            assert engine.autopilot is pilot
+            from polykey_tpu.obs.signals import signals_snapshot
+
+            assert "autopilot" in signals_snapshot(engine)
+        finally:
+            pilot.stop()
+        assert engine.autopilot is None
+
+
+class TestPauseRearm:
+    def test_pause_blocks_ticks_and_restart_reapplies(self, engine):
+        pilot = ap.Autopilot(engine, config=CFG).start()
+        try:
+            pilot.state.setpoints[ap.LOOKAHEAD] = 5
+            pilot._on_trip(engine, "watchdog stall")
+            assert pilot.paused
+            assert pilot.tick(now=100.0) == []   # paused → no control
+            # The "fresh engine" after a supervised restart boots with
+            # config-default knobs; the restart listener must re-apply
+            # the CURRENT setpoints before re-arming.
+            class FreshEngine:
+                def set_lookahead(self, depth):
+                    self.depth = depth
+                    return depth
+
+            fresh = FreshEngine()
+            pilot._on_restart(fresh)
+            assert fresh.depth == 5
+            assert not pilot.paused
+        finally:
+            pilot.stop()
+
+    def test_snapshot_shape(self, engine):
+        pilot = ap.Autopilot(engine, config=CFG).start()
+        try:
+            snap = pilot.snapshot()
+            assert snap["enabled"] is True
+            assert snap["paused"] is False
+            assert isinstance(snap["setpoints"], dict)
+            assert snap["decisions"] == []
+        finally:
+            pilot.stop()
+
+
+def make_pool() -> DisaggPool:
+    pool = DisaggPool(replace(CONFIG, max_queue_depth=4))
+    for tier in (PREFILL, DECODE):
+        for i in range(2):
+            worker = _Worker(tier=tier, index=i, state=SERVING,
+                             addr=("127.0.0.1", 1))   # nothing listens
+            worker.ping = {"queue_delay_s": 0.01, "load": 0.1}
+            pool.workers.append(worker)
+    return pool
+
+
+class TestElasticPool:
+    def test_tier_now_shape_and_null_verdict(self):
+        pool = make_pool()
+        tiers = pool.tier_now()
+        assert tiers[DECODE]["serving"] == 2
+        assert tiers[DECODE]["queue_delay_s"] == 0.01
+        for worker in pool.workers:
+            worker.ping = {}
+        assert pool.tier_now()[DECODE]["queue_delay_s"] is None
+
+    def test_scale_down_drains_before_kill(self):
+        pool = make_pool()
+        # Grab the victim BEFORE actuating: the fake addr refuses
+        # connections instantly, so the drain thread can finish and
+        # remove the worker from the pool before this thread resumes.
+        victim = next(w for w in pool.workers
+                      if w.tier == DECODE and w.index == 1)
+        name = pool.scale_down(DECODE)
+        assert name == "decode/1"   # highest index first
+        # The FIRST observable effect is DRAINING (out of routing) with
+        # the retiring mark — the kill only happens after the drain
+        # thread sees an idle worker (or its connection is already
+        # gone, in which case DEAD is a legitimate sighting).
+        assert victim.retiring
+        assert victim.state in (DRAINING, DEAD)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if victim not in pool.workers:
+                break
+            time.sleep(0.05)
+        assert victim not in pool.workers
+        assert victim.state == DEAD
+
+    def test_scale_down_refuses_last_serving_worker(self):
+        pool = make_pool()
+        pool.workers = [w for w in pool.workers
+                        if not (w.tier == DECODE and w.index == 1)]
+        assert pool.scale_down(DECODE) is None
+
+    def test_retiring_worker_death_never_respawns(self):
+        pool = make_pool()
+        victim = next(w for w in pool.workers
+                      if w.tier == DECODE and w.index == 1)
+        victim.retiring = True
+        victim.state = DRAINING
+        pool._on_worker_down(victim, "sigkill mid-drain")
+        assert victim.state == DEAD
+        assert victim not in pool.workers
+        assert pool.tier_restores[DECODE] == 0   # no restart burned
+
+    def test_scale_up_refuses_without_process_factory(self):
+        pool = make_pool()   # test-constructed: no _seed/_spawner wiring
+        assert pool.scale_up(DECODE) is None
+
+    def test_apply_knobs_remembers_setpoints(self):
+        pool = make_pool()
+        pool.apply_knobs({"lookahead": 4})
+        assert pool._knob_setpoints == {"lookahead": 4}
+
+    def test_signals_available_follows_interval(self):
+        from polykey_tpu.obs.signals import signals_available
+
+        assert signals_available(make_pool())
+        off = DisaggPool(replace(CONFIG, signals_interval_s=0.0))
+        assert not signals_available(off)
