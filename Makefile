@@ -12,6 +12,7 @@ BUILD_DIR := build
 	obs-smoke chaos-smoke print-chaos occupancy-smoke occupancy-soak \
 	failover-smoke failover-soak timeline-capture perf-gate \
 	perf-gate-reference flightwatch ragged-smoke ragged-soak \
+	spec-smoke \
 	disagg-smoke disagg-soak hostkv-smoke hostkv-soak \
 	autopilot-smoke autopilot-soak \
 	postmortem postmortem-smoke
@@ -114,6 +115,12 @@ occupancy-smoke: ## Poisson-load occupancy soak at CI scale (gated >= 0.7)
 # acceptance measurement.
 ragged-smoke: ## Ragged kernel interpret parity + engine bit-identity vs bucketed
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/ragged_smoke.py
+
+# Speculative rounds (ISSUE 19): the fused accept/merge core's
+# jit-vs-eager parity plus engine greedy bit-identity across plain,
+# spec-on-bucketed, and spec-on-ragged at lookahead depths 1 and 2.
+spec-smoke: ## Accept/merge interpret parity + spec-on-ragged bit-identity vs bucketed/plain
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/spec_smoke.py
 
 # Host-memory KV tier (ISSUE 15): sticky multi-turn sessions at 1.5x
 # the device pool — gates zero failed RPCs, greedy streams bit-identical
@@ -355,6 +362,7 @@ ci-check: ## Run the CI pipeline locally: lint+polylint+racelint+graphlint+memli
 	@$(MAKE) postmortem-smoke
 	@$(MAKE) occupancy-smoke
 	@$(MAKE) ragged-smoke
+	@$(MAKE) spec-smoke
 	@$(MAKE) hostkv-smoke
 	@$(MAKE) autopilot-smoke
 	@$(MAKE) obs-smoke

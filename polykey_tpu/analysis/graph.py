@@ -543,6 +543,7 @@ class GraphEnv:
         self._plain = None
         self._spec = None
         self._ragged = None
+        self._spec_ragged = None
         self._hostkv = None
         self._bf16 = None
         self._train = None
@@ -625,6 +626,27 @@ class GraphEnv:
             self._ragged = InferenceEngine(config)
         return self._ragged
 
+    def spec_ragged_engine(self):
+        """Warmed CPU engine with BOTH the draft model and the ragged
+        dispatch path on (ISSUE 19): the unified spec×ragged path's
+        whole claim is that gamma-token verify windows ride the flat
+        stream as ordinary ranges — GL001 asserts the path adds zero
+        post-warmup executables at both lookahead depths, and GL004's
+        census pins its sanctioned-crossing set to the bucketed spec
+        engine's (the unification must not mint new crossings)."""
+        if self._spec_ragged is None:
+            import dataclasses
+
+            from ..engine.engine import InferenceEngine
+
+            self.logs.append("building spec x ragged CPU engine (warmup)")
+            config = dataclasses.replace(
+                self._base_config(), draft_model="tiny-llama",
+                spec_gamma=2, ragged_dispatch=True,
+            )
+            self._spec_ragged = InferenceEngine(config)
+        return self._spec_ragged
+
     def hostkv_engine(self):
         """Warmed CPU engine with the host KV tier active (ISSUE 15):
         a deliberately TIGHT device pool + an aggressive resident
@@ -654,6 +676,7 @@ class GraphEnv:
         if self.profile != "smoke":
             yield "engine.spec", self.spec_engine()
             yield "engine.ragged", self.ragged_engine()
+            yield "engine.spec_ragged", self.spec_ragged_engine()
             yield "engine.hostkv", self.hostkv_engine()
 
     def jit_handles(self, engine) -> dict[str, object]:
@@ -672,6 +695,19 @@ class GraphEnv:
             # cold-handle check would misread an intentional zero).
             del handles["_jit_prefill"]
             handles["_jit_ragged"] = engine._jit_ragged
+            if engine._spec:
+                # Unified path (ISSUE 19): admissions ride the ragged
+                # stream, so the bucketed spec prefill never compiles
+                # either (census-watched like _jit_prefill); the plain
+                # ragged handle only holds the gate-fail fallback, which
+                # is warmed (and reachable) only without the top-p
+                # prefilter on sampled-warm builds.
+                del handles["_jit_spec_prefill"]
+                handles["_jit_ragged_spec"] = engine._jit_ragged_spec
+                cfg = engine.config
+                if not (cfg.warm_sampled_variants
+                        and cfg.top_p_candidates == 0):
+                    del handles["_jit_ragged"]
         if engine._host_kv is not None:
             # The host tier's fixed-width gather/scatter pair (ISSUE
             # 15): warmed at construction, and a spill or page fault
@@ -778,18 +814,55 @@ class GraphEnv:
             slot_state = (dev["last_tokens"], dev["seq_lens"], dev["active"])
             if engine._spec:
                 pools = (engine.paged, engine.d_paged)
-                yield (
-                    f"{engine_label}._jit_spec_prefill",
-                    partial(
-                        engine._jit_spec_prefill.lower,
-                        engine.params, engine.draft_params,
-                        engine.model_cfg, engine.draft_cfg,
-                        engine.paged, engine.d_paged, *window,
-                        greedy=True, candidates=cfg.top_p_candidates,
-                        mesh=engine.mesh,
-                    ),
-                    count_big_leaves(pools),
-                )
+                # The per-lane gamma dial donates alongside the slot
+                # state (ISSUE 19): it advances on device every round.
+                dial = (dev["accept_ewma"], dev["gamma_lane"])
+                if engine._ragged:
+                    # Unified path: the prefill site IS the mixed
+                    # spec×ragged dispatch — audit ITS donations (both
+                    # pools + slot state + dial) instead of the bucketed
+                    # spec prefill it never compiles.
+                    from ..engine.engine import ragged_zero_operands
+
+                    B = cfg.max_decode_slots
+                    gmax = engine._gamma_max
+                    pre = ragged_zero_operands(
+                        B, engine._ragged_spec_width[gmax],
+                        cfg.pages_per_seq,
+                    )
+                    yield (
+                        f"{engine_label}._jit_ragged_spec",
+                        partial(
+                            engine._jit_ragged_spec.lower,
+                            engine.params, engine.draft_params,
+                            engine.model_cfg, engine.draft_cfg,
+                            engine.paged, engine.d_paged,
+                            dev["last_tokens"], dev["seq_lens"],
+                            dev["page_tables"], dev["active"],
+                            dev["caps"], dev["seeds"],
+                            dev["temperature"], dev["top_p"],
+                            dev["top_k"], *dial, *pre,
+                            gamma=gmax,
+                            eos_id=engine.tokenizer.eos_id,
+                            gamma_low=engine._gamma_low,
+                            gamma_max=engine._gamma_max,
+                            greedy=True, candidates=0, mesh=engine.mesh,
+                        ),
+                        count_big_leaves((pools, slot_state, dial)),
+                    )
+                else:
+                    yield (
+                        f"{engine_label}._jit_spec_prefill",
+                        partial(
+                            engine._jit_spec_prefill.lower,
+                            engine.params, engine.draft_params,
+                            engine.model_cfg, engine.draft_cfg,
+                            engine.paged, engine.d_paged, *window,
+                            greedy=True, candidates=cfg.top_p_candidates,
+                            mesh=engine.mesh,
+                        ),
+                        count_big_leaves(pools),
+                    )
                 yield (
                     f"{engine_label}._jit_spec_decode",
                     partial(
@@ -800,12 +873,14 @@ class GraphEnv:
                         dev["last_tokens"], dev["seq_lens"],
                         dev["page_tables"], dev["active"], dev["caps"],
                         dev["seeds"], dev["temperature"], dev["top_p"],
-                        dev["top_k"],
+                        dev["top_k"], *dial,
                         gamma=engine._gamma_max,
                         eos_id=engine.tokenizer.eos_id,
+                        gamma_low=engine._gamma_low,
+                        gamma_max=engine._gamma_max,
                         candidates=0, mesh=engine.mesh,
                     ),
-                    count_big_leaves((pools, slot_state)),
+                    count_big_leaves((pools, slot_state, dial)),
                 )
             elif engine._ragged:
                 # The ragged engine's prefill site IS the mixed ragged
@@ -967,10 +1042,12 @@ class GraphEnv:
             yield (f"engine.{label}._ragged_fn", ragged, weight_shapes, bf16)
 
     def close(self) -> None:
-        for engine in (self._plain, self._spec, self._ragged, self._bf16):
+        for engine in (self._plain, self._spec, self._ragged,
+                       self._spec_ragged, self._hostkv, self._bf16):
             if engine is not None:
                 engine.shutdown()
-        self._plain = self._spec = self._ragged = self._bf16 = None
+        self._plain = self._spec = self._ragged = None
+        self._spec_ragged = self._hostkv = self._bf16 = None
         self._jaxprs = None
 
 
@@ -1027,11 +1104,23 @@ class RecompileStability(GraphCheck):
                 # the ragged engine must never compile a bucketed
                 # variant — not an absolute-zero claim.
                 prefill_before = engine._jit_prefill._cache_size()
+                if engine._spec:
+                    # Same delta claim for the bucketed spec prefill on
+                    # the unified path (ISSUE 19): admissions ride the
+                    # ragged stream, never the spec prefill buckets.
+                    spec_prefill_before = (
+                        engine._jit_spec_prefill._cache_size()
+                    )
             found, sizes = recompile_findings(label, handles, sweep)
             if engine._ragged:
                 sizes["_jit_prefill(bucketed)"] = (
                     prefill_before, engine._jit_prefill._cache_size()
                 )
+                if engine._spec:
+                    sizes["_jit_spec_prefill(bucketed)"] = (
+                        spec_prefill_before,
+                        engine._jit_spec_prefill._cache_size(),
+                    )
             findings.extend(found)
             census[label] = (engine, sizes)
             env.logs.append(
@@ -1051,37 +1140,53 @@ class RecompileStability(GraphCheck):
         identical geometry — one resident ragged executable replacing
         buckets × pad-groups × greedy variants."""
         findings: list[Finding] = []
-        if "engine.plain" not in census or "engine.ragged" not in census:
-            return findings
-        _, plain_sizes = census["engine.plain"]
-        _, ragged_sizes = census["engine.ragged"]
-        before, after = ragged_sizes.pop("_jit_prefill(bucketed)", (0, 0))
-        if after > before:
-            findings.append(graph_finding(
-                "GL001", "graph:engine.ragged",
-                "engine.ragged:_jit_prefill:not-gone",
-                f"the ragged engine compiled {after - before} bucketed "
-                "prefill executable(s) during its sweep — the ragged "
-                "path exists to make the per-bucket variants "
-                "unreachable, so any compile here means a code path "
-                "leaked back to the bucket table",
-            ))
-        plain_total = sum(a for _, a in plain_sizes.values())
-        ragged_total = sum(a for _, a in ragged_sizes.values())
-        if ragged_total >= plain_total:
-            findings.append(graph_finding(
-                "GL001", "graph:engine.ragged",
-                "engine.ragged:census-not-smaller",
-                f"ragged executable census {ragged_total} is not "
-                f"strictly smaller than the bucketed engine's "
-                f"{plain_total} at identical geometry — the single "
-                "resident ragged executable must REPLACE the per-bucket "
-                "prefill variants, not add to them",
-            ))
-        env.logs.append(
-            f"GL001 census: bucketed={plain_total} ragged={ragged_total} "
-            f"(ragged sweep bucketed-prefill {before}->{after})"
-        )
+        pairs = [
+            # (ragged-mode label, bucketed baseline, watched-gone handles)
+            ("engine.ragged", "engine.plain", ("_jit_prefill",)),
+            ("engine.spec_ragged", "engine.spec",
+             ("_jit_prefill", "_jit_spec_prefill")),
+        ]
+        for ragged_label, plain_label, gone_handles in pairs:
+            if ragged_label not in census or plain_label not in census:
+                continue
+            _, plain_sizes = census[plain_label]
+            _, ragged_sizes = census[ragged_label]
+            watched = []
+            for name in gone_handles:
+                before, after = ragged_sizes.pop(
+                    f"{name}(bucketed)", (0, 0)
+                )
+                watched.append((name, before, after))
+                if after > before:
+                    findings.append(graph_finding(
+                        "GL001", f"graph:{ragged_label}",
+                        f"{ragged_label}:{name}:not-gone",
+                        f"the {ragged_label} engine compiled "
+                        f"{after - before} bucketed {name} "
+                        "executable(s) during its sweep — the ragged "
+                        "path exists to make the per-bucket variants "
+                        "unreachable, so any compile here means a code "
+                        "path leaked back to the bucket table",
+                    ))
+            plain_total = sum(a for _, a in plain_sizes.values())
+            ragged_total = sum(a for _, a in ragged_sizes.values())
+            if ragged_total >= plain_total:
+                findings.append(graph_finding(
+                    "GL001", f"graph:{ragged_label}",
+                    f"{ragged_label}:census-not-smaller",
+                    f"{ragged_label} executable census {ragged_total} "
+                    "is not strictly smaller than the bucketed "
+                    f"{plain_label} engine's {plain_total} at identical "
+                    "geometry — the single resident ragged executable "
+                    "must REPLACE the per-bucket prefill variants, not "
+                    "add to them",
+                ))
+            env.logs.append(
+                f"GL001 census: {plain_label}={plain_total} "
+                f"{ragged_label}={ragged_total} (" + ", ".join(
+                    f"{n} {b}->{a}" for n, b, a in watched
+                ) + ")"
+            )
         return findings
 
 
@@ -1145,6 +1250,32 @@ class DtypePolicy(GraphCheck):
 # -- GL004: host-transfer guard -----------------------------------------------
 
 
+# Sanctioned-crossing census (ISSUE 19 satellite): the exact set of
+# engine._host_crossing() sites each engine MODE is allowed to fire
+# during the guarded serving smoke. This pins the tentpole's crossing
+# drop as a GATE: a speculative engine's steady state crosses at the
+# block boundary only ("spec-packed" — the once-per-round packed D2H
+# that carries tokens, counts, AND the gamma dial), plus the cold-path
+# admission/retire scalar sites every mode shares. A new fired site =
+# a new per-dispatch host tax someone added without sanctioning it
+# here; an expected site that never fires = the fixture stopped
+# exercising a crossing this check claims to cover.
+_BASE_CROSSINGS = frozenset({
+    "merge-upload",         # lane merge scalar upload (admission)
+    "first-token-resolve",  # cold-path first-token readback
+    "retire-upload",        # retire scalar upload
+})
+SANCTIONED_CROSSINGS: dict[str, frozenset] = {
+    "engine.plain": _BASE_CROSSINGS | {"block-packed"},
+    "engine.ragged": _BASE_CROSSINGS | {"block-packed"},
+    "engine.spec": _BASE_CROSSINGS | {"spec-packed"},
+    "engine.spec_ragged": _BASE_CROSSINGS | {"spec-packed"},
+    "engine.hostkv": _BASE_CROSSINGS | {
+        "block-packed", "kv-evict-gather", "kv-fault-restore",
+    },
+}
+
+
 @register_graph
 class HostTransferGuard(GraphCheck):
     """Two halves. Static: the step jaxprs contain no callback/infeed/
@@ -1180,6 +1311,8 @@ class HostTransferGuard(GraphCheck):
         # read, or the guard trips here.
         import jax
 
+        from ..engine.engine import CROSSING_CENSUS
+
         findings: list[Finding] = []
         for label, engine in env.engines():
             waves = env.request_mix(sampled=False)
@@ -1195,6 +1328,7 @@ class HostTransferGuard(GraphCheck):
             previous = {o: getattr(jax.config, o) for o in direction_opts}
             previous_umbrella = jax.config.jax_transfer_guard
             configured_depth = engine._depth
+            census_before = dict(CROSSING_CENSUS)
             jax.config.update("jax_transfer_guard", "disallow")
             try:
                 errors = []
@@ -1208,6 +1342,9 @@ class HostTransferGuard(GraphCheck):
                 jax.config.update("jax_transfer_guard", previous_umbrella)
                 for opt, value in previous.items():
                     jax.config.update(opt, value)
+            findings.extend(self._census_findings(
+                label, census_before, dict(CROSSING_CENSUS), env,
+            ))
             for error in errors:
                 key = f"{label}:guarded-smoke"
                 if "transfer" in error.lower():
@@ -1252,6 +1389,48 @@ class HostTransferGuard(GraphCheck):
             "GL004 guarded smoke: "
             + ("CLEAN" if not findings else f"{len(findings)} finding(s)")
         )
+        return findings
+
+    @staticmethod
+    def _census_findings(label: str, before: dict, after: dict,
+                         env) -> list[Finding]:
+        """Sanctioned-crossing census for one engine's guarded sweep:
+        the set of _host_crossing sites that FIRED (count delta > 0)
+        must equal the mode's pinned SANCTIONED_CROSSINGS entry. The
+        per-site deltas are logged, so a census regression names the
+        site and its per-sweep crossing count."""
+        expected = SANCTIONED_CROSSINGS.get(label)
+        if expected is None:
+            return []
+        deltas = {
+            site: after.get(site, 0) - before.get(site, 0)
+            for site in set(after) | set(before)
+        }
+        fired = {site for site, n in deltas.items() if n > 0}
+        env.logs.append(
+            f"GL004 {label} crossing census: " + (", ".join(
+                f"{site}={deltas[site]}" for site in sorted(fired)
+            ) or "none")
+        )
+        findings: list[Finding] = []
+        for site in sorted(fired - expected):
+            findings.append(graph_finding(
+                "GL004", f"graph:{label}",
+                f"{label}:census:{site}",
+                f"unsanctioned host-crossing site '{site}' fired "
+                f"{deltas[site]}x during {label}'s guarded sweep — the "
+                "serving loop grew a host tax outside the pinned census "
+                "(add a per-block/cold-path justification to "
+                "SANCTIONED_CROSSINGS or remove the crossing)",
+            ))
+        for site in sorted(expected - fired):
+            findings.append(graph_finding(
+                "GL000", f"graph:{label}",
+                f"{label}:census-not-exercised:{site}",
+                f"sanctioned crossing site '{site}' never fired during "
+                f"{label}'s guarded sweep — the fixture no longer "
+                "exercises a crossing the census claims to cover",
+            ))
         return findings
 
 

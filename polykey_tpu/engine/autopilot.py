@@ -7,6 +7,7 @@ tick and drives bounded, hysteretic, cooldown-rate-limited actuations:
 - **knobs** — dispatch lookahead from host-stall/device-busy evidence,
   prefill budget from interactive-arrival presence, KV restore slots
   and the host-KV resident floor from the PR 15 fault/restore signals,
+  the speculative gamma cap from windowed draft-acceptance evidence,
   router delay weight from per-replica TTFT skew;
 - **capacity** — disagg prefill and decode tiers scale independently
   from per-tier queue-delay evidence (scale-down drains before
@@ -70,6 +71,7 @@ LOOKAHEAD = "lookahead"
 PREFILL_BUDGET = "prefill_budget"
 RESTORE_SLOTS = "restore_slots"
 RESIDENT_FLOOR = "resident_floor"
+SPEC_GAMMA = "spec_gamma"
 ROUTE_DELAY_WEIGHT = "route_delay_weight"
 SCALE_PREFILL = "scale_prefill"
 SCALE_DECODE = "scale_decode"
@@ -82,6 +84,7 @@ _ENGINE_KNOB_SETTERS = {
     PREFILL_BUDGET: "set_prefill_budget",
     RESTORE_SLOTS: "set_kv_restore_slots",
     RESIDENT_FLOOR: "set_resident_floor",
+    SPEC_GAMMA: "set_spec_gamma",
 }
 
 
@@ -129,6 +132,12 @@ class AutopilotConfig:
     fault_low_per_min: float = 0.0    # kv quiet: decay toward baseline
     ttft_skew_high_ms: float = 500.0  # per-replica p95 spread: weight delay
     ttft_skew_low_ms: float = 100.0   # spread healed: decay weight
+    # Speculative acceptance band (ISSUE 19). Mirrors the device-side
+    # per-lane dial constants (GAMMA_ACCEPT_FLOOR/CEIL in spec_decode):
+    # the host controller moves the engine-wide cap, the device dial
+    # moves each lane inside it.
+    spec_accept_low: float = 0.35    # acceptance collapsed: cap the rung
+    spec_accept_high: float = 0.55   # acceptance healthy: restore boot cap
 
     @staticmethod
     def enabled_from_env() -> bool:
@@ -424,6 +433,46 @@ def decide_resident_floor(summary: Optional[dict], state: ControllerState,
     return None
 
 
+def decide_gamma(summary: Optional[dict], state: ControllerState,
+                 cfg: AutopilotConfig, now: float) -> Optional[Decision]:
+    """Speculation width from windowed acceptance evidence (ISSUE 19):
+    when the fleet-wide accept rate collapses below the band, every
+    verify position past the first is wasted target compute — snap the
+    engine's gamma cap down to the low ladder rung (set_spec_gamma
+    rung-snaps, so any value below gamma_max lands on gamma_low).
+    Healthy acceptance above the band restores the boot cap. The
+    per-lane device dial handles per-sequence variation INSIDE the cap;
+    this knob is the coarse host override for workload-wide collapse.
+    The setpoint only exists when the engine booted with a draft model
+    (knob_setpoints gates it), so the action is armed iff spec is on."""
+    if summary is None or not _ready(state, SPEC_GAMMA, cfg, now):
+        return None
+    old = state.setpoints.get(SPEC_GAMMA)
+    if old is None:
+        return None  # no draft model on this target: never arms
+    rate = summary.get("spec_accept_rate")
+    if rate is None:
+        return None  # no drafts proposed in the window: null verdict
+    lo, _hi = state.bounds.get(SPEC_GAMMA, (1, old))
+    if rate < cfg.spec_accept_low and old > lo:
+        return Decision(
+            SPEC_GAMMA, DOWN,
+            f"spec accept rate {rate:.2f} < {cfg.spec_accept_low:.2f}; "
+            "capping speculation at the low rung",
+            rate, old, lo,
+        )
+    if (rate > cfg.spec_accept_high
+            and old < state.baselines.get(SPEC_GAMMA, old)):
+        new = state.baselines[SPEC_GAMMA]
+        return Decision(
+            SPEC_GAMMA, UP,
+            f"spec accept rate {rate:.2f} > {cfg.spec_accept_high:.2f}; "
+            "restoring boot gamma cap",
+            rate, old, new,
+        )
+    return None
+
+
 def decide_route_weights(replicas: Optional[dict], state: ControllerState,
                          cfg: AutopilotConfig,
                          now: float) -> Optional[Decision]:
@@ -525,6 +574,7 @@ def evaluate(snapshot: dict, state: ControllerState, cfg: AutopilotConfig,
         decide_prefill_budget(summary, pool_windows, state, cfg, now),
         decide_restore_slots(summary, state, cfg, now),
         decide_resident_floor(summary, state, cfg, now),
+        decide_gamma(summary, state, cfg, now),
         decide_route_weights(snapshot.get("replicas"), state, cfg, now),
         decide_scale("prefill", snapshot.get("tiers"), state, cfg, now),
         decide_scale("decode", snapshot.get("tiers"), state, cfg, now),
@@ -637,6 +687,8 @@ class Autopilot:
                 knobs["resident_floor"] = (
                     config.host_kv_resident_pages or config.num_pages // 8
                 )
+            if config.draft_model:
+                knobs["spec_gamma"] = config.spec_gamma
         state.setpoints[LOOKAHEAD] = knobs["lookahead"]
         state.baselines[LOOKAHEAD] = knobs["lookahead"]
         state.bounds[LOOKAHEAD] = (
@@ -662,6 +714,16 @@ class Autopilot:
             state.bounds[RESIDENT_FLOOR] = (
                 floor, max(floor, config.num_pages // 2)
             )
+        if "spec_gamma" in knobs:
+            cap = knobs["spec_gamma"]
+            state.setpoints[SPEC_GAMMA] = cap
+            state.baselines[SPEC_GAMMA] = cap
+            if engines:
+                low = engines[0]._gamma_low
+            else:
+                low = (max(1, config.spec_gamma // 2)
+                       if config.adaptive_gamma else config.spec_gamma)
+            state.bounds[SPEC_GAMMA] = (low, cap)
         if hasattr(self.target, "set_route_weights"):
             weight = config.route_delay_weight
             state.setpoints[ROUTE_DELAY_WEIGHT] = weight
@@ -772,7 +834,7 @@ class Autopilot:
             self.state.setpoints[decision.action] = applied
         key = (decision.action, decision.direction)
         with self._lock:
-            # polylint: disable=ML002(keyed by (action, direction): 7 static action names x 2 directions, not per-request data)
+            # polylint: disable=ML002(keyed by (action, direction): 8 static action names x 2 directions, not per-request data)
             self.decisions_total[key] = self.decisions_total.get(key, 0) + 1
             self.decisions.append(
                 {"t": round(now, 3), **decision.as_dict()}

@@ -140,8 +140,11 @@ class EngineConfig:
     # greedy streams match the bucketed path token-for-token.
     # POLYKEY_RAGGED=1 enables; POLYKEY_DISABLE_RAGGED=1 is the
     # operational kill-switch (wins over config/env enablement, the
-    # POLYKEY_DISABLE_PAGED_KERNEL pattern). Requires dp=sp=pp=1 and no
-    # draft model (the spec round has no ragged formulation yet).
+    # POLYKEY_DISABLE_PAGED_KERNEL pattern). Requires dp=sp=pp=1.
+    # Composes with speculative decoding (ISSUE 19): gamma-token verify
+    # windows ride the flat stream as ordinary per-sequence ranges, so
+    # one mixed dispatch serves prefill chunks, decode lanes, AND spec
+    # verify lanes.
     ragged_dispatch: bool = False
 
     # Automatic prefix caching (engine/prefix_cache.py): requests sharing a
@@ -329,6 +332,14 @@ class EngineConfig:
     # forwards per round. Page/position slack always reserves for the
     # full spec_gamma, so adaptation never overflows a slot.
     adaptive_gamma: bool = True
+
+    # A/B instrumentation ONLY (scripts/occupancy_soak.py --ab-spec):
+    # emulate the pre-ISSUE-19 host-loop spec round by forcing three
+    # synchronous packed readbacks at dispatch time — the crossing
+    # schedule of the old path on the new path's math, so the A/B
+    # isolates the host tax. Never set in production; programmatic only
+    # (no env knob on purpose — it exists to measure a regression).
+    spec_host_sync: bool = False
 
     # Liveness. The watchdog window must comfortably exceed worst-case XLA
     # compile time (each new prefill bucket compiles on first use).
@@ -629,13 +640,10 @@ class EngineConfig:
         if self.prefill_chunk < 0:
             raise ValueError("prefill_chunk must be >= 0 (0 → max bucket)")
         if self.ragged_dispatch:
-            if self.draft_model is not None:
-                raise ValueError(
-                    "ragged_dispatch has no speculative formulation yet "
-                    "(the spec round verifies gamma-token windows, not a "
-                    "flat mixed stream) — unset POLYKEY_RAGGED or the "
-                    "draft model"
-                )
+            # Speculative engines ride the same flat stream since
+            # ISSUE 19: verify windows are ordinary per-sequence ranges,
+            # so draft models compose with ragged_dispatch (the old
+            # refusal is gone).
             if self.dp * self.num_slices > 1 or self.sp > 1 or self.pp > 1:
                 raise ValueError(
                     "ragged_dispatch serves tp-at-most meshes: the flat "

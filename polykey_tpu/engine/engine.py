@@ -92,7 +92,16 @@ from .sampling import sample_tail
 from .tokenizer import load_tokenizer
 
 
-def _host_crossing():
+# Sanctioned-crossing census (ISSUE 19): every _host_crossing scope names
+# its site; entries count here so graphlint GL004 can pin the SET of
+# crossing sites a serving smoke actually exercises per engine mode — the
+# device-resident spec round's 5→2 per-round drop is a committed gate
+# (analysis/graph.py SANCTIONED_CROSSINGS), not a claim. Engine-thread
+# writes only; GL004 snapshots deltas around its guarded drive.
+CROSSING_CENSUS: dict = {}
+
+
+def _host_crossing(site: str = "unlabeled"):
     """Deliberate host<->device crossing point: resolve-point reads
     (np.asarray of landed blocks/tokens) and the tiny numpy scalars the
     lane merge/retire dispatches upload. graphlint GL004 smokes the
@@ -101,12 +110,17 @@ def _host_crossing():
     the loop path trips the guard there instead of shipping silently.
     (PL001 is the source-tier mirror of the same invariant.)
 
+    `site` labels the crossing for the census above; call sites pass a
+    stable name (GL004 asserts the fired set against the committed
+    table).
+
     Fast path: with no guard configured (every run except the GL004
     smoke) this is a nullcontext — the real jax context manager costs
     ~30 us per entry, which the per-block process path should not pay.
     The three per-direction options are what actually gate transfers
     (the umbrella jax_transfer_guard propagates INTO them on update but
     doesn't reflect a per-direction update), so they are what we check."""
+    CROSSING_CENSUS[site] = CROSSING_CENSUS.get(site, 0) + 1
     if all(
         getattr(jax.config, opt) in (None, "allow")
         for opt in ("jax_transfer_guard_host_to_device",
@@ -396,8 +410,9 @@ def _ragged_fn(
 def _merge_lane_fn(
     last_tokens, seq_lens, page_tables, active, caps, temperature, top_p,
     top_k, seeds, tokens_vec, row, slot, seq_len, cap, temp, tp, tk,
-    table_row, seed_row,
-    *, eos_id: int,
+    table_row, seed_row, accept_ewma=None, gamma_lane=None,
+    gamma_reset=None,
+    *, eos_id: int, spec: bool = False,
 ):
     """Activate ONE decode lane entirely on device: splice the prefill's
     sampled token (still a device array — no host sync) and the slot's
@@ -409,10 +424,17 @@ def _merge_lane_fn(
 
     The lane is born live only if its first token isn't EOS and the
     position budget allows generation (the same conditions the host's
-    _maybe_finish applies when it later emits the first token)."""
+    _maybe_finish applies when it later emits the first token).
+
+    Speculative engines (`spec=True`) also carry the per-lane gamma dial
+    (ISSUE 19) in the donated slot state: a fresh lane starts with an
+    optimistic acceptance EWMA of 1.0 and its dial at `gamma_reset`
+    (= gamma_max), exactly like the old engine-global ladder's boot
+    state — the dial is per-REQUEST evidence, so it must not inherit the
+    previous occupant's history."""
     token = tokens_vec.reshape(-1)[row]   # [N] group/prefill token vector
     live = (token != eos_id) & (seq_len < cap)
-    return (
+    out = (
         last_tokens.at[slot].set(token),
         seq_lens.at[slot].set(seq_len),
         page_tables.at[slot].set(table_row),
@@ -423,6 +445,12 @@ def _merge_lane_fn(
         top_k.at[slot].set(tk),
         seeds.at[slot].set(seed_row),
     )
+    if spec:
+        out += (
+            accept_ewma.at[slot].set(1.0),
+            gamma_lane.at[slot].set(gamma_reset),
+        )
+    return out
 
 
 def _retire_lane_fn(last_tokens, seq_lens, page_tables, active, caps, slot):
@@ -737,9 +765,16 @@ class InferenceEngine:
             self._dp_vec, self._dp_vec, self._dp_vec, self._dp_vec,
             self._dp_mat,
         )
+        # Speculative engines carry two extra donated-state vectors (the
+        # per-lane acceptance EWMA + gamma dial, ISSUE 19) that the merge
+        # resets per admission.
+        merge_out = lane_out + (
+            (self._dp_vec, self._dp_vec)
+            if config.draft_model is not None else ()
+        )
         self._jit_merge = jax.jit(
-            _merge_lane_fn, static_argnames=("eos_id",),
-            out_shardings=lane_out,
+            _merge_lane_fn, static_argnames=("eos_id", "spec"),
+            out_shardings=merge_out,
         )
         self._jit_retire = jax.jit(
             _retire_lane_fn, out_shardings=lane_out[:5],
@@ -940,23 +975,35 @@ class InferenceEngine:
         # --- Speculative decoding: draft model + its own page pool, same
         # page tables (position → (page, offset) is model-independent).
         self._spec = config.draft_model is not None
-        # Adaptive gamma (VERDICT r2 #8: wire gamma to measured
-        # acceptance): dispatch gamma moves on a two-level ladder
-        # {max(1, γ/2), γ} driven by an acceptance EWMA with hysteresis —
-        # a bad draft stops wasting γ draft forwards per round, a good
-        # one keeps the full window. Page/position SLACK always reserves
-        # for _gamma_max, so a mid-stream gamma increase can never
-        # overflow a slot's pages. Each ladder level is its own compile;
-        # warmup covers both.
+        # Adaptive gamma (VERDICT r2 #8, per-lane since ISSUE 19): each
+        # LANE carries its own dial on a two-level ladder {max(1, γ/2), γ}
+        # driven by a per-lane acceptance EWMA with hysteresis, updated
+        # INSIDE the jitted round (spec_decode._accept_merge) — the dial
+        # rides the donated slot state, so it costs no crossings. The
+        # host-side `self._gamma` is now only the DISPATCH WIDTH: the
+        # ladder rung covering the widest active lane dial (recomputed
+        # from the packed round stats in _process_spec), clamped by the
+        # autopilot's `_gamma_cap` (set_spec_gamma). Page/position SLACK
+        # always reserves for _gamma_max, so a mid-stream dial increase
+        # can never overflow a slot's pages. Each ladder rung is its own
+        # compile; warmup covers both.
         self._gamma_max = config.spec_gamma if self._spec else 0
         self._gamma = self._gamma_max
         self._gamma_low = (
             max(1, config.spec_gamma // 2)
             if (self._spec and config.adaptive_gamma) else self._gamma_max
         )
+        self._gamma_cap = self._gamma_max   # autopilot bound (rung-snapped)
+        # Batch-aggregate acceptance EWMA, kept for observability/back-
+        # compat (stats()["spec_accept_ewma"]); the per-lane EWMAs below
+        # are what drive the dial.
         self._accept_ewma = 1.0          # optimistic start: full gamma
         if self._spec:
-            from .spec_decode import spec_decode_fn, spec_prefill_fn
+            from .spec_decode import (
+                ragged_spec_fn,
+                spec_decode_fn,
+                spec_prefill_fn,
+            )
 
             self.draft_cfg = get_config(config.draft_model)
             if self.draft_cfg.vocab_size != self.model_cfg.vocab_size:
@@ -1018,20 +1065,59 @@ class InferenceEngine:
             self._jit_spec_decode = jax.jit(
                 spec_decode_fn,
                 static_argnames=(
-                    "t_cfg", "d_cfg", "gamma", "eos_id", "candidates", "mesh",
+                    "t_cfg", "d_cfg", "gamma", "eos_id", "gamma_low",
+                    "gamma_max", "candidates", "mesh",
                 ),
                 # Same double-buffered slot-state donation as the plain
                 # decode block — spec rounds ride the identical pipeline.
+                # The per-lane gamma dial (accept_ewma / gamma_lane,
+                # ISSUE 19) donates alongside: it advances on device
+                # every round like the rest of the slot state.
                 donate_argnames=(
                     "t_paged", "d_paged",
                     "last_tokens", "seq_lens", "active",
+                    "accept_ewma", "gamma_lane",
                 ),
                 out_shardings=(
                     self._dp_mat, self._dp_vec, self._dp_vec, self._dp_vec,
-                    self._repl,
+                    self._dp_vec, self._dp_vec,
                     self._pool_sharding, self._pool_sharding,
                 ),
             )
+            self._jit_ragged_spec = None
+            if self._ragged:
+                # Spec×ragged unification (ISSUE 19 tentpole b): gamma-
+                # token verify windows ride the flat ragged stream as
+                # ordinary per-sequence ranges, so ONE mixed dispatch
+                # serves prefill chunks AND spec verify lanes. The flat
+                # stream is B·(γ+1)+W tokens, so the tile-aligned prefill
+                # width W is per-gamma (each ladder rung is its own
+                # compile anyway).
+                from ..ops.ragged_paged_attention_kernel import TOKEN_TILE
+
+                W0 = max(self._prefill_budget, self._chunk)
+                self._ragged_spec_width = {
+                    g: W0 + (-(B * (g + 1) + W0)) % TOKEN_TILE
+                    for g in sorted({self._gamma_low, self._gamma_max})
+                }
+                self._jit_ragged_spec = jax.jit(
+                    ragged_spec_fn,
+                    static_argnames=(
+                        "t_cfg", "d_cfg", "gamma", "eos_id", "gamma_low",
+                        "gamma_max", "greedy", "candidates", "mesh",
+                    ),
+                    donate_argnames=(
+                        "t_paged", "d_paged",
+                        "last_tokens", "seq_lens", "active",
+                        "accept_ewma", "gamma_lane",
+                    ),
+                    out_shardings=(
+                        self._dp_mat, self._dp_vec, self._dp_vec,
+                        self._dp_vec, self._dp_vec, self._dp_vec,
+                        self._repl,
+                        self._pool_sharding, self._pool_sharding,
+                    ),
+                )
 
         # Host mirrors of per-slot device state (engine thread only). They
         # are the source of truth at slot transitions (admit/finish mark
@@ -1047,6 +1133,14 @@ class InferenceEngine:
         self._top_p = np.ones((B,), dtype=np.float32)
         self._top_k = np.zeros((B,), dtype=np.int32)
         self._seeds = np.zeros((B, 2), dtype=np.int32)
+        # Per-lane gamma dial mirrors (spec engines, ISSUE 19): refreshed
+        # from each processed round's packed stat columns — the DEVICE
+        # copy is authoritative between slot transitions, exactly like
+        # the other mirrors.
+        self._lane_ewma = np.ones((B,), dtype=np.float32)
+        self._lane_gamma = np.full(
+            (B,), max(self._gamma_max, 1), dtype=np.int32
+        )
         self._slots: list[Optional[_Slot]] = [None] * B
         self._dev: dict = {}
         self._dev_dirty = True
@@ -1290,6 +1384,25 @@ class InferenceEngine:
         ))
         return self._resident_low
 
+    def set_spec_gamma(self, gamma: int) -> int:
+        """Upper bound on the speculative dispatch width (autopilot's
+        `decide_gamma`). Snapped to the nearest ladder rung — the per-
+        lane dial (device-resident) only ever takes rung values, and
+        each rung is its own compiled executable, so an off-rung cap
+        would either mask the dial or force a fresh compile. The cap
+        clamps the dispatch-width recompute in _process_spec; lane dials
+        keep adapting underneath it, so lifting the cap restores full
+        gamma within one round."""
+        if not self._spec:
+            return 0
+        g = int(gamma)
+        # Snap down to the low rung unless the cap clears the high one.
+        self._gamma_cap = (
+            self._gamma_max if g >= self._gamma_max else self._gamma_low
+        )
+        self._gamma = min(self._gamma, self._gamma_cap)
+        return self._gamma_cap
+
     def knob_setpoints(self) -> dict:
         """The live values of every actuated knob — what the loop will
         read on its next iteration, not what any config said at boot."""
@@ -1300,6 +1413,8 @@ class InferenceEngine:
         if self._host_kv is not None:
             out["restore_slots"] = self._restore_slots
             out["resident_floor"] = self._resident_low
+        if self._spec:
+            out["spec_gamma"] = self._gamma_cap
         return out
 
     @staticmethod
@@ -1365,7 +1480,38 @@ class InferenceEngine:
             # not the whole uptime — the staleness fix operators read.
             snap.update(signals.stats_fields())
         if self._spec:
-            snap["spec_gamma"] = self._gamma   # live dial value
+            # Dispatch width (the rung covering the widest active lane
+            # dial, under the autopilot cap) plus the per-lane dial/EWMA
+            # aggregates (ISSUE 19 satellite: the engine-global value is
+            # meaningless per-lane — mean/min/max over occupied lanes is
+            # what operators and the autopilot read).
+            snap["spec_gamma"] = self._gamma
+            snap["spec_gamma_cap"] = self._gamma_cap
+            occ = [
+                i for i, s in enumerate(self._slots) if s is not None
+            ]
+            if occ:
+                dials = self._lane_gamma[occ]
+                ewmas = self._lane_ewma[occ]
+                snap["spec_gamma_mean"] = round(float(dials.mean()), 4)
+                snap["spec_gamma_min"] = int(dials.min())
+                snap["spec_gamma_max"] = int(dials.max())
+                snap["spec_accept_ewma_mean"] = round(
+                    float(ewmas.mean()), 4
+                )
+                snap["spec_accept_ewma_min"] = round(
+                    float(ewmas.min()), 4
+                )
+                snap["spec_accept_ewma_max"] = round(
+                    float(ewmas.max()), 4
+                )
+            else:
+                snap["spec_gamma_mean"] = float(self._gamma)
+                snap["spec_gamma_min"] = self._gamma
+                snap["spec_gamma_max"] = self._gamma
+                snap["spec_accept_ewma_mean"] = 1.0
+                snap["spec_accept_ewma_min"] = 1.0
+                snap["spec_accept_ewma_max"] = 1.0
         if self._prefix is not None:
             snap.update(self._prefix.stats())
         # Host-KV tier (ISSUE 15): always present — collectors index
@@ -1996,6 +2142,12 @@ class InferenceEngine:
         [(slot_idx, slot, take)]; empty means no prefill work this
         iteration (steady-state decode keeps the K-step block path)."""
         W = self._ragged_width
+        if self._spec and self._jit_ragged_spec is not None:
+            # Spec engines may route these ranges through the per-gamma
+            # tile-aligned spec stream, whose prefill width can sit up to
+            # a tile short of the plain one — build to the tightest so a
+            # batch fits whichever executable the spec gate picks.
+            W = min(W, min(self._ragged_spec_width.values()))
         decode_live = bool(self._active.any())
         budget = min(self._prefill_budget, W) if decode_live else W
         ranges: list = []
@@ -2033,16 +2185,15 @@ class InferenceEngine:
             self._chunk_rr = (self._chunk_rr + 1) % B
         return ranges
 
-    def _dispatch_ragged(self, ranges: list):
-        """ONE flat mixed prefill+decode dispatch (ISSUE 12): the token
-        ranges from _build_ragged_batch plus every decode lane's single
-        token, through the resident ragged executable. Returns an
-        _InflightBlock whose packed [1, B] decode emissions ride the
-        lookahead pipeline's _process_step unchanged (None on a
-        contained prefill failure — the caller falls through to the
-        plain paths)."""
+    def _ragged_prefill_operands(self, ranges: list, W: int):
+        """Build the 14 `pre_*` numpy operands of a ragged dispatch
+        (stream width W) from the batch builder's token ranges — shared
+        by the plain ragged dispatch and the spec×ragged one (ISSUE 19)
+        so the operand layout cannot drift between them. Returns
+        (operands, useful, smp_temp): the positional operand tuple, the
+        real-token count (padding-waste accounting), and the sampled-
+        this-dispatch temperature vector (feeds the batch-greedy key)."""
         cfg = self.config
-        W = self._ragged_width
         B = cfg.max_decode_slots
         P = cfg.pages_per_seq
         pre_tokens = np.zeros((W,), np.int32)
@@ -2082,6 +2233,29 @@ class InferenceEngine:
                 smp_top_k[i] = self._eff_top_k(s.request)
             off += take
             useful += take
+        operands = (
+            pre_tokens, pre_pos, pre_tidx, pre_tables,
+            rng_start, rng_len, rng_kv, rng_tidx,
+            smp_idx, smp_pos, smp_seeds, smp_temp, smp_top_p, smp_top_k,
+        )
+        return operands, useful, smp_temp
+
+    def _dispatch_ragged(self, ranges: list):
+        """ONE flat mixed prefill+decode dispatch (ISSUE 12): the token
+        ranges from _build_ragged_batch plus every decode lane's single
+        token, through the resident ragged executable. Returns an
+        _InflightBlock whose packed [1, B] decode emissions ride the
+        lookahead pipeline's _process_step unchanged (None on a
+        contained prefill failure — the caller falls through to the
+        plain paths)."""
+        cfg = self.config
+        W = self._ragged_width
+        B = cfg.max_decode_slots
+        (pre_tokens, pre_pos, pre_tidx, pre_tables, rng_start, rng_len,
+         rng_kv, rng_tidx, smp_idx, smp_pos, smp_seeds, smp_temp,
+         smp_top_p, smp_top_k), useful, _ = (
+            self._ragged_prefill_operands(ranges, W)
+        )
 
         dev = self._dev
         act = self._active
@@ -2168,6 +2342,144 @@ class InferenceEngine:
             self._dispatch_seq, gap_ms, live,
         )
 
+    def _dispatch_ragged_spec(self, ranges: list):
+        """ONE flat mixed dispatch serving prefill chunks AND spec verify
+        lanes (ISSUE 19 tentpole b): each live decode lane contributes a
+        gamma+1 verify window to the flat stream as an ordinary per-
+        sequence range, alongside the prompt-chunk ranges — the spec
+        formulation of _dispatch_ragged. Returns an
+        _InflightBlock("spec", …) whose packed matrix rides the same
+        once-per-block D2H as a bucketed spec round (None on a contained
+        prefill failure)."""
+        cfg = self.config
+        gamma = self._gamma
+        W = self._ragged_spec_width[gamma]
+        B = cfg.max_decode_slots
+        (pre_tokens, pre_pos, pre_tidx, pre_tables, rng_start, rng_len,
+         rng_kv, rng_tidx, smp_idx, smp_pos, smp_seeds, smp_temp,
+         smp_top_p, smp_top_k), useful, _ = (
+            self._ragged_prefill_operands(ranges, W)
+        )
+
+        dev = self._dev
+        act = self._active
+        lanes = int(act.sum())
+        # Static greedy variant, batch-keyed like the plain ragged path:
+        # all live decode lanes AND all sampled-this-dispatch prefill
+        # rows greedy. The candidates variant follows the caller's spec
+        # gate: all-untruncated batches skip truncation work entirely
+        # (greedy=True implies all-untruncated, so (True, C>0) never
+        # compiles — mirrored in warmup's reachable-variant list).
+        greedy = bool(np.all(self._temperature[act] == 0.0)) and bool(
+            np.all(smp_temp == 0.0)
+        )
+        all_untruncated = bool(np.all(
+            ((self._top_p[act] >= 1.0) & (self._top_k[act] <= 0))
+            | (self._temperature[act] == 0.0)
+        ))
+        spec_candidates = (
+            0 if all_untruncated else self.config.top_p_candidates
+        )
+        # Spec rounds land >= 1 token per round, so `remaining` rounds
+        # always suffice (same tail-work cap as the bucketed spec path).
+        self._depth_target = min(
+            self._depth, max(1, self._remaining_budget(act))
+        )
+        self._last_dispatch_steps = 1
+        # A spec round's scan length is gamma draft steps + one verify —
+        # the step weight that makes its lane-seconds comparable.
+        gap_ms = self.metrics.on_dispatch(lanes, gamma + 1, slots=B)
+        # Padding-waste accounting covers the PREFILL region only: the
+        # B·(gamma+1) verify rows are charged by on_dispatch's
+        # steps-weighted lane accounting, same as a bucketed spec round.
+        self.metrics.on_padding_tokens(W, useful)
+        self.metrics.on_prefill_interleave(useful, lanes > 0)
+        live = tuple(int(i) for i in np.flatnonzero(act))
+        put = partial(jax.device_put, device=self._repl)
+        try:
+            if self._faults is not None:
+                self._faults.maybe_raise(
+                    "prefill-error", replica=self.replica_id,
+                    tier=self._tier,
+                )
+            with jax.profiler.TraceAnnotation("polykey/ragged_spec"):
+                (packed_dev, last_dev, seq_dev, act_dev, ewma_dev,
+                 dial_dev, first_dev, self.paged,
+                 self.d_paged) = self._jit_ragged_spec(
+                    self.params, self.draft_params,
+                    self.model_cfg, self.draft_cfg,
+                    self.paged, self.d_paged,
+                    dev["last_tokens"], dev["seq_lens"],
+                    dev["page_tables"], dev["active"], dev["caps"],
+                    dev["seeds"], dev["temperature"], dev["top_p"],
+                    dev["top_k"],
+                    dev["accept_ewma"], dev["gamma_lane"],
+                    put(pre_tokens), put(pre_pos), put(pre_tidx),
+                    put(pre_tables),
+                    put(rng_start), put(rng_len), put(rng_kv),
+                    put(rng_tidx),
+                    put(smp_idx), put(smp_pos), put(smp_seeds),
+                    put(smp_temp), put(smp_top_p), put(smp_top_k),
+                    gamma=gamma, eos_id=self.tokenizer.eos_id,
+                    gamma_low=self._gamma_low, gamma_max=self._gamma_max,
+                    greedy=greedy, candidates=spec_candidates,
+                    mesh=self.mesh,
+                )
+                dev["last_tokens"] = last_dev
+                dev["seq_lens"] = seq_dev
+                dev["active"] = act_dev
+                dev["accept_ewma"] = ewma_dev
+                dev["gamma_lane"] = dial_dev
+        except Exception as e:
+            # Same containment contract as _dispatch_ragged: finish the
+            # ranged slots, mark mirrors dirty, let the caller fall
+            # through. Decode lanes keep their state.
+            for i, s, _take in ranges:
+                if self._slots[i] is s:
+                    self._finish(i, error=f"prefill failed: {e}")
+            self._dev_dirty = True
+            return None
+        try:
+            packed_dev.copy_to_host_async()
+        except Exception:
+            # Best-effort copy hint only (same as the block dispatch).
+            pass
+        if self.config.spec_host_sync:
+            # A/B instrumentation (scripts/occupancy_soak.py --ab-spec):
+            # emulate the pre-ISSUE-19 host-loop spec round — three
+            # synchronous readbacks per round on the device-resident
+            # math, so the A/B isolates the crossing schedule, not the
+            # arithmetic. Each timed read lands in the host-stall
+            # accounting (metrics.on_spec_host_sync). Never enabled in
+            # production.
+            for _ in range(3):
+                t_sync = time.monotonic()
+                with _host_crossing("spec-host-sync"):
+                    # polylint: disable=PL001(spec_host_sync A/B emulation of the pre-ISSUE-19 host-loop round; off in production), PL008(the blocking dispatch-side read IS the measured subject here)
+                    np.asarray(packed_dev)
+                self.metrics.on_spec_host_sync(
+                    (time.monotonic() - t_sync) * 1e3
+                )
+        self._dispatch_seq += 1
+        if self.timeline is not None:
+            self.timeline.dispatch(
+                self._dispatch_seq, "spec", lanes, gamma + 1, gap_ms
+            )
+        for i, s, take in ranges:
+            final = s.filled + take >= len(s.pending)
+            if self.timeline is not None:
+                self.timeline.prefill(i, take, final)
+            if final:
+                # Same merge-activation as the plain ragged path; the
+                # spec merge additionally resets the lane's gamma dial.
+                self._merge_slot(i, s, first_dev, i)
+            else:
+                s.filled += take
+        return _InflightBlock(
+            "spec", packed_dev, self._snapshot_requests(),
+            self._dispatch_seq, gap_ms, live,
+        )
+
     def _compile_warmup(self) -> None:
         """Pre-compile the greedy prefill group shapes and the greedy
         decode block (or spec round) against the reserved garbage page.
@@ -2203,19 +2515,71 @@ class InferenceEngine:
                 ragged_zero_operands(B, W, cfg.pages_per_seq)
             )
             first_dev = None
-            for greedy in greedy_variants:
-                (_, dev["last_tokens"], dev["seq_lens"], dev["active"],
-                 first_dev, self.paged) = self._jit_ragged(
-                    self.params, self.model_cfg, self.paged,
-                    dev["last_tokens"], dev["seq_lens"],
-                    dev["page_tables"], dev["active"], dev["caps"],
-                    dev["seeds"], dev["temperature"], dev["top_p"],
-                    dev["top_k"], *pre,
-                    greedy=greedy, eos_id=self.tokenizer.eos_id,
-                    candidates=self.config.top_p_candidates,
-                    mesh=self.mesh,
-                )
-            self._jit_merge(
+            if self._spec:
+                # Unified spec×ragged path (ISSUE 19): one executable per
+                # (gamma rung, greedy/candidates variant). Reachable
+                # variants only — greedy=True implies an all-greedy batch,
+                # which is all-untruncated, which dispatches candidates=0.
+                spec_variants = [(True, 0)]
+                if warm_sampled:
+                    spec_variants.append((False, 0))
+                    if cfg.top_p_candidates > 0:
+                        spec_variants.append((False, cfg.top_p_candidates))
+                for greedy, cand in spec_variants:
+                    for gamma in sorted({self._gamma_low, self._gamma_max}):
+                        pre_g = tuple(
+                            put(a) for a in ragged_zero_operands(
+                                B, self._ragged_spec_width[gamma],
+                                cfg.pages_per_seq,
+                            )
+                        )
+                        (_, dev["last_tokens"], dev["seq_lens"],
+                         dev["active"], dev["accept_ewma"],
+                         dev["gamma_lane"], first_dev, self.paged,
+                         self.d_paged) = self._jit_ragged_spec(
+                            self.params, self.draft_params,
+                            self.model_cfg, self.draft_cfg,
+                            self.paged, self.d_paged,
+                            dev["last_tokens"], dev["seq_lens"],
+                            dev["page_tables"], dev["active"], dev["caps"],
+                            dev["seeds"], dev["temperature"], dev["top_p"],
+                            dev["top_k"], dev["accept_ewma"],
+                            dev["gamma_lane"], *pre_g,
+                            gamma=gamma, eos_id=self.tokenizer.eos_id,
+                            gamma_low=self._gamma_low,
+                            gamma_max=self._gamma_max,
+                            greedy=greedy, candidates=cand, mesh=self.mesh,
+                        )
+                if warm_sampled and cfg.top_p_candidates == 0:
+                    # Gate-fail fallback with prefill ranges in hand: a
+                    # truncated sampled row (only possible variant:
+                    # greedy=False, candidates=0) rides the PLAIN ragged
+                    # dispatch. With the prefilter on, the gate never
+                    # fails and _jit_ragged is unreachable entirely.
+                    (_, dev["last_tokens"], dev["seq_lens"], dev["active"],
+                     first_dev, self.paged) = self._jit_ragged(
+                        self.params, self.model_cfg, self.paged,
+                        dev["last_tokens"], dev["seq_lens"],
+                        dev["page_tables"], dev["active"], dev["caps"],
+                        dev["seeds"], dev["temperature"], dev["top_p"],
+                        dev["top_k"], *pre,
+                        greedy=False, eos_id=self.tokenizer.eos_id,
+                        candidates=0, mesh=self.mesh,
+                    )
+            else:
+                for greedy in greedy_variants:
+                    (_, dev["last_tokens"], dev["seq_lens"], dev["active"],
+                     first_dev, self.paged) = self._jit_ragged(
+                        self.params, self.model_cfg, self.paged,
+                        dev["last_tokens"], dev["seq_lens"],
+                        dev["page_tables"], dev["active"], dev["caps"],
+                        dev["seeds"], dev["temperature"], dev["top_p"],
+                        dev["top_k"], *pre,
+                        greedy=greedy, eos_id=self.tokenizer.eos_id,
+                        candidates=self.config.top_p_candidates,
+                        mesh=self.mesh,
+                    )
+            merge_args = (
                 dev["last_tokens"], dev["seq_lens"],
                 dev["page_tables"], dev["active"], dev["caps"],
                 dev["temperature"], dev["top_p"], dev["top_k"],
@@ -2224,8 +2588,15 @@ class InferenceEngine:
                 np.int32(1), np.int32(2), np.float32(0.0),
                 np.float32(1.0), np.int32(0), zrow,
                 np.zeros((2,), np.int32),
-                eos_id=self.tokenizer.eos_id,
             )
+            if self._spec:
+                self._jit_merge(
+                    *merge_args, dev["accept_ewma"], dev["gamma_lane"],
+                    np.int32(self._gamma_max),
+                    eos_id=self.tokenizer.eos_id, spec=True,
+                )
+            else:
+                self._jit_merge(*merge_args, eos_id=self.tokenizer.eos_id)
         bucket_list = () if self._ragged else cfg.prefill_buckets
         for bucket in bucket_list:
             for n in pads:
@@ -2270,7 +2641,7 @@ class InferenceEngine:
                     # output — a numpy stand-in would compile a different
                     # cache entry (committedness is part of the key) and
                     # the real first admission would still pay the compile.
-                    self._jit_merge(
+                    merge_args = (
                         dev["last_tokens"], dev["seq_lens"],
                         dev["page_tables"], dev["active"], dev["caps"],
                         dev["temperature"], dev["top_p"], dev["top_k"],
@@ -2279,8 +2650,17 @@ class InferenceEngine:
                         np.int32(1), np.int32(2), np.float32(0.0),
                         np.float32(1.0), np.int32(0), zrow,
                         np.zeros((2,), np.int32),
-                        eos_id=self.tokenizer.eos_id,
                     )
+                    if self._spec:
+                        self._jit_merge(
+                            *merge_args, dev["accept_ewma"],
+                            dev["gamma_lane"], np.int32(self._gamma_max),
+                            eos_id=self.tokenizer.eos_id, spec=True,
+                        )
+                    else:
+                        self._jit_merge(
+                            *merge_args, eos_id=self.tokenizer.eos_id,
+                        )
         if self._spec:
             # The spec round is the steady-state step; its compile is the
             # heavy one (draft scan + verify + draft-sync forwards).
@@ -2302,15 +2682,19 @@ class InferenceEngine:
                         dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
                         dev["active"], dev["caps"], dev["seeds"],
                         dev["temperature"], dev["top_p"], dev["top_k"],
+                        dev["accept_ewma"], dev["gamma_lane"],
                         gamma=gamma,
                         eos_id=self.tokenizer.eos_id,
+                        gamma_low=self._gamma_low,
+                        gamma_max=self._gamma_max,
                         candidates=cand, mesh=self.mesh,
                     )
                     # Donated slot state: rebind the warmed dev entries
                     # from the outputs or the next warmup call would feed
                     # deleted buffers.
                     (_, dev["last_tokens"], dev["seq_lens"], dev["active"],
-                     _, self.paged, self.d_paged) = outs
+                     dev["accept_ewma"], dev["gamma_lane"],
+                     self.paged, self.d_paged) = outs
             if warm_sampled and self.config.top_p_candidates == 0:
                 # Without the top-k prefilter, a batch containing any
                 # sampled top_p<1 row leaves the spec path entirely and
@@ -2456,12 +2840,8 @@ class InferenceEngine:
         try:
             # _host_crossing: the merge's geometry rides as tiny numpy
             # scalars (an implicit upload that piggybacks the dispatch).
-            with _host_crossing():
-                (
-                    dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
-                    dev["active"], dev["caps"], dev["temperature"], dev["top_p"],
-                    dev["top_k"], dev["seeds"],
-                ) = self._jit_merge(
+            with _host_crossing("merge-upload"):
+                args = (
                     dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
                     dev["active"], dev["caps"], dev["temperature"], dev["top_p"],
                     dev["top_k"], dev["seeds"],
@@ -2470,8 +2850,25 @@ class InferenceEngine:
                     np.float32(request.temperature), np.float32(request.top_p),
                     np.int32(self._eff_top_k(request)),
                     slot.table[0], slot.seed_row,
-                    eos_id=self.tokenizer.eos_id,
                 )
+                if self._spec:
+                    # The per-lane gamma dial resets with its occupant
+                    # (fresh EWMA, dial at gamma_max) — see _merge_lane_fn.
+                    outs = self._jit_merge(
+                        *args, dev["accept_ewma"], dev["gamma_lane"],
+                        np.int32(self._gamma_max),
+                        eos_id=self.tokenizer.eos_id, spec=True,
+                    )
+                    dev["accept_ewma"], dev["gamma_lane"] = outs[9:]
+                else:
+                    outs = self._jit_merge(
+                        *args, eos_id=self.tokenizer.eos_id,
+                    )
+                (
+                    dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
+                    dev["active"], dev["caps"], dev["temperature"], dev["top_p"],
+                    dev["top_k"], dev["seeds"],
+                ) = outs[:9]
         except Exception as e:
             self._finish(slot_idx, error=f"activation failed: {e}")
             return
@@ -2494,6 +2891,8 @@ class InferenceEngine:
         self._top_p[slot_idx] = request.top_p
         self._top_k[slot_idx] = self._eff_top_k(request)
         self._seeds[slot_idx] = slot.seed_row
+        self._lane_ewma[slot_idx] = 1.0
+        self._lane_gamma[slot_idx] = max(self._gamma_max, 1)
 
     def _resolve_prefills(self, block: bool = False) -> None:
         """Deliver first tokens whose async D2H copies have landed (all of
@@ -2509,7 +2908,7 @@ class InferenceEngine:
         try:
             # Deliberate resolve point: the copy was started async at merge
             # time (copy_to_host_async), so this sync is local by now.
-            with _host_crossing():
+            with _host_crossing("first-token-resolve"):
                 # polylint: disable=PL001(first-token resolve point; async copy landed), PL008(reached from dispatch only on the dev-dirty cold path, behind a full pipeline drain)
                 token = int(np.asarray(slot.token_dev).reshape(-1)[slot.token_row])
         except Exception as e:
@@ -2571,7 +2970,7 @@ class InferenceEngine:
         try:
             # polylint: disable=PL008(tiny page-index upload, not a readback; prefill_only cold path)
             idx = jnp.asarray(np.asarray(slot.pages[:n_kv], np.int32))
-            with _host_crossing():
+            with _host_crossing("handoff-export"):
                 # polylint: disable=PL008(handoff export: deliberate one-shot gather; prefill_only cold path never taken by in-process serving)
                 k = np.asarray(jnp.take(self.paged.k, idx, axis=1))
                 # polylint: disable=PL008(handoff export gather; prefill_only cold path)
@@ -2665,7 +3064,7 @@ class InferenceEngine:
                 operands += [put(_pad(state.ks)), put(_pad(state.vs))]
             # _host_crossing: the padded page payload rides up as one
             # deliberate upload (the handoff's whole point).
-            with _host_crossing():
+            with _host_crossing("handoff-restore"):
                 self.paged = self._jit_kv_restore(self.paged, *operands)
         except Exception as e:
             self.allocator.release_all(pages)
@@ -2834,7 +3233,7 @@ class InferenceEngine:
                 operands += [put(ks), put(vs)]
             # _host_crossing: the page payload rides up as one
             # deliberate upload — the page fault's whole point.
-            with _host_crossing():
+            with _host_crossing("kv-fault-restore"):
                 self.paged = self._jit_kv_restore(self.paged, *operands)
         except Exception as e:
             # Host copies are untouched on failure; _finish re-adopts
@@ -2886,7 +3285,7 @@ class InferenceEngine:
         idx = np.zeros((P,), np.int32)
         idx[:len(cands)] = [page for _, page in cands]
         outs = self._jit_kv_gather(self.paged, jax.device_put(idx, self._repl))
-        with _host_crossing():
+        with _host_crossing("kv-evict-gather"):
             # polylint: disable=PL008(eviction gather resolve: one packed D2H read per spill batch; cold path, reached from dispatch only via _finish under the resident-floor check)
             k = np.asarray(outs[0])
             # polylint: disable=PL008(spill gather read, same cold path)
@@ -3022,6 +3421,16 @@ class InferenceEngine:
             "top_k": jax.device_put(self._top_k, self._dp_vec),
             "seeds": jax.device_put(self._seeds, self._dp_mat),
         }
+        if self._spec:
+            # Per-lane gamma dial (ISSUE 19): device-resident like the
+            # rest of the slot state; the mirrors were refreshed from the
+            # last processed round's packed stat columns.
+            self._dev["accept_ewma"] = jax.device_put(
+                self._lane_ewma, self._dp_vec
+            )
+            self._dev["gamma_lane"] = jax.device_put(
+                self._lane_gamma, self._dp_vec
+            )
         self._dev_dirty = False
 
     def _dispatch_step(self):
@@ -3043,21 +3452,6 @@ class InferenceEngine:
             # already drained in-flight blocks).
             self._resolve_prefills(block=True)
             self._upload_slot_state()
-        if self._ragged:
-            # Ragged mode (ISSUE 12): any pending prefill work rides ONE
-            # mixed dispatch with the decode lanes' single tokens; pure-
-            # decode iterations fall through to the K-step block below
-            # (the PR 6 amortization is untouched at steady state).
-            ranges = self._build_ragged_batch()
-            if ranges:
-                block = self._dispatch_ragged(ranges)
-                if block is not None:
-                    return block
-            if not self._active.any():
-                # Prefill-only iteration that dispatched nothing (e.g.
-                # contained failure): no decode block to fall through to.
-                return None
-        dev = self._dev
         # top_p composes with speculation via truncated rejection sampling
         # (sampling.truncated_dist), which needs the top-k prefilter
         # (top_p_candidates > 0) to avoid full-vocab sorts. Without the
@@ -3073,9 +3467,39 @@ class InferenceEngine:
             ((self._top_p[act] >= 1.0) & (self._top_k[act] <= 0))
             | (self._temperature[act] == 0.0)
         ))
-        if self._spec and (
+        spec_on = self._spec and (
             self.config.top_p_candidates > 0 or all_untruncated
-        ):
+        )
+        if self._ragged:
+            # Ragged mode (ISSUE 12): any pending prefill work rides ONE
+            # mixed dispatch with the decode lanes' single tokens; pure-
+            # decode iterations fall through to the K-step block (or spec
+            # round) below (the PR 6 amortization is untouched at steady
+            # state). Spec engines (ISSUE 19): the same mixed dispatch
+            # carries the verify windows — prefill chunks, plain decode
+            # lanes, and gamma-token spec lanes in ONE ragged call; the
+            # gate-fail fallback (no prefilter + truncated sampled row)
+            # keeps the plain ragged dispatch, trading acceptance, never
+            # correctness.
+            ranges = self._build_ragged_batch()
+            if ranges:
+                block = (
+                    self._dispatch_ragged_spec(ranges)
+                    if spec_on else self._dispatch_ragged(ranges)
+                )
+                if block is not None:
+                    return block
+            if not self._active.any():
+                # Prefill-only iteration that dispatched nothing (e.g.
+                # contained failure): no decode block to fall through to.
+                return None
+            # A contained failure may have retired lanes; refresh the
+            # active view for the lane counts below (the spec gate only
+            # ever loses truncated rows to a retirement, so `spec_on`
+            # stays valid).
+            act = self._active
+        dev = self._dev
+        if spec_on:
             spec_candidates = (
                 0 if all_untruncated else self.config.top_p_candidates
             )
@@ -3251,8 +3675,6 @@ class InferenceEngine:
         the pre-pipeline behavior — correctness over overlap)."""
         data = block[1]
         try:
-            if block[0] == "spec":
-                return all(a.is_ready() for a in data)
             return data.is_ready()
         except Exception:
             # Justified: is_ready() is an optional backend capability —
@@ -3302,7 +3724,7 @@ class InferenceEngine:
                                       queued_after, 0.0)
             return
         t_sync = time.monotonic()
-        with _host_crossing():
+        with _host_crossing("block-packed"):
             # polylint: disable=PL001(block resolve point; one packed D2H read per block), PL008(process-side read; reachable from dispatch only via the ragged merge's dev-dirty cold path, behind a full pipeline drain)
             packed = np.asarray(data)     # [K, B]; blocks until block done
         # Host stall: how long the processed frontier blocked waiting for
@@ -3402,47 +3824,84 @@ class InferenceEngine:
     def _dispatch_spec(self, dev: dict, candidates: int = 0):
         """Dispatch one draft/verify round (spec_decode.py). `candidates`
         is 0 when every active row has top_p >= 1 — the round then skips
-        all truncation work (plain softmax dists)."""
+        all truncation work (plain softmax dists). The round is fully
+        device-resident (ISSUE 19): acceptance stats and the per-lane
+        gamma dial ride the packed matrix's stat columns, so the block
+        boundary costs ONE D2H read, same as a plain block."""
         with jax.profiler.TraceAnnotation("polykey/spec_decode"):
-            (packed_dev, new_last, new_seq, new_active, stats_dev,
-             self.paged, self.d_paged) = self._jit_spec_decode(
+            (packed_dev, new_last, new_seq, new_active, new_ewma,
+             new_gamma, self.paged, self.d_paged) = self._jit_spec_decode(
                 self.params, self.draft_params,
                 self.model_cfg, self.draft_cfg,
                 self.paged, self.d_paged,
                 dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
                 dev["active"], dev["caps"], dev["seeds"],
                 dev["temperature"], dev["top_p"], dev["top_k"],
+                dev["accept_ewma"], dev["gamma_lane"],
                 gamma=self._gamma,
                 eos_id=self.tokenizer.eos_id,
+                gamma_low=self._gamma_low, gamma_max=self._gamma_max,
                 candidates=candidates, mesh=self.mesh,
             )
             dev["last_tokens"] = new_last
             dev["seq_lens"] = new_seq
             dev["active"] = new_active
+            dev["accept_ewma"] = new_ewma
+            dev["gamma_lane"] = new_gamma
         try:
             packed_dev.copy_to_host_async()
-            stats_dev.copy_to_host_async()
         except Exception:
             # Best-effort copy hint only: _process_spec's np.asarray syncs
             # regardless; backends without async copies lose overlap only.
             pass
-        return packed_dev, stats_dev
+        if self.config.spec_host_sync:
+            # A/B instrumentation (scripts/occupancy_soak.py --ab-spec):
+            # emulate the pre-ISSUE-19 host-loop spec round — three
+            # synchronous readbacks per round on the device-resident
+            # math, so the A/B isolates the crossing schedule, not the
+            # arithmetic. Each timed read lands in the host-stall
+            # accounting (metrics.on_spec_host_sync). Never enabled in
+            # production.
+            for _ in range(3):
+                t_sync = time.monotonic()
+                with _host_crossing("spec-host-sync"):
+                    # polylint: disable=PL001(spec_host_sync A/B emulation of the pre-ISSUE-19 host-loop round; off in production), PL008(the blocking dispatch-side read IS the measured subject here)
+                    np.asarray(packed_dev)
+                self.metrics.on_spec_host_sync(
+                    (time.monotonic() - t_sync) * 1e3
+                )
+        return packed_dev
 
     def _process_spec(self, data, reqs, lookahead: int = 0, seq: int = 0,
                       gap_ms: float = 0.0, live: tuple = (),
                       queued_after: int = 0) -> None:
         """Sync a spec round; emits each row's packed prefix (-1 padded —
-        device-truncated). Acceptance stats come FROM the device
-        (spec_decode_fn), which owns truncation and the untruncated n_acc
-        the dial needs."""
-        packed_dev, stats_dev = data
+        device-truncated). Acceptance stats AND the per-lane gamma dial
+        come FROM the device inside the same packed matrix (ISSUE 19:
+        spec_decode._accept_merge owns truncation, the untruncated n_acc,
+        and the dial update) — ONE D2H read per round, exactly like a
+        plain block's packed readback."""
+        packed_dev = data
         t_sync = time.monotonic()
-        with _host_crossing():
-            # polylint: disable=PL001(spec-round resolve point; packed D2H read), PL008(process-side read; dispatch reaches it only via the merge drain cold path)
-            packed = np.asarray(packed_dev)  # [B, gamma+1]; blocks until done
-            # polylint: disable=PL001(device-owned acceptance stats feed the gamma dial), PL008(process-side read; dispatch reaches it only via the merge drain cold path)
-            accepted, proposed = (int(v) for v in np.asarray(stats_dev))
+        with _host_crossing("spec-packed"):
+            # polylint: disable=PL001(spec-round resolve point; the ONE packed D2H read carries tokens, counts, and the gamma dial), PL008(process-side read; dispatch reaches it only via the merge drain cold path)
+            packed = np.asarray(packed_dev)  # [B, gamma+1+SPEC_STAT_COLS]
         stall_ms = (time.monotonic() - t_sync) * 1e3
+        # Stat columns (spec_decode.SPEC_STAT_COLS): per-lane accepted /
+        # proposed counts, the acceptance EWMA in 1e-6 fixed point, and
+        # the lane's next gamma dial.
+        g1 = packed.shape[1] - 4
+        acc_col, prop_col = packed[:, g1], packed[:, g1 + 1]
+        ewma_col, dial_col = packed[:, g1 + 2], packed[:, g1 + 3]
+        accepted, proposed = int(acc_col.sum()), int(prop_col.sum())
+        for i, slot in enumerate(self._slots):
+            # Mirror refresh gated on request identity: a stale lookahead
+            # round must not overwrite a re-admitted lane's fresh dial
+            # (the DEVICE copy is already correct — the merge reset
+            # chained after this round's outputs).
+            if slot is not None and slot.request is reqs[i]:
+                self._lane_ewma[i] = ewma_col[i] / 1e6
+                self._lane_gamma[i] = dial_col[i]
         self.metrics.on_process_block(
             lookahead, stall_ms, trace_id=self._block_trace_id(reqs, live)
         )
@@ -3465,7 +3924,7 @@ class InferenceEngine:
                     continue
             before = slot.generated
             block_span = None
-            for j in range(packed.shape[1]):
+            for j in range(g1):
                 token = int(packed[i, j])
                 if token < 0:
                     break
@@ -3486,16 +3945,36 @@ class InferenceEngine:
             self.timeline.process(seq, t_sync, time.monotonic(), stall_ms,
                                   lookahead, queued_after, busy_ms)
         self.metrics.on_spec(accepted, proposed)
-        if proposed > 0 and self._gamma_low != self._gamma_max:
-            # The gamma dial: EWMA of the per-draft acceptance rate with a
-            # hysteresis band (0.35 / 0.55) so gamma doesn't thrash at the
-            # boundary. Both ladder levels are warmup-compiled.
+        if proposed > 0:
+            # Batch-aggregate EWMA, observability only (the per-lane dial
+            # updated on DEVICE; see spec_decode._accept_merge). Same
+            # blend as the per-lane one so operators can sanity-check the
+            # lane spread against a familiar aggregate.
+            from .spec_decode import GAMMA_EWMA_BETA
+
             rate = accepted / proposed
-            self._accept_ewma = 0.8 * self._accept_ewma + 0.2 * rate
-            if self._gamma == self._gamma_max and self._accept_ewma < 0.35:
-                self._gamma = self._gamma_low
-            elif self._gamma == self._gamma_low and self._accept_ewma > 0.55:
-                self._gamma = self._gamma_max
+            self._accept_ewma = (
+                GAMMA_EWMA_BETA * self._accept_ewma
+                + (1.0 - GAMMA_EWMA_BETA) * rate
+            )
+        # Dispatch width: the ladder rung covering the widest ACTIVE lane
+        # dial (a lane at gamma_low costs nothing extra when batchmates
+        # need gamma_max — its surplus drafts are force-masked on
+        # device), clamped by the autopilot's cap. Both rungs are
+        # warmup-compiled; no new executables.
+        if self._spec:
+            act = [
+                i for i, s in enumerate(self._slots)
+                if s is not None and self._active[i]
+            ]
+            want = (
+                int(self._lane_gamma[act].max()) if act else self._gamma_max
+            )
+            rung = (
+                self._gamma_max if want > self._gamma_low
+                else self._gamma_low
+            )
+            self._gamma = min(rung, self._gamma_cap)
 
     def _maybe_finish(self, slot_idx: int, token: int) -> None:
         slot = self._slots[slot_idx]
@@ -3569,7 +4048,7 @@ class InferenceEngine:
             dev = self._dev
             try:
                 # _host_crossing: the slot index rides as a numpy scalar.
-                with _host_crossing():
+                with _host_crossing("retire-upload"):
                     (
                         dev["last_tokens"], dev["seq_lens"], dev["page_tables"],
                         dev["active"], dev["caps"],

@@ -524,6 +524,30 @@ def engine_collector(engine_or_provider):
                 for labels, _engine, snap in members:
                     if snap.get("drafts_proposed"):
                         lines.append(render_sample(name, labels, snap[key]))
+        if any(snap.get("spec_gamma") is not None for _, _, snap in members):
+            # Per-lane dial aggregates (ISSUE 19): gamma went per-lane,
+            # so the families carry a `stat` label (mean/min/max over
+            # occupied lanes) instead of pretending one global exists.
+            # Present whenever spec is configured — operators watch the
+            # dial BEFORE traffic proposes anything.
+            for name, help_text, prefix in (
+                ("polykey_spec_gamma",
+                 "Per-lane speculative gamma dial, aggregated over "
+                 "occupied lanes (stat: mean/min/max).", "spec_gamma"),
+                ("polykey_spec_accept_rate",
+                 "Per-lane draft acceptance EWMA, aggregated over "
+                 "occupied lanes (stat: mean/min/max).",
+                 "spec_accept_ewma"),
+            ):
+                lines += render_header(name, help_text, "gauge")
+                for labels, _engine, snap in members:
+                    if snap.get("spec_gamma") is None:
+                        continue
+                    for stat in ("mean", "min", "max"):
+                        lines.append(render_sample(
+                            name, {**labels, "stat": stat},
+                            snap[f"{prefix}_{stat}"],
+                        ))
         if pool is not None:
             lines += _pool_lines(pool, members)
         lines += _slo_lines(members)

@@ -85,6 +85,7 @@ HIST_SIGNALS: dict[str, str] = {
 SCALAR_SIGNALS = frozenset({
     "device_busy_fraction", "avg_lanes", "tokens_per_sec",
     "availability", "host_stall_ms_mean", "lookahead_observed_mean",
+    "spec_accept_rate",
 })
 
 ENV_POLICY = "POLYKEY_SLO"
@@ -350,6 +351,17 @@ def summarize_deltas(deltas: dict, bounds: dict) -> dict:
             if covered > 0 else None
         ),
         "kv_pages_restored": c.get("kv_pages_restored", 0),
+        # Windowed draft acceptance (ISSUE 19): the autopilot's
+        # decide_gamma evidence. None when the window proposed nothing
+        # (spec off, or an idle/gate-failed stretch) — a null verdict,
+        # never a zero.
+        "spec_accept_rate": (
+            round(
+                c.get("drafts_accepted", 0) / c.get("drafts_proposed", 0),
+                4,
+            )
+            if c.get("drafts_proposed", 0) > 0 else None
+        ),
     }
     for name, (counts, _sum) in deltas["hists"].items():
         n = sum(counts)

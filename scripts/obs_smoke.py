@@ -518,6 +518,69 @@ def pool_smoke() -> list:
     return failures
 
 
+def spec_family_checks() -> list:
+    """Speculative-decode exposition (ISSUE 19 satellite): boot a spec
+    engine (seed+2 draft — quality is irrelevant, the families are the
+    subject), serve one greedy generation, and assert the per-lane dial
+    gauges (stat-labeled mean/min/max — the engine-global gamma died
+    with the per-lane redesign) plus the draft counters render. Guards
+    the `snap["spec_gamma"]` shape the exposition indexes: stats() once
+    exported a bare int here and the collector silently skipped the
+    family."""
+    import dataclasses
+
+    print("booting spec engine on CPU ...", flush=True)
+    logger = Logger(stream=open(os.devnull, "w"))
+    obs = Observability()
+    config = dataclasses.replace(
+        CONFIG, draft_model="tiny-llama", spec_gamma=2
+    )
+    engine = InferenceEngine(config, logger=logger)
+    service = TpuService.create(engine, logger=logger, obs=obs)
+    server, _, port = gateway_server.build_server(
+        service, logger, address="127.0.0.1:0", obs=obs
+    )
+    server.start()
+    metrics = MetricsHTTPServer(obs.registry, host="127.0.0.1", port=0)
+    metrics.start()
+
+    failures: list[str] = []
+    try:
+        channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+        stub = PolykeyServiceStub(channel)
+        request = pk.ExecuteToolRequest(tool_name="llm_generate")
+        request.parameters.update({"prompt": "spec smoke", "max_tokens": 24})
+        chunks = list(stub.ExecuteToolStream(request, timeout=120))
+        assert chunks[-1].final
+        channel.close()
+
+        page = scrape(metrics.port)
+        for family in (
+            'polykey_spec_gamma{stat="mean"}',
+            'polykey_spec_gamma{stat="min"}',
+            'polykey_spec_gamma{stat="max"}',
+            'polykey_spec_accept_rate{stat="mean"}',
+            'polykey_spec_accept_rate{stat="min"}',
+            'polykey_spec_accept_rate{stat="max"}',
+            "polykey_spec_drafts_proposed_total",
+            "polykey_spec_drafts_accepted_total",
+        ):
+            if family not in page:
+                failures.append(f"spec page missing: {family}")
+        snap = engine.stats()
+        for key in ("spec_gamma_mean", "spec_gamma_min", "spec_gamma_max",
+                    "spec_accept_ewma_mean"):
+            if key not in snap:
+                failures.append(f"engine stats missing {key}")
+        if not snap.get("drafts_proposed"):
+            failures.append("spec engine proposed no drafts")
+    finally:
+        metrics.stop()
+        server.stop(grace=None)
+        service.close()
+    return failures
+
+
 def disagg_smoke() -> list:
     """Disaggregated-tier exposition (ISSUE 13 + 16): one prefill + two
     decode workers (in-process servers over real localhost sockets)
@@ -803,6 +866,7 @@ def main() -> int:
         service.close()
         os.environ.pop("POLYKEY_DEBUG_ENDPOINTS", None)
 
+    failures += spec_family_checks()
     failures += pool_smoke()
     failures += disagg_smoke()
     failures += kv_exemplar_checks()

@@ -41,12 +41,16 @@ def log(msg: str) -> None:
 
 
 def make_corpus_sampler(seed: int = 0):
-    """Order-2 Markov chain over 26 lowercase letters with peaked rows:
-    enough structure that a 1-layer model learns most of it and a 2-layer
-    model learns more — the gap IS the acceptance curve's subject."""
+    """Order-2 Markov chain over 26 lowercase letters with PEAKED rows
+    (mean top transition prob ≈ 0.83 at scale 4.0): enough structure
+    that a 1-layer model learns most of it and a 2-layer model learns
+    more — the gap IS the acceptance curve's subject. At scale 2.0 the
+    rows were too flat: neither model's argmax converged to the chain's
+    mode in a few hundred steps and greedy agreement sat below 0.1,
+    measuring training noise instead of the draft/target capacity gap."""
     rng = np.random.default_rng(seed)
     k = 26
-    logits = rng.gumbel(size=(k, k, k)) * 2.0
+    logits = rng.gumbel(size=(k, k, k)) * 4.0
     probs = np.exp(logits - logits.max(-1, keepdims=True))
     probs /= probs.sum(-1, keepdims=True)
 
@@ -70,9 +74,19 @@ def train_model(cfg, corpus_fn, steps: int, seed: int) -> dict:
     from polykey_tpu.parallel.mesh import MeshConfig, create_mesh
     from polykey_tpu.train.train import make_train_step
 
+    import optax
+
     tok = ByteTokenizer()
     mesh = create_mesh(MeshConfig(), jax.devices()[:1])
-    init_state, train_step, shard_batch = make_train_step(cfg, mesh)
+    # make_train_step's default LR (1e-4) is sized for real pretraining
+    # runs; at tiny-model scale it leaves the pair at ~3.5 nats after
+    # 300 steps — far off the corpus's ~1 nat — and argmax agreement
+    # measures init noise. 3e-3 converges both models onto the chain's
+    # modes (target ≈0.7 nats, draft ≈1.0) in the same step budget.
+    init_state, train_step, shard_batch = make_train_step(
+        cfg, mesh,
+        optimizer=optax.adamw(learning_rate=3e-3, weight_decay=0.01),
+    )
     params = init_params(jax.random.PRNGKey(seed), cfg, jnp.float32)
     state = init_state(params)
 
@@ -98,8 +112,14 @@ def train_model(cfg, corpus_fn, steps: int, seed: int) -> dict:
     return jax.device_get(state.params)
 
 
-def serve(config, params, draft_params, prompts, max_new, temperature):
-    """Serve prompts on a fresh engine; returns (stats, tok_s)."""
+def serve(config, params, draft_params, prompts, max_new, temperature,
+          sample_gamma: bool = False):
+    """Serve prompts on a fresh engine; returns (stats, tok_s). With
+    sample_gamma, the per-lane gamma dial (stats spec_gamma_mean) is
+    sampled on every received token while lanes are LIVE — a drained
+    engine resets the dials optimistic, so the end-of-run snapshot
+    cannot see where the dial actually sat (ISSUE 19); the mean of the
+    live samples can. Reported as stats['spec_gamma_dial_mean']."""
     from polykey_tpu.engine.engine import GenRequest, InferenceEngine
 
     eng = InferenceEngine(config, params=params, draft_params=draft_params)
@@ -124,6 +144,7 @@ def serve(config, params, draft_params, prompts, max_new, temperature):
         for r in reqs:
             eng.submit(r)
         total = 0
+        gamma_samples = []
         for r in reqs:
             while True:
                 kind, value = r.out.get(timeout=600.0)
@@ -132,23 +153,28 @@ def serve(config, params, draft_params, prompts, max_new, temperature):
                     break
                 if kind == "error":
                     raise RuntimeError(value)
+                if sample_gamma:
+                    g = eng.stats().get("spec_gamma_mean")
+                    if g is not None:
+                        gamma_samples.append(g)
         dt = time.monotonic() - t0
-        return eng.stats(), total / dt
+        stats = eng.stats()
+        if sample_gamma:
+            stats["spec_gamma_dial_mean"] = (
+                round(float(np.mean(gamma_samples)), 3)
+                if gamma_samples else None)
+        return stats, total / dt
     finally:
         eng.shutdown()
 
 
-def main() -> None:
-    from polykey_tpu.engine.config import EngineConfig
+def prepare_trained_pair(steps: int):
+    """Register `tiny-llama-draft` and train the correlated target/draft
+    pair on the Markov corpus. Shared with `occupancy_soak.py --ab-spec`
+    (ISSUE 19) so the 48-slot A/B measures the SAME pair this sweep
+    pre-registers — one alpha, two harnesses. Returns
+    (target_cfg, draft_cfg, target_params, draft_params, corpus_fn)."""
     from polykey_tpu.models.config import MODEL_REGISTRY, TINY_LLAMA
-
-    steps = int(os.environ.get("SWEEP_TRAIN_STEPS", "400"))
-    n_req = int(os.environ.get("SWEEP_REQUESTS", "8"))
-    max_new = int(os.environ.get("SWEEP_MAX_NEW", "48"))
-    gammas = [int(g) for g in os.environ.get(
-        "SWEEP_GAMMAS", "2,4,8").split(",")]
-    temps = [float(t) for t in os.environ.get(
-        "SWEEP_TEMPS", "0.0,0.5,1.0").split(",")]
 
     target_cfg = TINY_LLAMA
     draft_cfg = dataclasses.replace(
@@ -163,6 +189,22 @@ def main() -> None:
         f"({draft_cfg.name}) on the Markov corpus, {steps} steps each...")
     target_params = train_model(target_cfg, corpus, steps, seed=3)
     draft_params = train_model(draft_cfg, corpus, steps, seed=5)
+    return target_cfg, draft_cfg, target_params, draft_params, corpus
+
+
+def main() -> None:
+    from polykey_tpu.engine.config import EngineConfig
+
+    steps = int(os.environ.get("SWEEP_TRAIN_STEPS", "400"))
+    n_req = int(os.environ.get("SWEEP_REQUESTS", "8"))
+    max_new = int(os.environ.get("SWEEP_MAX_NEW", "48"))
+    gammas = [int(g) for g in os.environ.get(
+        "SWEEP_GAMMAS", "2,4,8").split(",")]
+    temps = [float(t) for t in os.environ.get(
+        "SWEEP_TEMPS", "0.0,0.5,1.0").split(",")]
+
+    (target_cfg, draft_cfg, target_params, draft_params,
+     corpus) = prepare_trained_pair(steps)
 
     prompt_rng = np.random.default_rng(17)
     prompts = [corpus(48, prompt_rng) for _ in range(n_req)]
@@ -233,11 +275,24 @@ def main() -> None:
             if alpha is not None and alpha < 1.0:
                 entry["expected_tokens_per_round"] = round(
                     (1 - alpha ** (gamma + 1)) / (1 - alpha), 3)
+            # Per-lane dial leg (ISSUE 19): the same row under the
+            # engine default adaptive_gamma=True — where each lane's
+            # acceptance EWMA drives its own dial. The column is the
+            # mean dial observed while lanes were live; at the alphas
+            # this weak pair measures, it should sit near the LOW rung.
+            acfg = dataclasses.replace(cfg, adaptive_gamma=True)
+            astats, _ = serve(
+                acfg, target_params, draft_params, prompts, max_new,
+                temp, sample_gamma=True)
+            entry["per_lane_gamma_mean"] = astats.get(
+                "spec_gamma_dial_mean")
+            entry["acceptance_per_lane"] = astats.get("spec_acceptance")
             results["sweep"].append(entry)
             speedup = entry["cpu_speedup_vs_plain"]
             log(f"gamma={gamma} T={temp}: alpha={alpha} "
                 f"{tok_s:.1f} tok/s "
-                f"({f'{speedup}x' if speedup is not None else 'n/a'})")
+                f"({f'{speedup}x' if speedup is not None else 'n/a'}) "
+                f"per-lane dial {entry['per_lane_gamma_mean']}")
 
     out_path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), os.pardir,
@@ -247,13 +302,15 @@ def main() -> None:
     log(f"wrote {out_path}")
 
     # Markdown table (PERF.md's source).
-    print("| gamma | T | acceptance | E[tok/round] | CPU tok/s | vs plain |")
-    print("|---|---|---|---|---|---|")
+    print("| gamma | T | acceptance | E[tok/round] | per-lane γ̄ | "
+          "CPU tok/s | vs plain |")
+    print("|---|---|---|---|---|---|---|")
     for e in results["sweep"]:
         speedup = e["cpu_speedup_vs_plain"]
         print(f"| {e['gamma']} | {e['temperature']} | "
               f"{e['acceptance']} | "
               f"{e.get('expected_tokens_per_round', '—')} | "
+              f"{e.get('per_lane_gamma_mean', '—')} | "
               f"{e['tok_s']} | "
               f"{f'{speedup}x' if speedup is not None else '—'} |")
 

@@ -218,6 +218,90 @@ class TestDecideKvKnobs:
         assert d is None
 
 
+class TestDecideGamma:
+    """Speculation-cap control (ISSUE 19): the windowed fleet-wide
+    accept rate moves the engine's gamma cap between the low ladder
+    rung and the boot value; the per-lane device dial handles
+    variation inside the cap."""
+
+    @staticmethod
+    def spec_state(cap=4, low=2):
+        state = make_state()
+        state.setpoints[ap.SPEC_GAMMA] = cap
+        state.baselines[ap.SPEC_GAMMA] = 4
+        state.bounds[ap.SPEC_GAMMA] = (low, 4)
+        return state
+
+    def test_collapse_caps_at_low_rung(self):
+        d = ap.decide_gamma(
+            summary(spec_accept_rate=0.12), self.spec_state(), CFG, 100.0,
+        )
+        assert d is not None and d.direction == ap.DOWN
+        assert (d.old, d.new) == (4, 2)
+
+    def test_recovery_restores_boot_cap(self):
+        d = ap.decide_gamma(
+            summary(spec_accept_rate=0.8), self.spec_state(cap=2),
+            CFG, 100.0,
+        )
+        assert d is not None and d.direction == ap.UP
+        assert (d.old, d.new) == (2, 4)
+
+    def test_inside_band_holds(self):
+        # 0.45 sits between the 0.35/0.55 edges: hysteresis holds in
+        # BOTH directions, whether the cap is up or already down.
+        for cap in (4, 2):
+            d = ap.decide_gamma(
+                summary(spec_accept_rate=0.45), self.spec_state(cap=cap),
+                CFG, 100.0,
+            )
+            assert d is None
+
+    def test_already_at_low_rung_holds(self):
+        d = ap.decide_gamma(
+            summary(spec_accept_rate=0.12), self.spec_state(cap=2),
+            CFG, 100.0,
+        )
+        assert d is None
+
+    def test_already_at_boot_cap_holds(self):
+        d = ap.decide_gamma(
+            summary(spec_accept_rate=0.9), self.spec_state(), CFG, 100.0,
+        )
+        assert d is None
+
+    def test_no_draft_evidence_holds(self):
+        # No drafts proposed in the window → spec_accept_rate is None,
+        # never 0.0 (a synthesized zero would cap a quiet engine).
+        d = ap.decide_gamma(
+            summary(spec_accept_rate=None), self.spec_state(), CFG, 100.0,
+        )
+        assert d is None
+
+    def test_spec_off_never_arms(self):
+        # knob_setpoints only exposes spec_gamma on draft-model engines;
+        # without the setpoint the action holds forever.
+        d = ap.decide_gamma(
+            summary(spec_accept_rate=0.12), make_state(), CFG, 100.0,
+        )
+        assert d is None
+
+    def test_cooldown_gates(self):
+        state = self.spec_state()
+        state.last_fired[ap.SPEC_GAMMA] = 95.0
+        d = ap.decide_gamma(
+            summary(spec_accept_rate=0.12), state, CFG, 100.0,
+        )
+        assert d is None  # 5s elapsed < 10s cooldown
+
+    def test_setter_rung_snaps_and_gates_on_spec(self, engine):
+        # The live setter: a non-spec engine reports 0 and holds; the
+        # knob only actuates on draft-model engines (covered in
+        # test_engine_spec.py's live-dial tests).
+        assert engine.set_spec_gamma(2) == 0
+        assert "spec_gamma" not in engine.knob_setpoints()
+
+
 class TestDecideRouteWeights:
     @staticmethod
     def replicas(*p95s):

@@ -462,7 +462,7 @@ def test_gl004_trips_without_host_crossing_annotations():
     engine: the tripped merges poison its slots)."""
     from polykey_tpu.engine import engine as engine_mod
 
-    def _no_annotation():
+    def _no_annotation(site: str = "unlabeled"):
         return contextlib.nullcontext()
 
     original = engine_mod._host_crossing
