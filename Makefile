@@ -104,10 +104,15 @@ chaos-smoke: ## Run the fault-injection/resilience test suite on CPU
 # 10 s window, measured lanes >= 0.7 x slots (the 48-slot acceptance
 # run measured 0.82+; see perf/occupancy_soak_*.json). Artifact goes to
 # /tmp so CI runs never dirty the repo.
-occupancy-smoke: ## Poisson-load occupancy soak at CI scale (gated >= 0.7)
-	JAX_PLATFORMS=cpu $(PYTHON) scripts/occupancy_soak.py \
+occupancy-smoke: ## Poisson-load occupancy soak at CI scale (gated >= 0.7 + sched-witness zero-starvation gate)
+	rm -rf /tmp/polykey-sched-witness-occupancy
+	JAX_PLATFORMS=cpu POLYKEY_SCHED_WITNESS=1 \
+	  POLYKEY_SCHED_WITNESS_OUT=/tmp/polykey-sched-witness-occupancy \
+	  $(PYTHON) scripts/occupancy_soak.py \
 	  --slots 8 --duration 10 --min-occupancy 0.7 \
 	  --out /tmp/occupancy_smoke.json
+	$(PYTHON) -m polykey_tpu.analysis sched --only SL006 \
+	  --witness /tmp/polykey-sched-witness-occupancy
 
 # Ragged dispatch (ISSUE 12): the interpret-mode kernel path (fp +
 # int8) and the engine's greedy bit-identity vs the bucketed path are
@@ -180,12 +185,15 @@ failover-soak: ## The 3-replica / 30 s acceptance drill (writes perf/)
 # acquisition-order edges from the coordinator + every worker process
 # then merge into racelint's static lock graph, which must stay
 # cycle-free (the zero-deadlock gate with real evidence).
-disagg-smoke: ## Kill-workers drill at CI scale + lock-witness zero-cycle gate + heap-witness zero-growth gate
-	rm -rf /tmp/polykey-lock-witness /tmp/polykey-heap-witness-disagg
+disagg-smoke: ## Kill-workers drill at CI scale + lock-witness zero-cycle gate + heap-witness zero-growth gate + sched-witness zero-starvation gate
+	rm -rf /tmp/polykey-lock-witness /tmp/polykey-heap-witness-disagg \
+	  /tmp/polykey-sched-witness-disagg
 	JAX_PLATFORMS=cpu POLYKEY_LOCK_WITNESS=1 \
 	  POLYKEY_LOCK_WITNESS_OUT=/tmp/polykey-lock-witness \
 	  POLYKEY_HEAP_WITNESS=1 \
 	  POLYKEY_HEAP_WITNESS_OUT=/tmp/polykey-heap-witness-disagg \
+	  POLYKEY_SCHED_WITNESS=1 \
+	  POLYKEY_SCHED_WITNESS_OUT=/tmp/polykey-sched-witness-disagg \
 	  $(PYTHON) scripts/failover_soak.py --disagg \
 	  --prefill 2 --decode 1 --duration 10 \
 	  --out /tmp/disagg_smoke.json
@@ -193,6 +201,8 @@ disagg-smoke: ## Kill-workers drill at CI scale + lock-witness zero-cycle gate +
 	  --witness /tmp/polykey-lock-witness
 	$(PYTHON) -m polykey_tpu.analysis mem --only ML006 \
 	  --witness /tmp/polykey-heap-witness-disagg
+	$(PYTHON) -m polykey_tpu.analysis sched --only SL006 \
+	  --witness /tmp/polykey-sched-witness-disagg
 
 # Cross-process black boxes (ISSUE 16): reconstruct the last seconds
 # before any member death from the checkpoints in a disagg state dir —
@@ -218,13 +228,16 @@ postmortem-smoke: ## Kill a decode worker mid-stream; black boxes must reconstru
 # a typed autopilot_decision timeline event) plus the pool's own
 # supervision must recover p95 TTFT to within tolerance of the
 # pre-ramp baseline with zero failed RPCs and ZERO human intervention.
-# Smoke scale runs under the heap witness and finishes with the
-# four-tier `analysis all` gate (zero blocking findings).
-autopilot-smoke: ## Ramp+SIGKILL drill at CI scale, controller-only recovery + analysis-all gate + heap-witness gate
-	rm -rf /tmp/polykey-heap-witness-autopilot
+# Smoke scale runs under the heap + starvation witnesses and finishes
+# with the five-tier `analysis all` gate (zero blocking findings).
+autopilot-smoke: ## Ramp+SIGKILL drill at CI scale, controller-only recovery + analysis-all gate + heap-witness gate + sched-witness gate
+	rm -rf /tmp/polykey-heap-witness-autopilot \
+	  /tmp/polykey-sched-witness-autopilot
 	JAX_PLATFORMS=cpu \
 	  POLYKEY_HEAP_WITNESS=1 \
 	  POLYKEY_HEAP_WITNESS_OUT=/tmp/polykey-heap-witness-autopilot \
+	  POLYKEY_SCHED_WITNESS=1 \
+	  POLYKEY_SCHED_WITNESS_OUT=/tmp/polykey-sched-witness-autopilot \
 	  $(PYTHON) scripts/autopilot_soak.py \
 	  --prefill 1 --decode 1 --baseline-s 12 --ramp-s 35 --tail-s 10 \
 	  --max-p95-added-ms 45000 \
@@ -232,6 +245,8 @@ autopilot-smoke: ## Ramp+SIGKILL drill at CI scale, controller-only recovery + a
 	$(PYTHON) -m polykey_tpu.analysis all
 	$(PYTHON) -m polykey_tpu.analysis mem --only ML006 \
 	  --witness /tmp/polykey-heap-witness-autopilot
+	$(PYTHON) -m polykey_tpu.analysis sched --only SL006 \
+	  --witness /tmp/polykey-sched-witness-autopilot
 
 autopilot-soak: ## The 1+1 -> scaled / 65 s acceptance drill (writes perf/)
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/autopilot_soak.py \
@@ -277,7 +292,7 @@ multiproc-demo: ## 2-process jax.distributed train+serve on localhost CPU
 	bash scripts/run_multiproc_demo.sh
 
 # -- local CI reproduction (reference Makefile:217-308 scan/ci-check family) --
-.PHONY: lint polylint graphlint racelint memlint native-asan scan ci-check
+.PHONY: lint polylint graphlint racelint memlint schedlint native-asan scan ci-check
 
 lint: ## Lint: ruff (pinned ruff.toml, same config as CI) + polylint
 	@if command -v ruff >/dev/null 2>&1; then \
@@ -318,6 +333,16 @@ graphlint: ## Compiled-graph contract analysis (CPU-backed; ~1-2 min)
 memlint: ## Memory & capacity contract analysis (stdlib-only)
 	$(PYTHON) -m polykey_tpu.analysis mem
 
+# The fifth analysis tier (ISSUE 20): scheduler liveness & fairness
+# contracts — progress floors on budget-bounded dispatch loops (SL001),
+# round-robin cursor discipline with starved-first re-anchoring
+# (SL002), restore→prefill→decode frontier ordering (SL003),
+# bounded-wait queues (SL004), and ragged quota conservation (SL005).
+# Stdlib-only AST; the runtime starvation witness (SL006) rides
+# occupancy-smoke, disagg-smoke, and autopilot-smoke.
+schedlint: ## Scheduler liveness & fairness contract analysis (stdlib-only)
+	$(PYTHON) -m polykey_tpu.analysis sched
+
 ASAN_FLAGS := -g -O1 -fsanitize=address,undefined -fno-omit-frame-pointer
 
 native-asan: ## Build native components under ASan/UBSan and smoke-run them
@@ -351,11 +376,12 @@ scan: ## Security scan (Trivy fs over the tree + lockfile, CRITICAL/HIGH gate)
 	  --scanners vuln,secret \
 	  --severity CRITICAL,HIGH
 
-ci-check: ## Run the CI pipeline locally: lint+polylint+racelint+graphlint+memlint, chaos, failover, disagg(+lock/heap-witness gates), postmortem, occupancy, ragged, hostkv(+heap-witness gate), autopilot(+analysis-all gate), obs, perf-gate, tests, native(+asan), scan
+ci-check: ## Run the CI pipeline locally: lint+polylint+racelint+graphlint+memlint+schedlint, chaos, failover, disagg(+lock/heap/sched-witness gates), postmortem, occupancy(+sched-witness gate), ragged, hostkv(+heap-witness gate), autopilot(+analysis-all gate), obs, perf-gate, tests, native(+asan), scan
 	@$(MAKE) lint
 	@$(MAKE) racelint
 	@$(MAKE) graphlint
 	@$(MAKE) memlint
+	@$(MAKE) schedlint
 	@$(MAKE) chaos-smoke
 	@$(MAKE) failover-smoke
 	@$(MAKE) disagg-smoke

@@ -45,3 +45,14 @@ if _os.environ.get("POLYKEY_HEAP_WITNESS", "") == "1":
     from .analysis import heapwitness as _heapwitness
 
     _heapwitness.maybe_install()
+
+# Runtime starvation witness (schedlint's dynamic half, ISSUE 20): with
+# POLYKEY_SCHED_WITNESS=1, the engine loop records per-slot wait-age and
+# consecutive-skip counters at every dispatch boundary (restore /
+# prefill / decode frontiers), dumped per-process at exit for
+# `python -m polykey_tpu.analysis sched --witness`. Same gating shape
+# as the lock and heap witnesses above.
+if _os.environ.get("POLYKEY_SCHED_WITNESS", "") == "1":
+    from .analysis import schedwitness as _schedwitness
+
+    _schedwitness.maybe_install()

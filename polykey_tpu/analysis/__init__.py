@@ -19,9 +19,10 @@ Usage::
     python -m polykey_tpu.analysis graph              # graphlint (2nd tier)
     python -m polykey_tpu.analysis race               # racelint (3rd tier)
     python -m polykey_tpu.analysis mem                # memlint (4th tier)
+    python -m polykey_tpu.analysis sched              # schedlint (5th tier)
     python -m polykey_tpu.analysis all                # every tier, one exit
 
-Four tiers, one discipline (per-tier baselines that trend toward
+Five tiers, one discipline (per-tier baselines that trend toward
 empty, mandatory-reason suppressions, content-hashed fingerprints):
 
 - **polylint** (``rules.py``, PL***) — what the *source* promises:
@@ -50,14 +51,27 @@ empty, mandatory-reason suppressions, content-hashed fingerprints):
   with an opt-in runtime heap witness (``heapwitness.py``,
   POLYKEY_HEAP_WITNESS=1) that merges *observed* tracemalloc growth
   and pool occupancies into the findings (``mem --witness``).
+- **schedlint** (``sched.py``, SL***) — what the *scheduler* promises:
+  liveness and fairness contracts over the engine loop — every
+  budget-bounded dispatch loop has a statically provable progress
+  floor, every round-robin cursor advances or re-anchors
+  (starved-first) on every consumption path, the restore→prefill→
+  decode frontier order holds per iteration, consumed queues pair with
+  an admission bound or shed path, and ragged per-range accounting
+  sums exactly to the dispatch width. Stdlib-only, with an opt-in
+  runtime starvation witness (``schedwitness.py``,
+  POLYKEY_SCHED_WITNESS=1) that records per-slot wait ages and
+  consecutive-skip counts at dispatch boundaries and merges them into
+  the verdict under a max-starvation-age gate (``sched --witness``).
 
 Per-line suppression (reason required; reasonless or unused suppressions
 are themselves findings; the rule id's prefix names the tier that
-validates it, so PL/CL/ML entries never cross-fire)::
+validates it, so PL/CL/ML/SL entries never cross-fire)::
 
     packed = np.asarray(data)  # polylint: disable=PL001(resolve point)
     self._closing = True  # polylint: disable=CL002(one-way latch)
     self._sticky[k] = v  # polylint: disable=ML002(EWMA per replica id)
+    drain()  # polylint: disable=SL004(shutdown path, loop already dead)
 
 The package is stdlib-only by design: the CI lint job installs ruff and
 nothing else, and ``python -m polykey_tpu.analysis`` must run there.

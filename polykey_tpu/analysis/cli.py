@@ -22,8 +22,20 @@ analytic byte ledger vs chip HBM, unbounded-growth AST rules, knob
 documentation/ship contracts, and the runtime heap-witness merge.
 Stdlib-only like this tier.
 
-``python -m polykey_tpu.analysis all`` runs all four tiers with one
+``python -m polykey_tpu.analysis sched`` dispatches to the fifth tier
+(schedlint, analysis/sched.py): scheduler liveness & fairness contracts
+— progress floors on budget-bounded dispatch loops, round-robin cursor
+discipline, frontier ordering, bounded-wait queues, ragged quota
+conservation, and the runtime starvation-witness merge. Stdlib-only
+like this tier.
+
+``python -m polykey_tpu.analysis all`` runs all five tiers with one
 aggregate exit code (and one merged JSON object under ``--json``).
+
+Shared CLI plumbing (``--only`` typo rejection, ``--prune``/
+``--write-baseline`` partial-run refusal, ``--witness`` loading) lives
+in core.py (parse_only / require_full_run / load_witness_arg raising
+UsageError) so the five tiers cannot drift on the refusal semantics.
 """
 
 from __future__ import annotations
@@ -40,7 +52,13 @@ from .baseline import (
     prune_baseline,
     write_baseline,
 )
-from .core import DEFAULT_TARGETS, all_rules, run_paths
+from .core import (
+    DEFAULT_TARGETS,
+    UsageError,
+    all_rules,
+    require_full_run,
+    run_paths,
+)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -87,7 +105,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 def run_all(argv: list[str]) -> int:
     """``python -m polykey_tpu.analysis all [--json]``: polylint +
-    racelint + graphlint + memlint as one gate. Each tier runs its full
+    racelint + graphlint + memlint + schedlint as one gate. Each tier runs its full
     default sweep against its own committed baseline; the exit code is
     clean only when every tier is. Tier-specific flags (--only, --prune,
     --write-baseline, targets) are refused — partial aggregate runs
@@ -96,7 +114,8 @@ def run_all(argv: list[str]) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m polykey_tpu.analysis all",
         description="run every analysis tier (polylint + racelint + "
-                    "graphlint + memlint) with one aggregate exit code",
+                    "graphlint + memlint + schedlint) with one "
+                    "aggregate exit code",
     )
     parser.add_argument("--root", default=".",
                         help="repo root for every tier (default: cwd)")
@@ -107,13 +126,14 @@ def run_all(argv: list[str]) -> int:
     import contextlib
     import io
 
-    from . import concurrency, graph, memory
+    from . import concurrency, graph, memory, sched
 
     tiers = (
         ("polylint", main),
         ("racelint", concurrency.main),
         ("graphlint", graph.main),
         ("memlint", memory.main),
+        ("schedlint", sched.main),
     )
     results: dict[str, dict] = {}
     codes: dict[str, int] = {}
@@ -170,6 +190,10 @@ def main(argv: list[str] | None = None) -> int:
         from . import memory
 
         return memory.main(argv[1:])
+    if argv and argv[0] == "sched":
+        from . import sched
+
+        return sched.main(argv[1:])
     if argv and argv[0] == "all":
         return run_all(argv[1:])
     args = build_parser().parse_args(argv)
@@ -185,12 +209,14 @@ def main(argv: list[str] | None = None) -> int:
               file=sys.stderr)
         return 2
     targets = args.targets or None
-    if args.prune and targets:
+    try:
         # A partial run can't tell "fixed" from "not scanned"; pruning
         # against it would drop live baseline entries for every file
-        # outside the target list.
-        print("polylint: --prune requires a full run "
-              "(drop the explicit targets)", file=sys.stderr)
+        # outside the target list (shared refusal semantics, core.py).
+        require_full_run(partial=bool(targets), prune=args.prune,
+                         write_baseline=False)
+    except UsageError as e:
+        print(f"polylint: {e}", file=sys.stderr)
         return 2
     try:
         findings = run_paths(root, targets)

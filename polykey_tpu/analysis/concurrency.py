@@ -77,7 +77,11 @@ from .core import (
     FileContext,
     Finding,
     Rule,
+    UsageError,
     iter_py_files,
+    load_witness_arg,
+    parse_only,
+    require_full_run,
 )
 from .rules import call_name, dotted, walk_no_nested_functions
 
@@ -1753,42 +1757,21 @@ def main(argv: Optional[list[str]] = None) -> int:
               file=sys.stderr)
         return 2
     targets = args.targets or None
-    only = None
-    if args.only:
-        if args.prune or args.write_baseline:
-            # Same refusal as graphlint: a partial run can't tell
-            # "fixed" from "not checked", and write-baseline would
-            # silently discard every other rule's debt.
-            flag = "--prune" if args.prune else "--write-baseline"
-            print(f"racelint: {flag} requires a full run (drop --only)",
-                  file=sys.stderr)
-            return 2
-        only = {t.strip() for t in args.only.split(",") if t.strip()}
-        unknown = only - RACE_RULE_IDS
-        if unknown:
-            # A typo'd id silently running zero rules would read as a
-            # clean repo — the graphlint precedent.
-            print(
-                f"racelint: unknown rule id(s): "
-                f"{', '.join(sorted(unknown))} "
-                f"(known: {', '.join(sorted(RACE_RULE_IDS))})",
-                file=sys.stderr)
-            return 2
-    if args.prune and targets:
-        print("racelint: --prune requires a full run "
-              "(drop the explicit targets)", file=sys.stderr)
-        return 2
-
-    witness_data = None
-    if args.witness:
+    try:
+        # A typo'd id silently running zero rules would read as a clean
+        # repo, and a partial run can't tell "fixed" from "not checked"
+        # (shared refusal semantics, core.py).
+        only = parse_only(args.only, RACE_RULE_IDS)
+        require_full_run(partial=bool(targets) or only is not None,
+                         prune=args.prune,
+                         write_baseline=args.write_baseline)
         from . import witness as witness_mod
 
-        try:
-            witness_data = witness_mod.load_witness(args.witness)
-        except (OSError, ValueError) as e:
-            print(f"racelint: cannot load witness {args.witness}: {e}",
-                  file=sys.stderr)
-            return 2
+        witness_data = load_witness_arg(args.witness,
+                                        witness_mod.load_witness)
+    except UsageError as e:
+        print(f"racelint: {e}", file=sys.stderr)
+        return 2
 
     try:
         findings, analyzer = run_race(root, targets, only=only,

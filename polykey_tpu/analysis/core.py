@@ -32,10 +32,11 @@ SUPPRESS_RE = re.compile(r"polylint:\s*disable=(?P<entries>.+)$")
 # ("async copy (D2H) landed"); deeper nesting is not supported.
 # The rule id's two-letter prefix names the tier that owns it: PL = the
 # AST tier here, CL = racelint (analysis/concurrency.py), ML = memlint
-# (analysis/memory.py). One comment syntax serves every line-anchored
-# tier; each tier validates only the suppressions in its own namespace,
-# so a CL004 annotation in engine code is invisible to a plain polylint
-# run instead of an "unknown rule" finding.
+# (analysis/memory.py), SL = schedlint (analysis/sched.py). One comment
+# syntax serves every line-anchored tier; each tier validates only the
+# suppressions in its own namespace, so a CL004 annotation in engine
+# code is invisible to a plain polylint run instead of an "unknown
+# rule" finding.
 ENTRY_RE = re.compile(
     r"(?P<rule>[A-Z]{2}\d{3})\s*"
     r"(?:\((?P<reason>[^()]*(?:\([^()]*\)[^()]*)*)\))?"
@@ -44,7 +45,7 @@ ENTRY_RE = re.compile(
 # entry outside this set (a typo'd prefix, or GL — the graph tier
 # suppresses via class-level SUPPRESSIONS, not comments) suppresses
 # nothing; the base PL tier reports it so it can't sit dead forever.
-LINE_TIER_PREFIXES = frozenset({"PL", "CL", "ML"})
+LINE_TIER_PREFIXES = frozenset({"PL", "CL", "ML", "SL"})
 
 
 @dataclass
@@ -272,6 +273,64 @@ def register(cls: type[Rule]) -> type[Rule]:
 
 def all_rules() -> list[Rule]:
     return [_REGISTRY[k] for k in sorted(_REGISTRY)]
+
+
+# -- shared CLI plumbing ------------------------------------------------------
+#
+# Every line-anchored tier's main() repeats the same three safety
+# behaviors: --only typo rejection (a typo'd id silently running zero
+# rules reads as a clean repo), --prune/--write-baseline refusal on
+# partial runs (a partial run can't tell "fixed" from "not scanned"),
+# and --witness load-error handling. One implementation here; each tier
+# catches UsageError, prints it under its own prog name, and exits 2.
+
+
+class UsageError(Exception):
+    """CLI usage error (exit code 2). The tier main prints str(e) to
+    stderr prefixed with its own tier name."""
+
+
+def parse_only(raw: Optional[str], known: set,
+               noun: str = "rule") -> Optional[set]:
+    """Parse a --only value against the tier's known ids. Returns the
+    selected id set (None = full run); raises UsageError on a typo'd
+    id — it must not silently run zero rules."""
+    if not raw:
+        return None
+    only = {t.strip().upper() for t in raw.split(",") if t.strip()}
+    unknown = only - set(known)
+    if unknown:
+        raise UsageError(
+            f"unknown {noun} id(s) for --only: {', '.join(sorted(unknown))} "
+            f"(known: {', '.join(sorted(known))})"
+        )
+    return only
+
+
+def require_full_run(*, partial: bool, prune: bool,
+                     write_baseline: bool) -> None:
+    """Refuse baseline mutation on a partial run: pruning against it
+    drops live entries for everything outside the selection, and
+    write-baseline is worse — it rewrites the file from only the run
+    rules' findings, silently discarding every other rule's debt."""
+    if (prune or write_baseline) and partial:
+        flag = "--prune" if prune else "--write-baseline"
+        raise UsageError(
+            f"{flag} requires a full run (drop --only and explicit targets)"
+        )
+
+
+def load_witness_arg(path: Optional[str], loader):
+    """Load a --witness file-or-directory via the tier's loader
+    (witness/heapwitness/schedwitness .load_witness). Returns the
+    per-process snapshot list, or None when no path was given; raises
+    UsageError on unreadable or version-mismatched dumps."""
+    if not path:
+        return None
+    try:
+        return loader(path)
+    except (OSError, ValueError) as e:
+        raise UsageError(f"cannot load witness {path}: {e}") from e
 
 
 # -- runner -------------------------------------------------------------------

@@ -51,7 +51,7 @@ from .baseline import (
     prune_baseline,
     write_baseline,
 )
-from .core import Finding
+from .core import Finding, UsageError, parse_only, require_full_run
 
 GRAPH_BASELINE = "graphlint-baseline.json"
 
@@ -1786,27 +1786,17 @@ def main(argv: Optional[list[str]] = None) -> int:
         print(f"graphlint: --root {args.root} is not a directory",
               file=sys.stderr)
         return 2
-    only = None
-    if args.only:
-        if args.prune or args.write_baseline:
-            # A partial run can't tell "fixed" from "not checked":
-            # pruning against it drops live entries, and write-baseline
-            # is worse — it rewrites the file from only the run checks'
-            # findings, silently discarding every other check's debt.
-            flag = "--prune" if args.prune else "--write-baseline"
-            print(f"graphlint: {flag} requires a full run (drop --only)",
-                  file=sys.stderr)
-            return 2
-        only = {token.strip() for token in args.only.split(",") if token.strip()}
-        # A typo'd id silently running zero checks would read as a clean
-        # graph — the exact failure mode GL000 exists to prevent.
-        unknown = only - set(_GRAPH_REGISTRY)
-        if unknown:
-            print(
-                f"graphlint: unknown check id(s): {', '.join(sorted(unknown))} "
-                f"(known: {', '.join(sorted(_GRAPH_REGISTRY))})",
-                file=sys.stderr)
-            return 2
+    try:
+        # A typo'd id silently running zero checks would read as a
+        # clean graph (the exact failure mode GL000 exists to prevent),
+        # and a partial run can't tell "fixed" from "not checked"
+        # (shared refusal semantics, core.py).
+        only = parse_only(args.only, set(_GRAPH_REGISTRY), noun="check")
+        require_full_run(partial=only is not None, prune=args.prune,
+                         write_baseline=args.write_baseline)
+    except UsageError as e:
+        print(f"graphlint: {e}", file=sys.stderr)
+        return 2
 
     t0 = time.monotonic()
     env = GraphEnv()

@@ -85,7 +85,11 @@ from .core import (
     FileContext,
     Finding,
     Rule,
+    UsageError,
     iter_py_files,
+    load_witness_arg,
+    parse_only,
+    require_full_run,
 )
 
 MEM_BASELINE = "memlint-baseline.json"
@@ -1107,35 +1111,21 @@ def main(argv: Optional[list[str]] = None) -> int:
               file=sys.stderr)
         return 2
 
-    only: Optional[set[str]] = None
-    if args.only:
-        only = {r.strip().upper() for r in args.only.split(",") if r.strip()}
-        known = {r.id for r in MEM_RULES}
-        unknown = only - known
-        if unknown:
-            print(f"memlint: unknown rule id(s) for --only: "
-                  f"{', '.join(sorted(unknown))} (known: "
-                  f"{', '.join(sorted(known))})", file=sys.stderr)
-            return 2
-
     targets = args.targets or None
-    partial = bool(targets) or only is not None
-    if (args.prune or args.write_baseline) and partial:
-        # A partial run can't tell "fixed" from "not scanned".
-        print("memlint: --prune/--write-baseline require a full run "
-              "(drop --only and explicit targets)", file=sys.stderr)
+    try:
+        only = parse_only(args.only, {r.id for r in MEM_RULES})
+        # A partial run can't tell "fixed" from "not scanned" (shared
+        # refusal semantics, core.py).
+        require_full_run(partial=bool(targets) or only is not None,
+                         prune=args.prune,
+                         write_baseline=args.write_baseline)
+        from . import heapwitness
+
+        witness = load_witness_arg(args.witness, heapwitness.load_witness)
+    except UsageError as e:
+        print(f"memlint: {e}", file=sys.stderr)
         return 2
-
-    witness = None
-    if args.witness:
-        try:
-            from . import heapwitness
-
-            witness = heapwitness.load_witness(args.witness)
-        except (OSError, ValueError) as e:
-            print(f"memlint: cannot load heap witness {args.witness}: {e}",
-                  file=sys.stderr)
-            return 2
+    partial = bool(targets) or only is not None
 
     try:
         findings, ledgers = run_memlint(root, targets, only, witness)

@@ -66,6 +66,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis import schedwitness as _schedwitness
 from ..faults import get_injector
 from ..models.config import ModelConfig, get_config
 from ..obs.timeline import TimelineRecorder
@@ -226,6 +227,37 @@ class _Slot:
     # End of this slot's previous emit window (first-token resolve or the
     # last processed block) — the inter-token-latency clock.
     last_emit: float = 0.0
+
+
+class _RRCursor:
+    """Starved-first round-robin cursor over a modulo-N slot space —
+    the ONE shared implementation of the `_chunk_rr`/`_restore_rr`
+    discipline (schedlint SL002 checks this class instead of divergent
+    open-coded copies). A frontier sweep iterates :meth:`scan`; a
+    completed sweep calls :meth:`advance` so index order alone never
+    privileges a slot; an early exit (budget spent, stream width full)
+    calls :meth:`reanchor` ON the first skipped slot so it scans first
+    next iteration instead of losing its turn to the advance."""
+
+    __slots__ = ("pos",)
+
+    def __init__(self) -> None:
+        self.pos = 0
+
+    def scan(self, n: int):
+        """Slot indices anchored at the cursor: (pos+0)%n … (pos+n-1)%n.
+        The anchor is captured at the call, so a reanchor() fired by an
+        early exit mid-sweep cannot perturb the remaining order."""
+        base = self.pos
+        return ((base + off) % n for off in range(n))
+
+    def reanchor(self, i: int) -> None:
+        """Early exit: the starved slot goes first next sweep."""
+        self.pos = i
+
+    def advance(self, n: int) -> None:
+        """Completed sweep: rotate the anchor past the slot that led."""
+        self.pos = (self.pos + 1) % n
 
 
 def _prefill_fn(
@@ -874,10 +906,13 @@ class InferenceEngine:
         # iteration — a mid-run set_kv_restore_slots actuation takes
         # effect on the next loop pass instead of being silently
         # ignored (the knob-application audit, ISSUE 18).
-        self._restore_slots = config.host_kv_restore_slots
-        # Restore-frontier round-robin cursor (the _chunk_rr
+        # Clamped like set_kv_restore_slots: the restore frontier's
+        # progress floor (schedlint SL001) assumes a budget of at least
+        # one scatter per iteration.
+        self._restore_slots = max(1, config.host_kv_restore_slots)
+        # Restore-frontier round-robin cursor (the shared starved-first
         # discipline for page faults).
-        self._restore_rr = 0
+        self._restore_rr = _RRCursor()
         # Durable-store gc cadence: gc() lists and parses the whole
         # state dir — amortize it over batches instead of paying a
         # directory scan per spill on the engine thread.
@@ -924,7 +959,7 @@ class InferenceEngine:
         # Round-robin cursor over slots with pending chunked prefill —
         # budgeted chunk advancement must not starve the highest-index
         # pending slot when the budget covers fewer chunks than slots.
-        self._chunk_rr = 0
+        self._chunk_rr = _RRCursor()
         self._block_steps = config.decode_block_steps
         # Load-adaptive block size (config.adaptive_block): the solo block
         # is a distinct static `steps` value, so it gets its own compile —
@@ -1671,6 +1706,14 @@ class InferenceEngine:
                             )
                         dispatched = True
                         worked = True
+                if _schedwitness.installed() and self._active.any():
+                    # Decode boundary: a dispatched block serves every
+                    # active lane (flat batch); active lanes with no
+                    # block this iteration are waiting on the frontier.
+                    lanes = np.flatnonzero(self._active).tolist()
+                    _schedwitness.note(
+                        "decode", lanes if dispatched else [], lanes
+                    )
                 t0 = _t()
                 self._resolve_prefills()
                 _acc("resolve", t0)
@@ -2154,8 +2197,7 @@ class InferenceEngine:
         spent = 0
         B = len(self._slots)
         starved = None
-        for off in range(B):
-            i = (self._chunk_rr + off) % B
+        for i in self._chunk_rr.scan(B):
             s = self._slots[i]
             if s is None or s.pending is None:
                 continue
@@ -2180,9 +2222,10 @@ class InferenceEngine:
             ranges.append((i, s, take))
             spent += take
         if starved is not None:
-            self._chunk_rr = starved
+            self._chunk_rr.reanchor(starved)
         else:
-            self._chunk_rr = (self._chunk_rr + 1) % B
+            self._chunk_rr.advance(B)
+        self._note_sched_frontier("prefill", [i for i, _s, _t in ranges])
         return ranges
 
     def _ragged_prefill_operands(self, ranges: list, W: int):
@@ -3163,6 +3206,28 @@ class InferenceEngine:
             repr(basis).encode(), digest_size=8
         ).hexdigest()
 
+    def _note_sched_frontier(self, frontier: str, served: list) -> None:
+        """Starvation-witness hook (schedlint SL006): record one
+        dispatch boundary — the slots this frontier served and the
+        slots that were ELIGIBLE for it but got nothing (faulting slots
+        at the restore frontier, pending-prefill resident slots at the
+        prefill frontier). One predicate call when the witness is not
+        armed (POLYKEY_SCHED_WITNESS=1)."""
+        if not _schedwitness.installed():
+            return
+        if frontier == "restore":
+            waiting = [
+                i for i, s in enumerate(self._slots)
+                if s is not None and s.restore_pages is not None
+            ]
+        else:
+            waiting = [
+                i for i, s in enumerate(self._slots)
+                if s is not None and s.pending is not None
+                and s.restore_pages is None
+            ]
+        _schedwitness.note(frontier, served, waiting)
+
     def _issue_restores(self) -> int:
         """The restore frontier: issue host→device page scatters for up
         to `host_kv_restore_slots` FAULTING slots, round-robin ahead of
@@ -3176,25 +3241,33 @@ class InferenceEngine:
         if self._host_kv is None:
             return 0
         issued = 0
+        served: list = []
         B = len(self._slots)
-        for off in range(B):
-            # Round-robin from the cursor (the _chunk_rr discipline):
-            # admissions always fill the lowest free index, so a
-            # 0-based scan would let fresh low-index faults starve a
-            # high-index faulting slot of the per-iteration budget.
-            i = (self._restore_rr + off) % B
+        # Round-robin from the cursor (the shared _RRCursor
+        # discipline): admissions always fill the lowest free index, so
+        # a 0-based scan would let fresh low-index faults starve a
+        # high-index faulting slot of the per-iteration budget.
+        for i in self._restore_rr.scan(B):
             slot = self._slots[i]
             if slot is None or slot.restore_pages is None:
                 continue
-            if issued >= self._restore_slots:
-                self._restore_rr = i        # starved slot goes first next
+            if issued >= self._restore_slots and issued > 0:
+                # Progress floor (schedlint SL001): the `issued > 0`
+                # conjunct proves at least one scatter rode this
+                # iteration before the budget can wedge the frontier —
+                # previously implicit in the >=1 clamp on the knob,
+                # which a mis-tuned live actuation could have violated.
+                self._restore_rr.reanchor(i)    # starved goes first next
+                self._note_sched_frontier("restore", served)
                 return issued
             if slot.request.cancelled.is_set():
                 self._finish(i, error="cancelled")
                 continue
             self._restore_slot_pages(i, slot)
             issued += 1
-        self._restore_rr = (self._restore_rr + 1) % B
+            served.append(i)
+        self._restore_rr.advance(B)
+        self._note_sched_frontier("restore", served)
         return issued
 
     def _restore_slot_pages(self, slot_idx: int, slot: _Slot) -> None:
@@ -3347,9 +3420,9 @@ class InferenceEngine:
         stalls, it must never wedge a long prompt). Returns prefill
         tokens dispatched."""
         spent = 0
+        served: list = []
         B = len(self._slots)
-        for off in range(B):
-            i = (self._chunk_rr + off) % B
+        for i in self._chunk_rr.scan(B):
             s = self._slots[i]
             if s is None or s.pending is None:
                 continue
@@ -3361,27 +3434,37 @@ class InferenceEngine:
             if budget is not None and spent > 0 and spent >= budget:
                 # Leave the cursor ON the starved slot so it goes first
                 # next iteration.
-                self._chunk_rr = i
+                self._chunk_rr.reanchor(i)
+                self._note_sched_frontier("prefill", served)
                 return spent
-            self._prefill_one_chunk(i)
-            spent += self._chunk
-        self._chunk_rr = (self._chunk_rr + 1) % B
+            charged = self._prefill_one_chunk(i)
+            if charged:
+                served.append(i)
+            spent += charged
+        self._chunk_rr.advance(B)
+        self._note_sched_frontier("prefill", served)
         return spent
 
-    def _prefill_one_chunk(self, slot_idx: int) -> None:
+    def _prefill_one_chunk(self, slot_idx: int) -> int:
         """Advance a long-prompt slot by one fixed-size chunk; the final
-        chunk samples the first token and activates the slot."""
+        chunk samples the first token and activates the slot. Returns
+        the charged prefill width — one full chunk window when a
+        dispatch issued (the budget charges at chunk granularity even
+        for a partial final chunk), 0 when the slot exited without
+        dispatching (cancelled / deadline-expired / prefill failure),
+        so quota accounting (schedlint SL005) never bills tokens that
+        never rode a dispatch."""
         slot = self._slots[slot_idx]
         assert slot is not None and slot.pending is not None
         request = slot.request
         if request.cancelled.is_set():
             self._finish(slot_idx, error="cancelled")
-            return
+            return 0
         if self._deadline_expired(request):
             # Expired mid-prefill: remaining chunks never dispatch.
             self.metrics.on_deadline_expired("prefill")
             self._finish(slot_idx, error=f"{DEADLINE_MSG} during prefill")
-            return
+            return 0
         C = self._chunk
         prompt_len = len(slot.pending)
         take = min(C, prompt_len - slot.filled)
@@ -3395,7 +3478,7 @@ class InferenceEngine:
             )
         except Exception as e:
             self._finish(slot_idx, error=f"prefill failed: {e}")
-            return
+            return 0
         if self.timeline is not None:
             self.timeline.prefill(slot_idx, take, final)
         # The chunk window is C tokens wide; `take` carried real ones.
@@ -3408,6 +3491,7 @@ class InferenceEngine:
             self._merge_slot(slot_idx, slot, token_dev, 0)
         else:
             slot.filled += take
+        return C
 
     def _upload_slot_state(self) -> None:
         self._dev = {

@@ -54,6 +54,26 @@ def percentile(values: list, q: float) -> float:
     return float(np.percentile(np.asarray(values, dtype=np.float64), q))
 
 
+def sched_witness_verdict():
+    """Merged starvation-witness verdict, or None when not armed.
+
+    Dumps this coordinator's recorder, then merges every
+    sched_witness_*.json under the witness dir (worker processes
+    dump theirs at exit) so the artifact carries the fleet-wide
+    max wait-age, not just the local one.
+    """
+    from polykey_tpu.analysis import sched, schedwitness
+    if not schedwitness.installed():
+        return None
+    path = schedwitness.dump()
+    if path is None:
+        return None
+    log(f"sched witness -> {path}")
+    return sched.witness_verdict(
+        schedwitness.load_witness(os.path.dirname(path))
+    )
+
+
 def _config(args):
     from polykey_tpu.engine.config import EngineConfig
 
@@ -367,6 +387,9 @@ def run(args) -> int:
             + timeline_kinds.get("tier_scale_down", 0)
         ),
     }
+    verdict = sched_witness_verdict()
+    if verdict is not None:
+        artifact["sched_witness"] = verdict
     out = args.out or os.path.join(
         "perf", f"autopilot_soak_{time.strftime('%Y-%m-%d')}.json"
     )

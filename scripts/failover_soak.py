@@ -234,6 +234,24 @@ def _dump_lock_witness() -> None:
             log(f"lock witness -> {path}")
 
 
+def _sched_witness_verdict():
+    """Dump this process's starvation-witness summary (no-op unless
+    POLYKEY_SCHED_WITNESS=1 armed it at import) and return the merged
+    SL006 verdict over every dump in the witness dir — workers dump
+    their own files on clean exit; a SIGKILLed worker loses its file
+    and the surviving processes still cover the frontiers they ran."""
+    from polykey_tpu.analysis import sched, schedwitness
+
+    if not schedwitness.installed():
+        return None
+    path = schedwitness.dump()
+    if path is None:
+        return None
+    log(f"sched witness -> {path}")
+    return sched.witness_verdict(
+        schedwitness.load_witness(os.path.dirname(path)))
+
+
 def run_disagg(args) -> int:
     """ISSUE 13 acceptance drill: prefill/decode worker PROCESSES over
     localhost under open-loop Poisson load, a prefill worker killed
@@ -491,6 +509,9 @@ def run_disagg(args) -> int:
         "clock_offsets": stats.get("clock_offsets", {}),
         "handoff_causal_gate": causal,
     }
+    verdict = _sched_witness_verdict()
+    if verdict is not None:
+        artifact["sched_witness"] = verdict
     out = args.out or os.path.join(
         "perf", f"disagg_soak_{time.strftime('%Y-%m-%d')}.json"
     )

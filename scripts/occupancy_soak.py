@@ -52,6 +52,23 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def sched_witness_verdict():
+    """Merged starvation-witness verdict for the artifact (schedlint
+    SL006): when POLYKEY_SCHED_WITNESS armed the witness, dump this
+    process's per-slot wait-age/skip summary now and merge every dump
+    in the out directory. None when the witness is off — artifacts only
+    carry evidence that was actually recorded."""
+    from polykey_tpu.analysis import sched, schedwitness
+
+    if not schedwitness.installed():
+        return None
+    path = schedwitness.dump()
+    if path is None:
+        return None
+    return sched.witness_verdict(
+        schedwitness.load_witness(os.path.dirname(path)))
+
+
 def build_engine(args, ragged: bool = False, overrides: dict = None,
                  params=None, draft_params=None):
     import dataclasses
@@ -208,6 +225,14 @@ def run_main(args) -> int:
     else:
         result = run_soak(args, ragged=args.ragged)
         failures = result["failed_in_window"]
+
+    verdict = sched_witness_verdict()
+    if verdict is not None:
+        # The soak's fairness evidence rides the same artifact as its
+        # occupancy numbers: per-frontier worst wait age / consecutive
+        # skips vs the SL006 gates, merged across every process that
+        # dumped into the witness dir.
+        result["sched_witness"] = verdict
 
     out_path = args.out or os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -1057,6 +1082,9 @@ def run_hostkv_main(args) -> int:
         "platform": jax.devices()[0].platform,
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
+    verdict = sched_witness_verdict()
+    if verdict is not None:
+        result["sched_witness"] = verdict
 
     out_path = args.out or os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),

@@ -615,7 +615,7 @@ def test_json_output_shape(tmp_path, capsys):
         assert 0 < entry["hbm_fraction"] < 1
 
 
-# -- the four-tier `all` aggregate --------------------------------------------
+# -- the five-tier `all` aggregate --------------------------------------------
 
 
 def test_all_includes_memlint_and_any_tier_failure_fails(
@@ -635,7 +635,7 @@ def test_all_includes_memlint_and_any_tier_failure_fails(
     payload = json.loads(capsys.readouterr().out)
     assert rc == 0
     assert set(payload["tiers"]) == {"polylint", "racelint", "graphlint",
-                                     "memlint"}
+                                     "memlint", "schedlint"}
     assert payload["summary"]["all_clean"] is True
 
     # A memlint-only failure (clean for every other tier) fails the

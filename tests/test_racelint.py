@@ -884,7 +884,7 @@ def test_all_aggregates_tiers(tmp_path, capsys, monkeypatch):
     assert rc == 0
     assert calls        # graph tier was dispatched
     assert set(payload["tiers"]) == {"polylint", "racelint", "graphlint",
-                                     "memlint"}
+                                     "memlint", "schedlint"}
     assert payload["summary"]["all_clean"] is True
 
     # A blocking finding in ANY tier fails the aggregate.
